@@ -1,0 +1,9 @@
+"""replay_tpu — a TPU-native recommender-systems framework.
+
+A ground-up JAX/XLA re-design with the capabilities of sb-ai-lab/RePlay: data schema +
+preprocessing + splitting, classical models, transformer sequential models (SASRec,
+BERT4Rec, TwoTower) trained with a pjit/mesh trainer over TPU ICI, an evaluation-metric
+battery, HPO, and production inference paths.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+from .dataset import Dataset, nunique, select
+from .dataset_label_encoder import DatasetLabelEncoder
+from .schema import FeatureHint, FeatureInfo, FeatureSchema, FeatureSource, FeatureType
+
+__all__ = [
+    "Dataset",
+    "DatasetLabelEncoder",
+    "FeatureHint",
+    "FeatureInfo",
+    "FeatureSchema",
+    "FeatureSource",
+    "FeatureType",
+    "nunique",
+    "select",
+]

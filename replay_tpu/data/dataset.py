@@ -1,0 +1,416 @@
+"""Universal dataset container: interactions + optional query/item feature frames.
+
+Capability parity with the reference Dataset (replay/data/dataset.py:33-797): consistency
+checks (ids present in feature frames, encoded-id range checks), auto-labeling of columns
+missing from the schema as NUMERICAL (with a warning), lazy cardinality via nunique,
+``save``/``load`` into a ``<name>.replay`` directory (init_args.json + parquet payloads),
+backend conversion, and ``subset``. Our build is pandas-first — polars/spark frames are
+accepted and converted at the boundary when those engines are installed.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from replay_tpu.utils.types import POLARS_AVAILABLE, DataFrameLike, df_backend
+
+from .schema import FeatureHint, FeatureInfo, FeatureSchema, FeatureSource, FeatureType
+
+
+def _unique_count(df, column: str) -> int:
+    backend = df_backend(df)
+    if backend == "pandas":
+        return int(df[column].nunique())
+    if backend == "polars":  # pragma: no cover - polars absent in image
+        return int(df[column].n_unique())
+    return int(df.select(column).distinct().count())  # pragma: no cover - spark
+
+
+def _unique_values(df, column: str):
+    backend = df_backend(df)
+    if backend == "pandas":
+        return df[column].unique()
+    if backend == "polars":  # pragma: no cover
+        return df[column].unique().to_numpy()
+    return np.array([r[0] for r in df.select(column).distinct().collect()])  # pragma: no cover
+
+
+class Dataset:
+    """Container of interactions plus optional query/item feature frames."""
+
+    def __init__(
+        self,
+        feature_schema: FeatureSchema,
+        interactions: DataFrameLike,
+        query_features: Optional[DataFrameLike] = None,
+        item_features: Optional[DataFrameLike] = None,
+        check_consistency: bool = True,
+        categorical_encoded: bool = False,
+    ) -> None:
+        self._interactions = interactions
+        self._query_features = query_features
+        self._item_features = item_features
+        self._categorical_encoded = categorical_encoded
+        self._backend = df_backend(interactions)
+
+        for name, frame in (("query_features", query_features), ("item_features", item_features)):
+            if frame is not None and df_backend(frame) != self._backend:
+                msg = f"interactions and {name} must use the same dataframe backend."
+                raise TypeError(msg)
+
+        try:
+            feature_schema.query_id_column
+        except ValueError as exc:
+            msg = "Query id column is not set."
+            raise ValueError(msg) from exc
+        try:
+            feature_schema.item_id_column
+        except ValueError as exc:
+            msg = "Item id column is not set."
+            raise ValueError(msg) from exc
+
+        self._feature_schema = self._complete_schema(feature_schema.copy())
+
+        if check_consistency:
+            if query_features is not None:
+                self._check_ids_consistency(FeatureHint.QUERY_ID)
+            if item_features is not None:
+                self._check_ids_consistency(FeatureHint.ITEM_ID)
+            if categorical_encoded:
+                self._check_encoded()
+
+    # -- basic properties -------------------------------------------------
+    interactions = property(lambda self: self._interactions)
+    query_features = property(lambda self: self._query_features)
+    item_features = property(lambda self: self._item_features)
+    feature_schema = property(lambda self: self._feature_schema)
+
+    @property
+    def is_categorical_encoded(self) -> bool:
+        return self._categorical_encoded
+
+    @property
+    def is_pandas(self) -> bool:
+        return self._backend == "pandas"
+
+    @property
+    def is_polars(self) -> bool:
+        return self._backend == "polars"
+
+    @property
+    def is_spark(self) -> bool:
+        return self._backend == "spark"
+
+    @property
+    def backend(self) -> str:
+        return self._backend
+
+    def _frame_of(self, source: Optional[FeatureSource]) -> Optional[DataFrameLike]:
+        return {
+            FeatureSource.INTERACTIONS: self._interactions,
+            FeatureSource.QUERY_FEATURES: self._query_features,
+            FeatureSource.ITEM_FEATURES: self._item_features,
+            None: None,
+        }[source]
+
+    def _id_frame(self, hint: FeatureHint) -> DataFrameLike:
+        """Frame the id column should be counted over: the feature frame when present."""
+        if hint == FeatureHint.QUERY_ID and self._query_features is not None:
+            return self._query_features
+        if hint == FeatureHint.ITEM_ID and self._item_features is not None:
+            return self._item_features
+        return self._interactions
+
+    @property
+    def query_ids(self) -> DataFrameLike:
+        col = self._feature_schema.query_id_column
+        return self._unique_id_frame(self._id_frame(FeatureHint.QUERY_ID), col)
+
+    @property
+    def item_ids(self) -> DataFrameLike:
+        col = self._feature_schema.item_id_column
+        return self._unique_id_frame(self._id_frame(FeatureHint.ITEM_ID), col)
+
+    def _unique_id_frame(self, df, col: str):
+        if self.is_pandas:
+            import pandas as pd
+
+            return pd.DataFrame({col: np.sort(df[col].unique())})
+        if self.is_polars:  # pragma: no cover
+            return df.select(col).unique().sort(col)
+        return df.select(col).distinct()  # pragma: no cover
+
+    @property
+    def query_count(self) -> int:
+        count = self._feature_schema.query_id_feature.cardinality
+        assert count is not None
+        return count
+
+    @property
+    def item_count(self) -> int:
+        count = self._feature_schema.item_id_feature.cardinality
+        assert count is not None
+        return count
+
+    # -- schema completion ------------------------------------------------
+    def _complete_schema(self, schema: FeatureSchema) -> FeatureSchema:
+        """Assign sources, auto-label unlisted columns as NUMERICAL, install cardinality callbacks."""
+        frames = {
+            FeatureSource.INTERACTIONS: self._interactions,
+            FeatureSource.QUERY_FEATURES: self._query_features,
+            FeatureSource.ITEM_FEATURES: self._item_features,
+        }
+        column_sources: dict[str, FeatureSource] = {}
+        for source, frame in frames.items():
+            if frame is None:
+                continue
+            for col in self._columns(frame):
+                column_sources.setdefault(col, source)
+
+        features = list(schema.all_features)
+        known = {f.column for f in features}
+        qid = schema.query_id_column
+        iid = schema.item_id_column
+
+        for col, source in column_sources.items():
+            if col not in known and col not in (qid, iid):
+                warnings.warn(
+                    f"Column '{col}' is not described in the feature schema; assuming NUMERICAL.",
+                    stacklevel=3,
+                )
+                features.append(
+                    FeatureInfo(column=col, feature_type=FeatureType.NUMERICAL, feature_source=source)
+                )
+
+        completed = FeatureSchema(features)
+        for feature in completed.all_features:
+            if feature.feature_source is None and feature.column in column_sources:
+                feature._set_feature_source(column_sources[feature.column])
+            if feature.feature_hint in (FeatureHint.QUERY_ID, FeatureHint.ITEM_ID):
+                feature._set_feature_source(FeatureSource.INTERACTIONS)
+            if feature.feature_type.is_categorical:
+                feature._set_cardinality_callback(self._make_cardinality_callback(feature))
+        return completed
+
+    def _make_cardinality_callback(self, feature: FeatureInfo):
+        hint = feature.feature_hint
+
+        def callback(column: str) -> int:
+            if hint in (FeatureHint.QUERY_ID, FeatureHint.ITEM_ID):
+                if self._categorical_encoded:
+                    # encoded ids are contiguous [0, n) — cardinality is max+1
+                    frame = self._id_frame(hint)
+                    return int(np.max(np.asarray(frame[column] if self.is_pandas else _unique_values(frame, column)))) + 1
+                return _unique_count(self._id_frame(hint), column)
+            frame = self._frame_of(feature.feature_source) if feature.feature_source else self._interactions
+            if feature.feature_type == FeatureType.CATEGORICAL_LIST:
+                if self.is_pandas:
+                    return int(frame[column].explode().nunique())
+                msg = "cardinality of list features is only supported on pandas frames"  # pragma: no cover
+                raise NotImplementedError(msg)  # pragma: no cover
+            return _unique_count(frame, column)
+
+        return callback
+
+    # -- consistency ------------------------------------------------------
+    def _check_ids_consistency(self, hint: FeatureHint) -> None:
+        features_frame = self._query_features if hint == FeatureHint.QUERY_ID else self._item_features
+        assert features_frame is not None
+        column = (
+            self._feature_schema.query_id_column
+            if hint == FeatureHint.QUERY_ID
+            else self._feature_schema.item_id_column
+        )
+        inter_ids = set(np.asarray(_unique_values(self._interactions, column)).tolist())
+        feat_ids = set(np.asarray(_unique_values(features_frame, column)).tolist())
+        missing = inter_ids - feat_ids
+        if missing:
+            msg = f"{len(missing)} {hint.value}s from interactions are absent in the feature frame."
+            raise ValueError(msg)
+
+    def _check_encoded(self) -> None:
+        for feature in self._feature_schema.categorical_features.all_features:
+            frame = self._frame_of(feature.feature_source)
+            if frame is None:
+                continue
+            if not self.is_pandas:  # pragma: no cover
+                continue
+            series = frame[feature.column]
+            if feature.feature_type == FeatureType.CATEGORICAL_LIST:
+                series = series.explode()
+            values = series.to_numpy()
+            if values.size == 0:
+                continue
+            if not np.issubdtype(np.asarray(values).dtype, np.integer):
+                msg = f"Column '{feature.column}' is declared encoded but is not integer-typed."
+                raise ValueError(msg)
+            if int(values.min()) < 0:
+                msg = f"Column '{feature.column}' is declared encoded but contains negative ids."
+                raise ValueError(msg)
+
+    # -- structural ops ---------------------------------------------------
+    def subset(self, features_to_keep) -> "Dataset":
+        """Project every frame onto the requested feature columns (+ id columns)."""
+        keep = set(features_to_keep)
+        keep.add(self._feature_schema.query_id_column)
+        keep.add(self._feature_schema.item_id_column)
+        schema = self._feature_schema.subset(keep)
+
+        def project(frame):
+            if frame is None:
+                return None
+            cols = [c for c in self._columns(frame) if c in keep]
+            return frame[cols] if self.is_pandas else frame.select(cols)
+
+        item_frame = project(self._item_features)
+        query_frame = project(self._query_features)
+        if item_frame is not None and len(self._columns(item_frame)) <= 1:
+            item_frame = None
+            schema = schema.drop(feature_source=FeatureSource.ITEM_FEATURES)
+        if query_frame is not None and len(self._columns(query_frame)) <= 1:
+            query_frame = None
+            schema = schema.drop(feature_source=FeatureSource.QUERY_FEATURES)
+
+        return Dataset(
+            feature_schema=schema,
+            interactions=project(self._interactions),
+            query_features=query_frame,
+            item_features=item_frame,
+            check_consistency=False,
+            categorical_encoded=self._categorical_encoded,
+        )
+
+    @staticmethod
+    def _columns(frame) -> list[str]:
+        return list(frame.columns)
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path: str) -> None:
+        base = Path(path).with_suffix(".replay").resolve()
+        base.mkdir(parents=True, exist_ok=True)
+        meta = {
+            "_class_name": type(self).__name__,
+            "init_args": {
+                "feature_schema": [
+                    {
+                        "column": f.column,
+                        "feature_type": f.feature_type.name,
+                        "feature_hint": f.feature_hint.name if f.feature_hint else None,
+                    }
+                    for f in self._feature_schema.all_features
+                ],
+                "backend": self._backend,
+                "query_features": self._query_features is not None,
+                "item_features": self._item_features is not None,
+                "categorical_encoded": self._categorical_encoded,
+            },
+        }
+        (base / "init_args.json").write_text(json.dumps(meta))
+        for name, frame in (
+            ("interactions", self._interactions),
+            ("query_features", self._query_features),
+            ("item_features", self._item_features),
+        ):
+            if frame is not None:
+                self._write_parquet(frame, base / f"{name}.parquet")
+
+    def _write_parquet(self, frame, path: Path) -> None:
+        if self.is_pandas:
+            frame.to_parquet(path)
+        elif self.is_polars:  # pragma: no cover
+            frame.write_parquet(path)
+        else:  # pragma: no cover
+            frame.write.mode("overwrite").parquet(str(path))
+
+    @classmethod
+    def load(cls, path: str, dataframe_type: Optional[str] = None) -> "Dataset":
+        base = Path(path).with_suffix(".replay").resolve()
+        meta = json.loads((base / "init_args.json").read_text())
+        args = meta["init_args"]
+        backend = dataframe_type or args.get("backend", "pandas")
+
+        features = [
+            FeatureInfo(
+                column=f["column"],
+                feature_type=FeatureType[f["feature_type"]],
+                feature_hint=FeatureHint[f["feature_hint"]] if f["feature_hint"] else None,
+            )
+            for f in args["feature_schema"]
+        ]
+
+        def read(name: str):
+            file = base / f"{name}.parquet"
+            if backend == "pandas":
+                import pandas as pd
+
+                return pd.read_parquet(file)
+            if backend == "polars" and POLARS_AVAILABLE:  # pragma: no cover
+                import polars as pl
+
+                return pl.read_parquet(file)
+            msg = f"Unsupported dataframe backend for load: {backend}"  # pragma: no cover
+            raise ValueError(msg)  # pragma: no cover
+
+        return cls(
+            feature_schema=FeatureSchema(features),
+            interactions=read("interactions"),
+            query_features=read("query_features") if args["query_features"] else None,
+            item_features=read("item_features") if args["item_features"] else None,
+            check_consistency=False,
+            categorical_encoded=args["categorical_encoded"],
+        )
+
+    # -- backend conversion ----------------------------------------------
+    def to_pandas(self) -> "Dataset":
+        """Return a pandas-backed copy of this dataset (no-op if already pandas)."""
+        if self.is_pandas:
+            return self
+        convert = _to_pandas_frame  # pragma: no cover
+        return self._converted(convert)  # pragma: no cover
+
+    def to_polars(self) -> "Dataset":  # pragma: no cover - polars absent in image
+        if self.is_polars:
+            return self
+        if not POLARS_AVAILABLE:
+            msg = "polars is not installed"
+            raise ImportError(msg)
+        import polars as pl
+
+        return self._converted(lambda df: pl.from_pandas(df) if df_backend(df) == "pandas" else df)
+
+    def _converted(self, convert) -> "Dataset":  # pragma: no cover
+        return Dataset(
+            feature_schema=self._feature_schema.copy(),
+            interactions=convert(self._interactions),
+            query_features=convert(self._query_features) if self._query_features is not None else None,
+            item_features=convert(self._item_features) if self._item_features is not None else None,
+            check_consistency=False,
+            categorical_encoded=self._categorical_encoded,
+        )
+
+
+def _to_pandas_frame(df):  # pragma: no cover - conversion from optional engines
+    backend = df_backend(df)
+    if backend == "pandas":
+        return df
+    if backend == "polars":
+        return df.to_pandas()
+    return df.toPandas()
+
+
+def nunique(df, column: str) -> int:
+    """Number of distinct values of ``column`` (backend-dispatching helper)."""
+    return _unique_count(df, column)
+
+
+def select(df, columns):
+    """Project onto ``columns`` (backend-dispatching helper)."""
+    backend = df_backend(df)
+    if backend == "pandas":
+        return df[list(columns)]
+    return df.select(list(columns))  # pragma: no cover

@@ -1,0 +1,35 @@
+from .filters import (
+    ConsecutiveDuplicatesFilter,
+    EntityDaysFilter,
+    GlobalDaysFilter,
+    InteractionEntriesFilter,
+    LowRatingFilter,
+    MinCountFilter,
+    NumInteractionsFilter,
+    QuantileItemsFilter,
+    TimePeriodFilter,
+)
+from .label_encoder import (
+    LabelEncoder,
+    LabelEncoderPartialFitWarning,
+    LabelEncoderTransformWarning,
+    LabelEncodingRule,
+    SequenceEncodingRule,
+)
+
+__all__ = [
+    "ConsecutiveDuplicatesFilter",
+    "EntityDaysFilter",
+    "GlobalDaysFilter",
+    "InteractionEntriesFilter",
+    "LabelEncoder",
+    "LabelEncoderPartialFitWarning",
+    "LabelEncoderTransformWarning",
+    "LabelEncodingRule",
+    "LowRatingFilter",
+    "MinCountFilter",
+    "NumInteractionsFilter",
+    "QuantileItemsFilter",
+    "SequenceEncodingRule",
+    "TimePeriodFilter",
+]

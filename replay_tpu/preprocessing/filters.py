@@ -1,0 +1,284 @@
+"""Interaction-log filters.
+
+Capability parity with the reference filter set (replay/preprocessing/filters.py:57-1075):
+InteractionEntriesFilter, MinCountFilter, LowRatingFilter, NumInteractionsFilter,
+EntityDaysFilter, GlobalDaysFilter, TimePeriodFilter, QuantileItemsFilter,
+ConsecutiveDuplicatesFilter. Pandas-first vectorized implementations.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from datetime import datetime, timedelta
+from typing import Literal, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+
+class _BaseFilter(ABC):
+    """A filter maps an interactions dataframe to a filtered dataframe."""
+
+    def transform(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        return self._filter(interactions)
+
+    @abstractmethod
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame: ...
+
+
+class InteractionEntriesFilter(_BaseFilter):
+    """Iteratively drop users/items whose interaction counts fall outside given bounds.
+
+    Applies user and item constraints alternately until a fixed point, the same
+    convergence loop the reference runs (replay/preprocessing/filters.py:131-208).
+    """
+
+    def __init__(
+        self,
+        query_column: str = "user_id",
+        item_column: str = "item_id",
+        min_inter_per_user: Optional[int] = None,
+        max_inter_per_user: Optional[int] = None,
+        min_inter_per_item: Optional[int] = None,
+        max_inter_per_item: Optional[int] = None,
+        allow_caching: bool = True,
+    ) -> None:
+        self.query_column = query_column
+        self.item_column = item_column
+        self.min_inter_per_user = min_inter_per_user
+        self.max_inter_per_user = max_inter_per_user
+        self.min_inter_per_item = min_inter_per_item
+        self.max_inter_per_item = max_inter_per_item
+        self.allow_caching = allow_caching
+        self.total_dropped_interactions = 0
+        for lo, hi in ((min_inter_per_user, max_inter_per_user), (min_inter_per_item, max_inter_per_item)):
+            if lo is not None and lo <= 0:
+                msg = "minimum interaction bounds must be positive"
+                raise ValueError(msg)
+            if lo is not None and hi is not None and hi < lo:
+                msg = "maximum interaction bound must be >= the minimum bound"
+                raise ValueError(msg)
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        df = interactions
+        while True:
+            before = len(df)
+            df = self._bound(df, self.query_column, self.min_inter_per_user, self.max_inter_per_user)
+            df = self._bound(df, self.item_column, self.min_inter_per_item, self.max_inter_per_item)
+            if len(df) == before or df.empty:
+                break
+        self.total_dropped_interactions = len(interactions) - len(df)
+        return df
+
+    @staticmethod
+    def _bound(df: pd.DataFrame, column: str, lo: Optional[int], hi: Optional[int]) -> pd.DataFrame:
+        if lo is None and hi is None:
+            return df
+        counts = df.groupby(column)[column].transform("size")
+        mask = pd.Series(True, index=df.index)
+        if lo is not None:
+            mask &= counts >= lo
+        if hi is not None:
+            mask &= counts <= hi
+        return df[mask]
+
+
+class MinCountFilter(_BaseFilter):
+    """Keep rows whose ``groupby_column`` value occurs at least ``num_entries`` times."""
+
+    def __init__(self, num_entries: int, groupby_column: str = "user_id") -> None:
+        if num_entries <= 0:
+            msg = "num_entries must be positive"
+            raise ValueError(msg)
+        self.num_entries = num_entries
+        self.groupby_column = groupby_column
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        counts = interactions.groupby(self.groupby_column)[self.groupby_column].transform("size")
+        return interactions[counts >= self.num_entries]
+
+
+class LowRatingFilter(_BaseFilter):
+    """Keep rows with ``rating_column`` >= ``value``."""
+
+    def __init__(self, value: float, rating_column: str = "rating") -> None:
+        self.value = value
+        self.rating_column = rating_column
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        return interactions[interactions[self.rating_column] >= self.value]
+
+
+class NumInteractionsFilter(_BaseFilter):
+    """Keep the first/last ``num_interactions`` interactions of each query (by timestamp)."""
+
+    def __init__(
+        self,
+        num_interactions: int = 10,
+        first: bool = True,
+        query_column: str = "user_id",
+        timestamp_column: str = "timestamp",
+        item_column: Optional[str] = None,
+    ) -> None:
+        if num_interactions < 0:
+            msg = "num_interactions must be non-negative"
+            raise ValueError(msg)
+        self.num_interactions = num_interactions
+        self.first = first
+        self.query_column = query_column
+        self.timestamp_column = timestamp_column
+        self.item_column = item_column
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        sort_cols = [self.timestamp_column] + ([self.item_column] if self.item_column else [])
+        ordered = interactions.sort_values(sort_cols, ascending=self.first, kind="stable")
+        kept = ordered.groupby(self.query_column, sort=False).head(self.num_interactions)
+        return kept.sort_index()
+
+
+class EntityDaysFilter(_BaseFilter):
+    """Keep each entity's first/last ``days`` days of interactions."""
+
+    def __init__(
+        self,
+        days: int = 10,
+        first: bool = True,
+        entity_column: str = "user_id",
+        timestamp_column: str = "timestamp",
+    ) -> None:
+        if days <= 0:
+            msg = "days must be positive"
+            raise ValueError(msg)
+        self.days = days
+        self.first = first
+        self.entity_column = entity_column
+        self.timestamp_column = timestamp_column
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        ts = pd.to_datetime(interactions[self.timestamp_column])
+        window = pd.Timedelta(days=self.days)
+        if self.first:
+            start = ts.groupby(interactions[self.entity_column]).transform("min")
+            mask = ts < start + window
+        else:
+            end = ts.groupby(interactions[self.entity_column]).transform("max")
+            mask = ts > end - window
+        return interactions[mask]
+
+
+class GlobalDaysFilter(_BaseFilter):
+    """Keep the dataset's first/last ``days`` days of interactions."""
+
+    def __init__(self, days: int = 10, first: bool = True, timestamp_column: str = "timestamp") -> None:
+        if days <= 0:
+            msg = "days must be positive"
+            raise ValueError(msg)
+        self.days = days
+        self.first = first
+        self.timestamp_column = timestamp_column
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        ts = pd.to_datetime(interactions[self.timestamp_column])
+        window = pd.Timedelta(days=self.days)
+        if self.first:
+            return interactions[ts < ts.min() + window]
+        return interactions[ts > ts.max() - window]
+
+
+class TimePeriodFilter(_BaseFilter):
+    """Keep interactions inside ``[start_date, end_date)``."""
+
+    def __init__(
+        self,
+        start_date: Union[str, datetime, None] = None,
+        end_date: Union[str, datetime, None] = None,
+        timestamp_column: str = "timestamp",
+        time_column_format: str = "%Y-%m-%d %H:%M:%S",
+    ) -> None:
+        self.start_date = self._parse(start_date, time_column_format)
+        self.end_date = self._parse(end_date, time_column_format)
+        self.timestamp_column = timestamp_column
+
+    @staticmethod
+    def _parse(date: Union[str, datetime, None], fmt: str) -> Optional[datetime]:
+        return datetime.strptime(date, fmt) if isinstance(date, str) else date
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        ts = pd.to_datetime(interactions[self.timestamp_column])
+        mask = pd.Series(True, index=interactions.index)
+        if self.start_date is not None:
+            mask &= ts >= self.start_date
+        if self.end_date is not None:
+            mask &= ts < self.end_date
+        return interactions[mask]
+
+
+class QuantileItemsFilter(_BaseFilter):
+    """Undersample over-popular items above the ``alpha_quantile`` of item counts.
+
+    For every item whose count exceeds the quantile threshold, removes
+    ``items_proportion`` of the excess over the long-tail maximum, taking rows from
+    the most-active users first (reference: replay/preprocessing/filters.py:833-995).
+    """
+
+    def __init__(
+        self,
+        alpha_quantile: float = 0.99,
+        items_proportion: float = 0.5,
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+    ) -> None:
+        if not 0 < alpha_quantile < 1:
+            msg = "alpha_quantile must be in (0, 1)"
+            raise ValueError(msg)
+        if not 0 < items_proportion < 1:
+            msg = "items_proportion must be in (0, 1)"
+            raise ValueError(msg)
+        self.alpha_quantile = alpha_quantile
+        self.items_proportion = items_proportion
+        self.query_column = query_column
+        self.item_column = item_column
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        item_counts = interactions.groupby(self.item_column)[self.item_column].transform("size")
+        user_counts = interactions.groupby(self.query_column)[self.query_column].transform("size")
+        per_item_counts = interactions.groupby(self.item_column).size()
+        threshold = per_item_counts.quantile(self.alpha_quantile, interpolation="midpoint")
+
+        long_tail_mask = item_counts <= threshold
+        long_tail_max = item_counts[long_tail_mask].max() if long_tail_mask.any() else 0
+        head = interactions[~long_tail_mask].copy()
+        if head.empty:
+            return interactions
+        head["__n_del"] = (self.items_proportion * (item_counts[~long_tail_mask] - long_tail_max)).astype(int)
+        head["__ucount"] = user_counts[~long_tail_mask]
+        head = head.sort_values("__ucount", ascending=False, kind="stable")
+
+        rank = head.groupby(self.item_column).cumcount()
+        keep_head = head[rank >= head["__n_del"]]
+        result = pd.concat([interactions[long_tail_mask], keep_head[interactions.columns]])
+        return result
+
+class ConsecutiveDuplicatesFilter(_BaseFilter):
+    """Collapse runs of repeated items inside each query's timeline to one row."""
+
+    def __init__(
+        self,
+        keep: Literal["first", "last"] = "first",
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+        timestamp_column: str = "timestamp",
+    ) -> None:
+        if keep not in ("first", "last"):
+            msg = "keep must be 'first' or 'last'"
+            raise ValueError(msg)
+        self.keep = keep
+        self.query_column = query_column
+        self.item_column = item_column
+        self.timestamp_column = timestamp_column
+
+    def _filter(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        shift = 1 if self.keep == "first" else -1
+        ordered = interactions.sort_values(self.timestamp_column, kind="stable")
+        neighbor = ordered.groupby(self.query_column)[self.item_column].shift(shift)
+        return ordered[ordered[self.item_column] != neighbor].reset_index(drop=True)

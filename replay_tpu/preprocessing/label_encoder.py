@@ -1,0 +1,283 @@
+"""Categorical label encoding into contiguous integer ids.
+
+Capability parity with the reference encoder (replay/preprocessing/label_encoder.py:86-996):
+per-column ``LabelEncodingRule`` with fit / partial_fit / transform / inverse_transform,
+unknown-label strategies ``error`` / ``use_default_value`` / ``drop`` (default value may be
+``"last"``), ``SequenceEncodingRule`` for list columns, and a ``LabelEncoder`` composing
+several rules. Pandas-first implementation on numpy factorization instead of the
+reference's triple-backend join pipelines.
+"""
+
+from __future__ import annotations
+
+import warnings
+from collections.abc import Mapping, Sequence
+from typing import Literal, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+HandleUnknownStrategies = Literal["error", "use_default_value", "drop"]
+_STRATEGIES = ("error", "use_default_value", "drop")
+
+
+class LabelEncoderTransformWarning(Warning):
+    """Warning raised on lossy transform behavior (dropping unknown labels)."""
+
+
+class LabelEncoderPartialFitWarning(Warning):
+    """Warning raised when partial_fit is called before fit."""
+
+
+class LabelEncodingRule:
+    """Encode one scalar column's values into contiguous ids ``[0, n)``."""
+
+    def __init__(
+        self,
+        column: str,
+        mapping: Optional[Mapping] = None,
+        handle_unknown: HandleUnknownStrategies = "error",
+        default_value: Union[int, str, None] = None,
+    ) -> None:
+        if handle_unknown not in _STRATEGIES:
+            msg = f"handle_unknown must be one of {_STRATEGIES}, got {handle_unknown!r}."
+            raise ValueError(msg)
+        if (
+            handle_unknown == "use_default_value"
+            and default_value is not None
+            and not isinstance(default_value, int)
+            and default_value != "last"
+        ):
+            msg = "default_value must be int, None or 'last'."
+            raise ValueError(msg)
+        self._column = column
+        self._handle_unknown = handle_unknown
+        self._default_value = default_value
+        self._mapping: Optional[dict] = dict(mapping) if mapping is not None else None
+        self._inverse: Optional[list] = None
+
+    # -- properties -------------------------------------------------------
+    @property
+    def column(self) -> str:
+        return self._column
+
+    @property
+    def is_fitted(self) -> bool:
+        return self._mapping is not None
+
+    def get_mapping(self) -> Mapping:
+        self._require_fitted()
+        return self._mapping
+
+    def get_inverse_mapping(self) -> Mapping:
+        self._require_fitted()
+        return {code: label for label, code in self._mapping.items()}
+
+    def _require_fitted(self):
+        if self._mapping is None:
+            msg = f"LabelEncodingRule for '{self._column}' is not fitted."
+            raise RuntimeError(msg)
+
+    def _inverse_list(self) -> list:
+        if self._inverse is None or len(self._inverse) != len(self._mapping):
+            self._inverse = [None] * len(self._mapping)
+            for label, code in self._mapping.items():
+                self._inverse[code] = label
+        return self._inverse
+
+    # -- column value access (overridden by SequenceEncodingRule) ---------
+    def _values(self, df: pd.DataFrame) -> np.ndarray:
+        return df[self._column].to_numpy()
+
+    def _flat_values(self, df: pd.DataFrame) -> np.ndarray:
+        return self._values(df)
+
+    # -- fitting ----------------------------------------------------------
+    def fit(self, df: pd.DataFrame) -> "LabelEncodingRule":
+        values = pd.unique(pd.Series(self._flat_values(df)))
+        self._mapping = {label: code for code, label in enumerate(values)}
+        self._inverse = None
+        if self._handle_unknown == "use_default_value" and self._default_value in self._mapping:
+            warnings.warn(
+                f"default_value {self._default_value} collides with an encoded label.",
+                LabelEncoderTransformWarning,
+                stacklevel=2,
+            )
+        return self
+
+    def partial_fit(self, df: pd.DataFrame) -> "LabelEncodingRule":
+        if self._mapping is None:
+            warnings.warn(
+                "partial_fit called before fit; falling back to fit.",
+                LabelEncoderPartialFitWarning,
+                stacklevel=2,
+            )
+            return self.fit(df)
+        next_code = len(self._mapping)
+        for label in pd.unique(pd.Series(self._flat_values(df))):
+            if label not in self._mapping:
+                self._mapping[label] = next_code
+                next_code += 1
+        self._inverse = None
+        return self
+
+    # -- encoding ---------------------------------------------------------
+    def _encode_array(self, values: np.ndarray) -> np.ndarray:
+        codes = pd.Series(values).map(self._mapping)
+        unknown = codes.isna().to_numpy()
+        if unknown.any():
+            if self._handle_unknown == "error":
+                unknown_labels = pd.unique(pd.Series(values)[unknown])
+                msg = f"Found unknown labels in column '{self._column}': {list(unknown_labels)[:10]}"
+                raise ValueError(msg)
+            default = len(self._mapping) if self._default_value == "last" else self._default_value
+            if default is None:
+                default = -1
+            codes = codes.fillna(default)
+        return codes.to_numpy(dtype=np.int64), unknown
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self._require_fitted()
+        result = df.copy()
+        codes, unknown = self._encode_array(self._values(df))
+        result[self._column] = codes
+        if unknown.any() and self._handle_unknown == "drop":
+            result = result.loc[~unknown]
+            if result.empty:
+                warnings.warn(
+                    f"Transform of column '{self._column}' with handle_unknown='drop' "
+                    "produced an empty dataframe.",
+                    LabelEncoderTransformWarning,
+                    stacklevel=2,
+                )
+        return result
+
+    def inverse_transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self._require_fitted()
+        inverse = self._inverse_list()
+        result = df.copy()
+        result[self._column] = pd.Series(df[self._column].to_numpy()).map(
+            lambda c: inverse[c] if 0 <= c < len(inverse) else None
+        ).to_numpy()
+        return result
+
+    # -- configuration ----------------------------------------------------
+    def set_default_value(self, default_value: Union[int, str, None]) -> None:
+        if default_value is not None and not isinstance(default_value, int) and default_value != "last":
+            msg = "default_value must be int, None or 'last'."
+            raise ValueError(msg)
+        self._default_value = default_value
+
+    def set_handle_unknown(self, handle_unknown: HandleUnknownStrategies) -> None:
+        if handle_unknown not in _STRATEGIES:
+            msg = f"handle_unknown must be one of {_STRATEGIES}, got {handle_unknown!r}."
+            raise ValueError(msg)
+        self._handle_unknown = handle_unknown
+
+
+class SequenceEncodingRule(LabelEncodingRule):
+    """Encode a list-typed column element-wise with one shared mapping."""
+
+    def _flat_values(self, df: pd.DataFrame) -> np.ndarray:
+        return df[self._column].explode().dropna().to_numpy()
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self._require_fitted()
+        result = df.copy()
+        mapping = self._mapping
+        unknown_found = [False]
+        handle = self._handle_unknown
+        default = len(mapping) if self._default_value == "last" else self._default_value
+
+        def encode_seq(seq):
+            out = []
+            for label in seq:
+                code = mapping.get(label)
+                if code is None:
+                    unknown_found[0] = True
+                    if handle == "error":
+                        msg = f"Found unknown label {label!r} in list column '{self._column}'"
+                        raise ValueError(msg)
+                    if handle == "drop":
+                        continue
+                    out.append(default if default is not None else -1)
+                else:
+                    out.append(code)
+            return np.asarray(out, dtype=np.int64)
+
+        result[self._column] = df[self._column].map(encode_seq)
+        if unknown_found[0] and handle == "drop":
+            lengths = result[self._column].map(len)
+            if (lengths == 0).all():
+                warnings.warn(
+                    f"Transform of list column '{self._column}' with handle_unknown='drop' "
+                    "dropped every element.",
+                    LabelEncoderTransformWarning,
+                    stacklevel=2,
+                )
+        return result
+
+    def inverse_transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        self._require_fitted()
+        inverse = self._inverse_list()
+        result = df.copy()
+        result[self._column] = df[self._column].map(
+            lambda seq: np.asarray(
+                [inverse[c] if 0 <= c < len(inverse) else None for c in seq], dtype=object
+            )
+        )
+        return result
+
+
+class LabelEncoder:
+    """Apply a set of encoding rules column-wise to a dataframe."""
+
+    def __init__(self, rules: Sequence[LabelEncodingRule]) -> None:
+        self.rules = list(rules)
+
+    @property
+    def mapping(self) -> Mapping[str, Mapping]:
+        return {rule.column: rule.get_mapping() for rule in self.rules}
+
+    @property
+    def inverse_mapping(self) -> Mapping[str, Mapping]:
+        return {rule.column: rule.get_inverse_mapping() for rule in self.rules}
+
+    def fit(self, df: pd.DataFrame) -> "LabelEncoder":
+        for rule in self.rules:
+            rule.fit(df)
+        return self
+
+    def partial_fit(self, df: pd.DataFrame) -> "LabelEncoder":
+        for rule in self.rules:
+            rule.partial_fit(df)
+        return self
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        for rule in self.rules:
+            df = rule.transform(df)
+        return df
+
+    def inverse_transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        for rule in self.rules:
+            df = rule.inverse_transform(df)
+        return df
+
+    def fit_transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        return self.fit(df).transform(df)
+
+    def set_default_values(self, default_value_rules: Mapping[str, Union[int, str, None]]) -> None:
+        by_column = {rule.column: rule for rule in self.rules}
+        for column, value in default_value_rules.items():
+            if column not in by_column:
+                msg = f"No encoding rule for column '{column}'."
+                raise ValueError(msg)
+            by_column[column].set_default_value(value)
+
+    def set_handle_unknowns(self, handle_unknown_rules: Mapping[str, HandleUnknownStrategies]) -> None:
+        by_column = {rule.column: rule for rule in self.rules}
+        for column, value in handle_unknown_rules.items():
+            if column not in by_column:
+                msg = f"No encoding rule for column '{column}'."
+                raise ValueError(msg)
+            by_column[column].set_handle_unknown(value)

@@ -1,0 +1,26 @@
+from .base import Splitter, SplitterReturnType
+from .strategies import (
+    ColdUserRandomSplitter,
+    KFolds,
+    LastNSplitter,
+    NewUsersSplitter,
+    RandomNextNSplitter,
+    RandomSplitter,
+    RatioSplitter,
+    TimeSplitter,
+    TwoStageSplitter,
+)
+
+__all__ = [
+    "ColdUserRandomSplitter",
+    "KFolds",
+    "LastNSplitter",
+    "NewUsersSplitter",
+    "RandomNextNSplitter",
+    "RandomSplitter",
+    "RatioSplitter",
+    "Splitter",
+    "SplitterReturnType",
+    "TimeSplitter",
+    "TwoStageSplitter",
+]

@@ -1,0 +1,474 @@
+"""Train/test splitting strategies.
+
+Capability parity with the reference splitter zoo (replay/splitters/*.py): Ratio, Time,
+LastN (interactions | timedelta), RandomNextN, Random, ColdUserRandom, NewUsers,
+TwoStage, KFolds. Each strategy computes a boolean test mask over the interactions;
+the base class applies session recovery and cold-entity dropping.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Literal, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from .base import Splitter, SplitterReturnType
+
+
+def _row_num(df: pd.DataFrame, group_col: str, ts_col: str) -> pd.Series:
+    """1-based rank of each row inside its group, ordered by timestamp (stable)."""
+    order = df.sort_values(ts_col, kind="stable").groupby(group_col, sort=False).cumcount() + 1
+    return order.reindex(df.index)
+
+
+class RatioSplitter(Splitter):
+    """Per-group tail fraction goes to test (reference: replay/splitters/ratio_splitter.py:13)."""
+
+    _init_arg_names = [
+        *Splitter._init_arg_names,
+        "test_size",
+        "divide_column",
+        "min_interactions_per_group",
+        "split_by_fractions",
+    ]
+
+    def __init__(
+        self,
+        test_size: float,
+        divide_column: str = "query_id",
+        drop_cold_users: bool = False,
+        drop_cold_items: bool = False,
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+        timestamp_column: str = "timestamp",
+        min_interactions_per_group: Optional[int] = None,
+        split_by_fractions: bool = True,
+        session_id_column: Optional[str] = None,
+        session_id_processing_strategy: str = "test",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            drop_cold_users=drop_cold_users,
+            query_column=query_column,
+            item_column=item_column,
+            timestamp_column=timestamp_column,
+            session_id_column=session_id_column,
+            session_id_processing_strategy=session_id_processing_strategy,
+        )
+        if not 0 <= test_size <= 1:
+            msg = "test_size must be in [0, 1]"
+            raise ValueError(msg)
+        self.test_size = test_size
+        self.divide_column = divide_column
+        self.min_interactions_per_group = min_interactions_per_group
+        self.split_by_fractions = split_by_fractions
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
+        row_num = _row_num(interactions, self.divide_column, self.timestamp_column)
+        count = interactions.groupby(self.divide_column)[self.divide_column].transform("size")
+        if self.split_by_fractions:
+            mask = row_num / count > 1 - self.test_size
+        else:
+            train_size = count - (count * self.test_size).astype(int)
+            if self.min_interactions_per_group is None:
+                # guarantee small-but-splittable groups at least one test row
+                fractional = (count * self.test_size > 0) & (count * self.test_size < 1) & (train_size > 1)
+                train_size = train_size.where(~fractional, train_size - 1)
+            mask = row_num > train_size
+        if self.min_interactions_per_group is not None:
+            mask &= count >= self.min_interactions_per_group
+        return mask.to_numpy()
+
+
+class TimeSplitter(Splitter):
+    """Split at a timestamp threshold; float threshold means a global row-count quantile."""
+
+    _init_arg_names = [*Splitter._init_arg_names, "time_threshold", "time_column_format"]
+
+    def __init__(
+        self,
+        time_threshold: Union[datetime, str, float, int],
+        query_column: str = "query_id",
+        drop_cold_users: bool = False,
+        drop_cold_items: bool = False,
+        item_column: str = "item_id",
+        timestamp_column: str = "timestamp",
+        session_id_column: Optional[str] = None,
+        session_id_processing_strategy: str = "test",
+        time_column_format: str = "%Y-%m-%d %H:%M:%S",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            drop_cold_users=drop_cold_users,
+            query_column=query_column,
+            item_column=item_column,
+            timestamp_column=timestamp_column,
+            session_id_column=session_id_column,
+            session_id_processing_strategy=session_id_processing_strategy,
+        )
+        if isinstance(time_threshold, float) and not 0 <= time_threshold <= 1:
+            msg = "float time_threshold is a ratio and must be in [0, 1]"
+            raise ValueError(msg)
+        if isinstance(time_threshold, str):
+            time_threshold = datetime.strptime(time_threshold, time_column_format)
+        self.time_threshold = time_threshold
+        self.time_column_format = time_column_format
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
+        ts = interactions[self.timestamp_column]
+        if isinstance(self.time_threshold, float):
+            # reference semantics: threshold = timestamp at row int(n * (1 - ratio)) when sorted
+            ordered = ts.sort_values(kind="stable")
+            threshold = ordered.iloc[int(len(ordered) * (1 - self.time_threshold))]
+            return (ts >= threshold).to_numpy()
+        threshold = self.time_threshold
+        if np.issubdtype(ts.dtype, np.datetime64):
+            if isinstance(threshold, (int, float)):
+                # numeric thresholds against datetime columns are unix SECONDS
+                threshold = pd.Timestamp(threshold, unit="s")
+            else:
+                threshold = pd.Timestamp(threshold)
+            ts = pd.to_datetime(ts)
+        return (ts >= threshold).to_numpy()
+
+
+class LastNSplitter(Splitter):
+    """Last N interactions (or last N seconds of history) per group go to test."""
+
+    _init_arg_names = [*Splitter._init_arg_names, "N", "divide_column", "strategy"]
+
+    def __init__(
+        self,
+        N: int,  # noqa: N803 - reference-compatible name
+        divide_column: str = "query_id",
+        time_column_format: str = "%Y-%m-%d %H:%M:%S",
+        strategy: Literal["interactions", "timedelta"] = "interactions",
+        drop_cold_users: bool = False,
+        drop_cold_items: bool = False,
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+        timestamp_column: str = "timestamp",
+        session_id_column: Optional[str] = None,
+        session_id_processing_strategy: str = "test",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            drop_cold_users=drop_cold_users,
+            query_column=query_column,
+            item_column=item_column,
+            timestamp_column=timestamp_column,
+            session_id_column=session_id_column,
+            session_id_processing_strategy=session_id_processing_strategy,
+        )
+        if strategy not in ("interactions", "timedelta"):
+            msg = "strategy must be 'interactions' or 'timedelta'"
+            raise ValueError(msg)
+        self.N = N
+        self.divide_column = divide_column
+        self.strategy = strategy
+        self.time_column_format = time_column_format
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
+        if self.strategy == "interactions":
+            row_num = _row_num(interactions, self.divide_column, self.timestamp_column)
+            count = interactions.groupby(self.divide_column)[self.divide_column].transform("size")
+            return (row_num > count - float(self.N)).to_numpy()
+        ts = interactions[self.timestamp_column]
+        if not np.issubdtype(ts.dtype, np.number):
+            ts = pd.to_datetime(ts).astype("int64") // 10**9
+        group_max = ts.groupby(interactions[self.divide_column]).transform("max")
+        return ((group_max - ts) < self.N).to_numpy()
+
+
+class RandomNextNSplitter(Splitter):
+    """Cut each group's timeline at a random point; the next N rows are test, the rest dropped.
+
+    Mirrors the reference semantics (replay/splitters/random_next_n_splitter.py:20): rows
+    past ``cut + N`` are removed from both splits, so ``split`` is overridden to drop them.
+    """
+
+    _init_arg_names = [*Splitter._init_arg_names, "N", "divide_column", "seed"]
+
+    def __init__(
+        self,
+        N: Optional[int] = 1,  # noqa: N803
+        divide_column: str = "query_id",
+        seed: Optional[int] = None,
+        query_column: str = "query_id",
+        drop_cold_users: bool = False,
+        drop_cold_items: bool = False,
+        item_column: str = "item_id",
+        timestamp_column: str = "timestamp",
+        session_id_column: Optional[str] = None,
+        session_id_processing_strategy: str = "test",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            drop_cold_users=drop_cold_users,
+            query_column=query_column,
+            item_column=item_column,
+            timestamp_column=timestamp_column,
+            session_id_column=session_id_column,
+            session_id_processing_strategy=session_id_processing_strategy,
+        )
+        if N is not None and N < 1:
+            msg = "N must be >= 1 or None"
+            raise ValueError(msg)
+        self.N = N
+        self.divide_column = divide_column
+        self.seed = seed
+
+    def split(self, interactions: pd.DataFrame) -> SplitterReturnType:
+        rank = _row_num(interactions, self.divide_column, self.timestamp_column) - 1
+        counts = interactions.groupby(self.divide_column, sort=False)[self.divide_column].agg("size")
+        rng = np.random.RandomState(self.seed)
+        cuts = pd.Series(rng.randint(0, counts.to_numpy()), index=counts.index)
+        cut_per_row = interactions[self.divide_column].map(cuts)
+
+        keep = interactions if self.N is None else interactions[rank < cut_per_row + self.N]
+        rank = rank.loc[keep.index]
+        cut_per_row = cut_per_row.loc[keep.index]
+        test_mask = (rank >= cut_per_row).to_numpy()
+        if self.session_id_column is not None:
+            test_mask = self._recover_sessions(keep, test_mask)
+        return self._drop_cold(keep[~test_mask], keep[test_mask])
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:  # pragma: no cover
+        raise NotImplementedError
+
+
+class RandomSplitter(Splitter):
+    """Uniformly sample a fraction of rows into test."""
+
+    _init_arg_names = [*Splitter._init_arg_names, "test_size", "seed"]
+
+    def __init__(
+        self,
+        test_size: float,
+        drop_cold_items: bool = False,
+        drop_cold_users: bool = False,
+        seed: Optional[int] = None,
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            drop_cold_users=drop_cold_users,
+            query_column=query_column,
+            item_column=item_column,
+        )
+        if not 0 <= test_size <= 1:
+            msg = "test_size must be in [0, 1]"
+            raise ValueError(msg)
+        self.test_size = test_size
+        self.seed = seed
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
+        train_idx = interactions.sample(frac=1 - self.test_size, random_state=self.seed).index
+        return (~interactions.index.isin(train_idx)).astype(bool)
+
+
+class ColdUserRandomSplitter(Splitter):
+    """Randomly move whole users (all their interactions) into test."""
+
+    _init_arg_names = [*Splitter._init_arg_names, "test_size", "seed"]
+
+    def __init__(
+        self,
+        test_size: float,
+        drop_cold_items: bool = False,
+        seed: Optional[int] = None,
+        query_column: str = "query_id",
+        item_column: Optional[str] = "item_id",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            query_column=query_column,
+            item_column=item_column,
+        )
+        if not 0 < test_size < 1:
+            msg = "test_size must be in (0, 1)"
+            raise ValueError(msg)
+        self.test_size = test_size
+        self.seed = seed
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
+        users = pd.Series(interactions[self.query_column].unique())
+        train_users = users.sample(frac=1 - self.test_size, random_state=self.seed)
+        return (~interactions[self.query_column].isin(set(train_users))).to_numpy()
+
+
+class NewUsersSplitter(Splitter):
+    """Test = full history of the ``test_size`` fraction of users who arrive latest.
+
+    Train keeps only interactions strictly before the first new-user arrival
+    (reference: replay/splitters/new_users_splitter.py:12).
+    """
+
+    _init_arg_names = [*Splitter._init_arg_names, "test_size"]
+
+    def __init__(
+        self,
+        test_size: float,
+        drop_cold_items: bool = False,
+        query_column: str = "query_id",
+        item_column: Optional[str] = "item_id",
+        timestamp_column: Optional[str] = "timestamp",
+        session_id_column: Optional[str] = None,
+        session_id_processing_strategy: str = "test",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            query_column=query_column,
+            item_column=item_column,
+            timestamp_column=timestamp_column,
+            session_id_column=session_id_column,
+            session_id_processing_strategy=session_id_processing_strategy,
+        )
+        if not 0 < test_size < 1:
+            msg = "test_size must be in (0, 1)"
+            raise ValueError(msg)
+        self.test_size = test_size
+
+    def split(self, interactions: pd.DataFrame) -> SplitterReturnType:
+        ts = interactions[self.timestamp_column]
+        start_by_user = ts.groupby(interactions[self.query_column]).transform("min")
+        user_starts = (
+            interactions.assign(__start=start_by_user)
+            .drop_duplicates(self.query_column)["__start"]
+            .sort_values(ascending=False)
+        )
+        n_test_users = int(np.ceil(self.test_size * len(user_starts)))
+        test_start = user_starts.iloc[max(n_test_users - 1, 0)]
+
+        test_mask = (start_by_user >= test_start).to_numpy()
+        if self.session_id_column is not None:
+            test_mask = self._recover_sessions(interactions, test_mask)
+        train = interactions[(ts < test_start).to_numpy() & ~test_mask]
+        test = interactions[test_mask]
+        return self._drop_cold(train, test)
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:  # pragma: no cover
+        raise NotImplementedError
+
+
+class TwoStageSplitter(Splitter):
+    """First pick test users (fraction or count), then a fraction/count of each one's rows."""
+
+    _init_arg_names = [
+        *Splitter._init_arg_names,
+        "first_divide_size",
+        "second_divide_size",
+        "first_divide_column",
+        "second_divide_column",
+        "shuffle",
+        "seed",
+    ]
+
+    def __init__(
+        self,
+        first_divide_size: Union[float, int],
+        second_divide_size: Union[float, int],
+        first_divide_column: str = "query_id",
+        second_divide_column: str = "item_id",
+        shuffle: bool = False,
+        drop_cold_items: bool = False,
+        drop_cold_users: bool = False,
+        seed: Optional[int] = None,
+        query_column: str = "query_id",
+        item_column: Optional[str] = "item_id",
+        timestamp_column: Optional[str] = "timestamp",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            drop_cold_users=drop_cold_users,
+            query_column=query_column,
+            item_column=item_column,
+            timestamp_column=timestamp_column,
+        )
+        self.first_divide_size = first_divide_size
+        self.second_divide_size = second_divide_size
+        self.first_divide_column = first_divide_column
+        self.second_divide_column = second_divide_column
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
+        values = np.sort(interactions[self.first_divide_column].unique())
+        n_values = len(values)
+        if isinstance(self.first_divide_size, int):
+            if not 1 <= self.first_divide_size < n_values:
+                msg = f"first_divide_size must be in [1, {n_values}), got {self.first_divide_size}"
+                raise ValueError(msg)
+            n_test = self.first_divide_size
+        else:
+            if not 0 < self.first_divide_size < 1:
+                msg = "fractional first_divide_size must be in (0, 1)"
+                raise ValueError(msg)
+            n_test = int(n_values * self.first_divide_size)
+        rng = np.random.RandomState(self.seed)
+        test_values = set(rng.permutation(values)[:n_test].tolist())
+
+        in_test_group = interactions[self.first_divide_column].isin(test_values)
+        if self.shuffle:
+            order = interactions.sample(frac=1, random_state=self.seed)
+        else:
+            order = interactions.sort_values(self.timestamp_column, kind="stable")
+        rank = order.groupby(self.first_divide_column, sort=False).cumcount() + 1
+        rank = rank.reindex(interactions.index)
+        count = interactions.groupby(self.first_divide_column)[self.first_divide_column].transform("size")
+        if isinstance(self.second_divide_size, int):
+            threshold = count - self.second_divide_size
+        else:
+            threshold = count - (count * self.second_divide_size).astype(int)
+        return (in_test_group & (rank > threshold)).to_numpy()
+
+
+class KFolds(Splitter):
+    """Yield ``n_folds`` (train, test) pairs; each query's rows are dealt round-robin."""
+
+    _init_arg_names = [*Splitter._init_arg_names, "n_folds", "strategy", "seed"]
+
+    def __init__(
+        self,
+        n_folds: Optional[int] = 5,
+        strategy: Literal["query"] = "query",
+        drop_cold_items: bool = False,
+        drop_cold_users: bool = False,
+        seed: Optional[int] = None,
+        query_column: str = "query_id",
+        item_column: Optional[str] = "item_id",
+        timestamp_column: Optional[str] = "timestamp",
+        session_id_column: Optional[str] = None,
+        session_id_processing_strategy: str = "test",
+    ) -> None:
+        super().__init__(
+            drop_cold_items=drop_cold_items,
+            drop_cold_users=drop_cold_users,
+            query_column=query_column,
+            item_column=item_column,
+            timestamp_column=timestamp_column,
+            session_id_column=session_id_column,
+            session_id_processing_strategy=session_id_processing_strategy,
+        )
+        if strategy != "query":
+            msg = f"Unknown strategy: {strategy}"
+            raise ValueError(msg)
+        self.n_folds = n_folds
+        self.strategy = strategy
+        self.seed = seed
+
+    def split(self, interactions: pd.DataFrame):
+        shuffled = interactions.sample(frac=1, random_state=self.seed)
+        fold = (shuffled.groupby(self.query_column, sort=False).cumcount() + 1) % self.n_folds
+        fold = fold.reindex(interactions.index)
+        for i in range(self.n_folds):
+            test_mask = (fold == i).to_numpy()
+            if self.session_id_column is not None:
+                test_mask = self._recover_sessions(interactions, test_mask)
+            yield self._drop_cold(interactions[~test_mask], interactions[test_mask])
+
+    def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:  # pragma: no cover
+        raise NotImplementedError

@@ -1,0 +1,25 @@
+from .types import (
+    OPTUNA_AVAILABLE,
+    PANDAS_AVAILABLE,
+    POLARS_AVAILABLE,
+    PYSPARK_AVAILABLE,
+    TORCH_AVAILABLE,
+    DataFrameLike,
+    PandasDataFrame,
+    PolarsDataFrame,
+    SparkDataFrame,
+    df_backend,
+)
+
+__all__ = [
+    "OPTUNA_AVAILABLE",
+    "PANDAS_AVAILABLE",
+    "POLARS_AVAILABLE",
+    "PYSPARK_AVAILABLE",
+    "TORCH_AVAILABLE",
+    "DataFrameLike",
+    "PandasDataFrame",
+    "PolarsDataFrame",
+    "SparkDataFrame",
+    "df_backend",
+]

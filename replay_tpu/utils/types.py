@@ -1,0 +1,56 @@
+"""Optional-dependency gates and dataframe typing.
+
+Capability parity with ``replay/utils/types.py`` (reference: replay/utils/types.py:23-50):
+the reference feature-gates pyspark/torch/ann/openvino/optuna/lightfm/obp/lightautoml.
+Our TPU build's primary engine is pandas (+ JAX for compute); polars and pyspark are
+optional input adapters, optuna gates HPO, torch is only used for interop tests.
+"""
+
+from importlib.util import find_spec
+from typing import Union
+
+PANDAS_AVAILABLE = find_spec("pandas") is not None
+POLARS_AVAILABLE = find_spec("polars") is not None
+PYSPARK_AVAILABLE = find_spec("pyspark") is not None
+OPTUNA_AVAILABLE = find_spec("optuna") is not None
+TORCH_AVAILABLE = find_spec("torch") is not None
+HYPOTHESIS_AVAILABLE = find_spec("hypothesis") is not None
+
+_frames = []
+
+if PANDAS_AVAILABLE:
+    import pandas as _pd
+
+    PandasDataFrame = _pd.DataFrame
+    _frames.append(_pd.DataFrame)
+else:  # pragma: no cover - pandas is always present in our image
+    PandasDataFrame = None
+
+if POLARS_AVAILABLE:  # pragma: no cover - polars absent in our image
+    import polars as _pl
+
+    PolarsDataFrame = _pl.DataFrame
+    _frames.append(_pl.DataFrame)
+else:
+    PolarsDataFrame = None
+
+if PYSPARK_AVAILABLE:  # pragma: no cover - pyspark absent in our image
+    from pyspark.sql import DataFrame as SparkDataFrame
+
+    _frames.append(SparkDataFrame)
+else:
+    SparkDataFrame = None
+
+DataFrameLike = Union[tuple(_frames)] if len(_frames) > 1 else PandasDataFrame
+
+
+def df_backend(df) -> str:
+    """Return the backend name ('pandas' | 'polars' | 'spark') of a dataframe."""
+    if PANDAS_AVAILABLE and isinstance(df, PandasDataFrame):
+        return "pandas"
+    if POLARS_AVAILABLE and isinstance(df, PolarsDataFrame):  # pragma: no cover
+        return "polars"
+    if PYSPARK_AVAILABLE and isinstance(df, SparkDataFrame):  # pragma: no cover
+        return "spark"
+    msg = f"Unsupported dataframe type: {type(df)}"
+    raise TypeError(msg)

@@ -1,0 +1,77 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureSource, FeatureType
+
+
+def test_counts(dataset):
+    assert dataset.query_count == 4
+    assert dataset.item_count == 4
+    assert dataset.is_pandas and not dataset.is_polars and not dataset.is_spark
+
+
+def test_ids(dataset):
+    assert list(dataset.query_ids["user_id"]) == [0, 1, 2, 3]
+    assert list(dataset.item_ids["item_id"]) == [0, 1, 2, 3]
+
+
+def test_unlabeled_column_warns(feature_schema, interactions_pandas):
+    df = interactions_pandas.assign(extra=1.0)
+    with pytest.warns(UserWarning, match="extra"):
+        ds = Dataset(feature_schema=feature_schema, interactions=df)
+    assert ds.feature_schema["extra"].feature_type == FeatureType.NUMERICAL
+    assert ds.feature_schema["extra"].feature_source == FeatureSource.INTERACTIONS
+
+
+def test_missing_ids_rejected(interactions_pandas):
+    schema = FeatureSchema([FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID)])
+    with pytest.raises(ValueError, match="Query id"):
+        Dataset(feature_schema=schema, interactions=interactions_pandas)
+
+
+def test_feature_frame_consistency(feature_schema, interactions_pandas):
+    item_features = pd.DataFrame({"item_id": [0, 1], "price": [1.0, 2.0]})
+    with pytest.raises(ValueError, match="absent"):
+        Dataset(
+            feature_schema=feature_schema
+            + FeatureSchema([FeatureInfo("price", FeatureType.NUMERICAL, None, FeatureSource.ITEM_FEATURES)]),
+            interactions=interactions_pandas,
+            item_features=item_features,
+        )
+
+
+def test_encoded_check(feature_schema, interactions_pandas):
+    bad = interactions_pandas.copy()
+    bad["item_id"] = bad["item_id"].astype(float)
+    with pytest.raises(ValueError, match="integer"):
+        Dataset(feature_schema=feature_schema, interactions=bad, categorical_encoded=True)
+    ok = Dataset(feature_schema=feature_schema, interactions=interactions_pandas, categorical_encoded=True)
+    assert ok.is_categorical_encoded
+    assert ok.item_count == 4
+
+
+def test_save_load_roundtrip(dataset, tmp_path):
+    path = str(tmp_path / "ds")
+    dataset.save(path)
+    loaded = Dataset.load(path)
+    assert loaded.query_count == dataset.query_count
+    pd.testing.assert_frame_equal(
+        loaded.interactions.reset_index(drop=True), dataset.interactions.reset_index(drop=True)
+    )
+
+
+def test_subset(feature_schema, interactions_pandas):
+    item_features = pd.DataFrame({"item_id": [0, 1, 2, 3], "price": [1.0, 2.0, 3.0, 4.0]})
+    schema = feature_schema + FeatureSchema(
+        [FeatureInfo("price", FeatureType.NUMERICAL, None, FeatureSource.ITEM_FEATURES)]
+    )
+    ds = Dataset(feature_schema=schema, interactions=interactions_pandas, item_features=item_features)
+    sub = ds.subset(["rating"])
+    assert "timestamp" not in sub.interactions.columns
+    assert sub.item_features is None
+    assert "price" not in sub.feature_schema
+
+
+def test_to_pandas_noop(dataset):
+    assert dataset.to_pandas() is dataset

@@ -1,0 +1,61 @@
+import pandas as pd
+import pytest
+
+from replay_tpu.data import (
+    Dataset,
+    DatasetLabelEncoder,
+    FeatureHint,
+    FeatureInfo,
+    FeatureSchema,
+    FeatureSource,
+    FeatureType,
+)
+
+
+@pytest.fixture
+def string_dataset():
+    interactions = pd.DataFrame(
+        {
+            "user_id": ["u1", "u1", "u2", "u3"],
+            "item_id": ["i2", "i1", "i2", "i3"],
+            "rating": [1.0, 2.0, 3.0, 4.0],
+        }
+    )
+    item_features = pd.DataFrame({"item_id": ["i1", "i2", "i3", "i4"], "genre": ["g1", "g2", "g1", "g3"]})
+    schema = FeatureSchema(
+        [
+            FeatureInfo("user_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+            FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+            FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+            FeatureInfo("genre", FeatureType.CATEGORICAL, None, FeatureSource.ITEM_FEATURES),
+        ]
+    )
+    return Dataset(feature_schema=schema, interactions=interactions, item_features=item_features)
+
+
+def test_fit_transform(string_dataset):
+    encoder = DatasetLabelEncoder()
+    encoded = encoder.fit_transform(string_dataset)
+    assert encoded.is_categorical_encoded
+    assert encoded.interactions["user_id"].tolist() == [0, 0, 1, 2]
+    assert encoded.interactions["item_id"].tolist() == [0, 1, 0, 2]
+    # item features frame sees ids fitted on interactions first, then extended: i4 -> 3
+    assert encoded.item_features["item_id"].tolist() == [1, 0, 2, 3]
+    assert encoded.item_features["genre"].tolist() == [0, 1, 0, 2]
+
+
+def test_sub_encoders(string_dataset):
+    encoder = DatasetLabelEncoder().fit(string_dataset)
+    q = encoder.query_id_encoder
+    assert q.mapping["user_id"] == {"u1": 0, "u2": 1, "u3": 2}
+    i = encoder.item_id_encoder
+    assert i.mapping["item_id"]["i4"] == 3
+    both = encoder.query_and_item_id_encoder
+    assert set(both.mapping) == {"user_id", "item_id"}
+
+
+def test_get_encoder(string_dataset):
+    encoder = DatasetLabelEncoder().fit(string_dataset)
+    assert encoder.get_encoder(["nope"]) is None
+    sub = encoder.get_encoder(["genre"])
+    assert list(sub.mapping) == ["genre"]

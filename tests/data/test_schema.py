@@ -1,0 +1,102 @@
+import pytest
+
+from replay_tpu.data import FeatureHint, FeatureInfo, FeatureSchema, FeatureSource, FeatureType
+
+
+def make_schema():
+    return FeatureSchema(
+        [
+            FeatureInfo("user_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+            FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+            FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING, FeatureSource.INTERACTIONS),
+            FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP, FeatureSource.INTERACTIONS),
+            FeatureInfo("genres", FeatureType.CATEGORICAL_LIST, None, FeatureSource.ITEM_FEATURES),
+            FeatureInfo("age", FeatureType.NUMERICAL, None, FeatureSource.QUERY_FEATURES),
+        ]
+    )
+
+
+def test_id_columns():
+    schema = make_schema()
+    assert schema.query_id_column == "user_id"
+    assert schema.item_id_column == "item_id"
+    assert schema.interactions_rating_column == "rating"
+    assert schema.interactions_timestamp_column == "timestamp"
+
+
+def test_filter_and_drop():
+    schema = make_schema()
+    cats = schema.categorical_features
+    assert set(cats.columns) == {"user_id", "item_id", "genres"}
+    nums = schema.numerical_features
+    assert set(nums.columns) == {"rating", "timestamp", "age"}
+    dropped = schema.drop(feature_hint=FeatureHint.QUERY_ID)
+    assert "user_id" not in dropped
+    only_item_features = schema.item_features
+    assert only_item_features.columns == ["genres"]
+
+
+def test_interaction_features_excludes_ids():
+    schema = make_schema()
+    inter = schema.interaction_features
+    assert set(inter.columns) == {"rating", "timestamp"}
+
+
+def test_subset_and_item():
+    schema = make_schema()
+    sub = schema.subset(["rating", "nonexistent"])
+    assert sub.columns == ["rating"]
+    assert sub.item().column == "rating"
+    with pytest.raises(ValueError):
+        schema.item()
+
+
+def test_add_and_len():
+    schema = make_schema()
+    extra = FeatureSchema([FeatureInfo("price", FeatureType.NUMERICAL)])
+    combined = schema + extra
+    assert len(combined) == len(schema) + 1
+
+
+def test_duplicate_columns_rejected():
+    with pytest.raises(ValueError, match="Duplicate"):
+        FeatureSchema(
+            [
+                FeatureInfo("x", FeatureType.NUMERICAL),
+                FeatureInfo("x", FeatureType.NUMERICAL),
+            ]
+        )
+
+
+def test_two_item_ids_rejected():
+    with pytest.raises(ValueError, match="ITEM_ID"):
+        FeatureSchema(
+            [
+                FeatureInfo("a", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+                FeatureInfo("b", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+            ]
+        )
+
+
+def test_cardinality_rules():
+    info = FeatureInfo("x", FeatureType.CATEGORICAL, cardinality=5)
+    assert info.cardinality == 5
+    with pytest.raises(ValueError):
+        FeatureInfo("y", FeatureType.NUMERICAL, cardinality=5)
+    num = FeatureInfo("z", FeatureType.NUMERICAL)
+    with pytest.raises(RuntimeError):
+        _ = num.cardinality
+
+
+def test_lazy_cardinality_callback():
+    info = FeatureInfo("x", FeatureType.CATEGORICAL)
+    info._set_cardinality_callback(lambda col: 42)
+    assert info.cardinality == 42
+    info.reset_cardinality()
+    assert info.cardinality == 42
+
+
+def test_copy_resets_cardinality():
+    schema = FeatureSchema([FeatureInfo("x", FeatureType.CATEGORICAL, cardinality=7)])
+    copied = schema.copy()
+    assert copied["x"]._cardinality is None

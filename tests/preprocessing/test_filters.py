@@ -1,0 +1,118 @@
+import pandas as pd
+import pytest
+
+from replay_tpu.preprocessing import (
+    ConsecutiveDuplicatesFilter,
+    EntityDaysFilter,
+    GlobalDaysFilter,
+    InteractionEntriesFilter,
+    LowRatingFilter,
+    MinCountFilter,
+    NumInteractionsFilter,
+    QuantileItemsFilter,
+    TimePeriodFilter,
+)
+
+
+@pytest.fixture
+def interactions():
+    return pd.DataFrame(
+        {
+            "user_id": [1, 1, 1, 2, 2, 2, 3, 3, 3, 3],
+            "item_id": [3, 7, 10, 5, 8, 11, 4, 9, 2, 5],
+            "rating": [1, 2, 3, 3, 2, 1, 3, 12, 1, 4],
+        }
+    )
+
+
+def test_interaction_entries_filter(interactions):
+    out = InteractionEntriesFilter(min_inter_per_user=4).transform(interactions)
+    assert out["user_id"].unique().tolist() == [3]
+    assert len(out) == 4
+
+
+def test_interaction_entries_filter_iterates():
+    df = pd.DataFrame({"user_id": [1, 1, 2], "item_id": [10, 11, 11]})
+    out = InteractionEntriesFilter(min_inter_per_user=2, min_inter_per_item=1).transform(df)
+    assert out["user_id"].tolist() == [1, 1]
+
+
+def test_min_count_filter():
+    df = pd.DataFrame({"user_id": [1, 1, 2]})
+    out = MinCountFilter(2).transform(df)
+    assert out["user_id"].tolist() == [1, 1]
+
+
+def test_low_rating_filter():
+    df = pd.DataFrame({"rating": [1, 5, 3.5, 4]})
+    out = LowRatingFilter(3.5).transform(df)
+    assert out["rating"].tolist() == [5, 3.5, 4]
+
+
+def test_num_interactions_filter():
+    df = pd.DataFrame(
+        {
+            "user_id": ["u1", "u2", "u2", "u3", "u3", "u3"],
+            "item_id": ["i1", "i2", "i3", "i1", "i2", "i3"],
+            "timestamp": [1, 1, 2, 1, 2, 3],
+        }
+    )
+    first = NumInteractionsFilter(1, first=True).transform(df)
+    assert len(first) == 3
+    assert first[first.user_id == "u3"]["timestamp"].tolist() == [1]
+    last = NumInteractionsFilter(1, first=False).transform(df)
+    assert last[last.user_id == "u3"]["timestamp"].tolist() == [3]
+
+
+def test_entity_days_filter():
+    base = pd.Timestamp("2024-01-01")
+    df = pd.DataFrame(
+        {
+            "user_id": [1, 1, 1, 2, 2],
+            "timestamp": [base, base + pd.Timedelta(days=5), base + pd.Timedelta(days=20), base, base],
+        }
+    )
+    out = EntityDaysFilter(days=10, first=True).transform(df)
+    assert len(out) == 4
+
+
+def test_global_days_filter():
+    base = pd.Timestamp("2024-01-01")
+    df = pd.DataFrame({"timestamp": [base, base + pd.Timedelta(days=5), base + pd.Timedelta(days=30)]})
+    out = GlobalDaysFilter(days=10).transform(df)
+    assert len(out) == 2
+    out_last = GlobalDaysFilter(days=10, first=False).transform(df)
+    assert len(out_last) == 1
+
+
+def test_time_period_filter():
+    df = pd.DataFrame({"timestamp": pd.to_datetime(["2024-01-01", "2024-02-01", "2024-03-01"])})
+    out = TimePeriodFilter(start_date="2024-01-15 00:00:00", end_date="2024-02-15 00:00:00").transform(df)
+    assert len(out) == 1
+
+
+def test_quantile_items_filter():
+    df = pd.DataFrame(
+        {
+            "query_id": list(range(20)) + [0, 1, 2, 3],
+            "item_id": [1] * 20 + [2, 2, 3, 3],
+        }
+    )
+    out = QuantileItemsFilter(alpha_quantile=0.5, items_proportion=0.5).transform(df)
+    assert len(out) < len(df)
+    # long-tail items untouched
+    assert (out["item_id"] == 2).sum() == 2
+    assert (out["item_id"] == 3).sum() == 2
+
+
+def test_consecutive_duplicates_filter():
+    df = pd.DataFrame(
+        {
+            "query_id": [1, 1, 1, 1, 2],
+            "item_id": [5, 5, 6, 5, 5],
+            "timestamp": [0, 1, 2, 3, 0],
+        }
+    )
+    out = ConsecutiveDuplicatesFilter().transform(df)
+    assert out[out.query_id == 1]["item_id"].tolist() == [5, 6, 5]
+    assert len(out) == 4

@@ -1,0 +1,118 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.preprocessing import (
+    LabelEncoder,
+    LabelEncoderPartialFitWarning,
+    LabelEncoderTransformWarning,
+    LabelEncodingRule,
+    SequenceEncodingRule,
+)
+
+
+@pytest.fixture
+def df():
+    return pd.DataFrame({"item_id": ["a", "b", "a", "c"], "x": [1, 2, 3, 4]})
+
+
+def test_fit_transform_contiguous(df):
+    rule = LabelEncodingRule("item_id")
+    out = rule.fit(df).transform(df)
+    assert out["item_id"].tolist() == [0, 1, 0, 2]
+    assert rule.get_mapping() == {"a": 0, "b": 1, "c": 2}
+    assert rule.get_inverse_mapping() == {0: "a", 1: "b", 2: "c"}
+
+
+def test_inverse_roundtrip(df):
+    rule = LabelEncodingRule("item_id").fit(df)
+    encoded = rule.transform(df)
+    decoded = rule.inverse_transform(encoded)
+    assert decoded["item_id"].tolist() == df["item_id"].tolist()
+
+
+def test_unknown_error(df):
+    rule = LabelEncodingRule("item_id").fit(df)
+    new = pd.DataFrame({"item_id": ["a", "zzz"]})
+    with pytest.raises(ValueError, match="unknown"):
+        rule.transform(new)
+
+
+def test_unknown_default_value(df):
+    rule = LabelEncodingRule("item_id", handle_unknown="use_default_value", default_value=-1).fit(df)
+    new = pd.DataFrame({"item_id": ["a", "zzz"]})
+    out = rule.transform(new)
+    assert out["item_id"].tolist() == [0, -1]
+
+
+def test_unknown_default_last(df):
+    rule = LabelEncodingRule("item_id", handle_unknown="use_default_value", default_value="last").fit(df)
+    new = pd.DataFrame({"item_id": ["zzz", "b"]})
+    out = rule.transform(new)
+    assert out["item_id"].tolist() == [3, 1]
+
+
+def test_unknown_drop(df):
+    rule = LabelEncodingRule("item_id", handle_unknown="drop").fit(df)
+    new = pd.DataFrame({"item_id": ["zzz", "b"]})
+    out = rule.transform(new)
+    assert out["item_id"].tolist() == [1]
+
+
+def test_drop_to_empty_warns(df):
+    rule = LabelEncodingRule("item_id", handle_unknown="drop").fit(df)
+    new = pd.DataFrame({"item_id": ["zzz", "yyy"]})
+    with pytest.warns(LabelEncoderTransformWarning):
+        out = rule.transform(new)
+    assert out.empty
+
+
+def test_partial_fit_extends(df):
+    rule = LabelEncodingRule("item_id").fit(df)
+    rule.partial_fit(pd.DataFrame({"item_id": ["c", "d"]}))
+    assert rule.get_mapping()["d"] == 3
+    assert rule.get_mapping()["c"] == 2
+
+
+def test_partial_fit_before_fit_warns(df):
+    rule = LabelEncodingRule("item_id")
+    with pytest.warns(LabelEncoderPartialFitWarning):
+        rule.partial_fit(df)
+    assert rule.is_fitted
+
+
+def test_sequence_rule():
+    df = pd.DataFrame({"genres": [["a", "b"], ["b", "c"], ["a"]]})
+    rule = SequenceEncodingRule("genres").fit(df)
+    out = rule.transform(df)
+    assert out["genres"].iloc[0].tolist() == [0, 1]
+    assert out["genres"].iloc[1].tolist() == [1, 2]
+    decoded = rule.inverse_transform(out)
+    assert decoded["genres"].iloc[1].tolist() == ["b", "c"]
+
+
+def test_sequence_rule_unknown_drop():
+    df = pd.DataFrame({"genres": [["a", "b"]]})
+    rule = SequenceEncodingRule("genres", handle_unknown="drop").fit(df)
+    out = rule.transform(pd.DataFrame({"genres": [["a", "zzz"]]}))
+    assert out["genres"].iloc[0].tolist() == [0]
+
+
+def test_label_encoder_composition(df):
+    df2 = df.assign(user_id=["u1", "u2", "u1", "u3"])
+    encoder = LabelEncoder([LabelEncodingRule("item_id"), LabelEncodingRule("user_id")])
+    out = encoder.fit_transform(df2)
+    assert out["user_id"].tolist() == [0, 1, 0, 2]
+    assert set(encoder.mapping.keys()) == {"item_id", "user_id"}
+    back = encoder.inverse_transform(out)
+    assert back["user_id"].tolist() == df2["user_id"].tolist()
+
+
+def test_set_strategies(df):
+    encoder = LabelEncoder([LabelEncodingRule("item_id")]).fit(df)
+    encoder.set_handle_unknowns({"item_id": "use_default_value"})
+    encoder.set_default_values({"item_id": -1})
+    out = encoder.transform(pd.DataFrame({"item_id": ["zzz"]}))
+    assert out["item_id"].tolist() == [-1]
+    with pytest.raises(ValueError):
+        encoder.set_default_values({"nope": 1})

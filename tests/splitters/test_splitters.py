@@ -1,0 +1,151 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.splitters import (
+    ColdUserRandomSplitter,
+    KFolds,
+    LastNSplitter,
+    NewUsersSplitter,
+    RandomNextNSplitter,
+    RandomSplitter,
+    RatioSplitter,
+    TimeSplitter,
+    TwoStageSplitter,
+)
+
+
+@pytest.fixture
+def interactions():
+    return pd.DataFrame(
+        {
+            "query_id": [1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3],
+            "item_id": [1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4],
+            "timestamp": [0, 1, 2, 3, 0, 1, 2, 3, 0, 1, 2, 3],
+        }
+    )
+
+
+def test_ratio_splitter(interactions):
+    train, test = RatioSplitter(test_size=0.5).split(interactions)
+    assert len(train) == 6 and len(test) == 6
+    for q in (1, 2, 3):
+        assert sorted(test[test.query_id == q]["timestamp"]) == [2, 3]
+
+
+def test_ratio_splitter_quantity(interactions):
+    train, test = RatioSplitter(test_size=0.25, split_by_fractions=False).split(interactions)
+    assert len(test) == 3
+    assert (test.groupby("query_id").size() == 1).all()
+
+
+def test_ratio_min_interactions(interactions):
+    small = interactions[interactions.query_id != 1]
+    train, test = RatioSplitter(test_size=0.5, min_interactions_per_group=5).split(small)
+    assert len(test) == 0
+
+
+def test_time_splitter(interactions):
+    train, test = TimeSplitter(time_threshold=2).split(interactions)
+    assert set(train["timestamp"]) == {0, 1}
+    assert set(test["timestamp"]) == {2, 3}
+
+
+def test_time_splitter_ratio(interactions):
+    train, test = TimeSplitter(time_threshold=0.25).split(interactions)
+    assert set(test["timestamp"]) == {3}
+
+
+def test_last_n_splitter(interactions):
+    train, test = LastNSplitter(N=2, divide_column="query_id").split(interactions)
+    assert len(test) == 6
+    assert set(test["timestamp"]) == {2, 3}
+
+
+def test_last_n_timedelta(interactions):
+    train, test = LastNSplitter(N=2, strategy="timedelta").split(interactions)
+    assert set(test["timestamp"]) == {2, 3}
+
+
+def test_random_splitter(interactions):
+    train, test = RandomSplitter(test_size=0.25, seed=0).split(interactions)
+    assert len(train) + len(test) == len(interactions)
+    assert len(test) == 3
+
+
+def test_cold_user_splitter(interactions):
+    train, test = ColdUserRandomSplitter(test_size=0.34, seed=0).split(interactions)
+    test_users = set(test.query_id)
+    assert test_users.isdisjoint(set(train.query_id))
+    assert len(test_users) == 1
+
+
+def test_new_users_splitter():
+    df = pd.DataFrame(
+        {
+            "query_id": [1, 1, 2, 2, 3, 3],
+            "item_id": [1, 2, 1, 2, 1, 2],
+            "timestamp": [0, 5, 1, 6, 4, 7],
+        }
+    )
+    # ceil(0.34 * 3) = 2 newest users go to test (reference cumulative semantics)
+    train, test = NewUsersSplitter(test_size=0.34).split(df)
+    assert set(test.query_id) == {2, 3}
+    # train only keeps rows strictly before the first new user's arrival
+    assert train["timestamp"].max() < 1
+    train, test = NewUsersSplitter(test_size=0.1).split(df)
+    assert set(test.query_id) == {3}
+
+
+def test_random_next_n_splitter(interactions):
+    train, test = RandomNextNSplitter(N=1, seed=0).split(interactions)
+    assert (test.groupby("query_id").size() <= 1).all()
+    assert len(train) + len(test) <= len(interactions)
+
+
+def test_two_stage_splitter(interactions):
+    train, test = TwoStageSplitter(first_divide_size=1, second_divide_size=0.5, seed=3).split(interactions)
+    assert len(set(test.query_id)) == 1
+    assert len(test) == 2
+
+
+def test_kfolds(interactions):
+    folds = list(KFolds(n_folds=2, seed=0).split(interactions))
+    assert len(folds) == 2
+    for train, test in folds:
+        assert len(train) + len(test) == len(interactions)
+
+
+def test_drop_cold_items(interactions):
+    df = interactions.copy()
+    # make item 4 occur only in the test tail
+    train, test = LastNSplitter(N=1, drop_cold_items=True).split(df)
+    assert set(test.item_id).issubset(set(train.item_id))
+
+
+def test_session_recovery():
+    df = pd.DataFrame(
+        {
+            "query_id": [1, 1, 1, 1],
+            "item_id": [1, 2, 3, 4],
+            "timestamp": [0, 1, 2, 3],
+            "session_id": [7, 7, 7, 8],
+        }
+    )
+    train, test = LastNSplitter(N=2, session_id_column="session_id").split(df)
+    # session 7 straddles the boundary -> moved wholly to test by default
+    assert len(test) == 4
+    train, test = LastNSplitter(
+        N=2, session_id_column="session_id", session_id_processing_strategy="train"
+    ).split(df)
+    assert sorted(test["item_id"]) == [4]
+
+
+def test_save_load(tmp_path, interactions):
+    splitter = RatioSplitter(test_size=0.5)
+    splitter.save(str(tmp_path / "sp"))
+    loaded = RatioSplitter.load(str(tmp_path / "sp"))
+    assert loaded.test_size == 0.5
+    t1, v1 = splitter.split(interactions)
+    t2, v2 = loaded.split(interactions)
+    pd.testing.assert_frame_equal(t1, t2)
