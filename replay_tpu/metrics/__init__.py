@@ -1,0 +1,32 @@
+from .base import Metric, MetricDuplicatesWarning
+from .beyond_accuracy import CategoricalDiversity, Coverage, Novelty, Surprisal, Unexpectedness
+from .builder import MetricsBuilder, metrics_to_df
+from .descriptors import CalculationDescriptor, ConfidenceInterval, Mean, Median, PerUser
+from .offline_metrics import Experiment, OfflineMetrics
+from .ranking import MAP, MRR, NDCG, HitRate, Precision, Recall, RocAuc
+
+__all__ = [
+    "MAP",
+    "MRR",
+    "NDCG",
+    "CalculationDescriptor",
+    "CategoricalDiversity",
+    "ConfidenceInterval",
+    "Coverage",
+    "Experiment",
+    "HitRate",
+    "Mean",
+    "Median",
+    "Metric",
+    "MetricDuplicatesWarning",
+    "MetricsBuilder",
+    "Novelty",
+    "OfflineMetrics",
+    "PerUser",
+    "Precision",
+    "Recall",
+    "RocAuc",
+    "Surprisal",
+    "Unexpectedness",
+    "metrics_to_df",
+]

@@ -1,0 +1,134 @@
+"""Metric base class: input normalization + per-user evaluation + aggregation.
+
+Capability parity with the reference Metric (replay/metrics/base_metric.py:34-330):
+accepts pandas frames or dicts (``{query: [item, ...]}`` / ``{query: [(item, score), ...]}``),
+warns on duplicate (query, item) recommendation pairs, evaluates a per-user vector over
+the sorted topk list, and reduces with a :class:`CalculationDescriptor`. Results are
+keyed ``"<Name>@<k>"`` (descriptor suffix when not Mean). Polars/Spark frames are
+accepted when those engines are installed by converting to pandas at the boundary.
+"""
+
+from __future__ import annotations
+
+import warnings
+from abc import ABC, abstractmethod
+from typing import Dict, List, Union
+
+import numpy as np
+import pandas as pd
+
+from .descriptors import CalculationDescriptor, Mean
+
+MetricsDataFrameLike = Union[pd.DataFrame, dict]
+MetricsReturnType = Dict[str, float]
+
+
+class MetricDuplicatesWarning(Warning):
+    """The recommendations contain duplicate (query, item) pairs."""
+
+
+def _normalize(value):
+    if isinstance(value, np.generic):
+        return value.item()
+    return value
+
+
+class Metric(ABC):
+    """Base class of offline recommendation metrics."""
+
+    def __init__(
+        self,
+        topk: Union[List[int], int],
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+        rating_column: str = "rating",
+        mode: CalculationDescriptor = None,
+    ) -> None:
+        if isinstance(topk, int):
+            topk = [topk]
+        if not isinstance(topk, list) or not all(isinstance(k, int) for k in topk):
+            msg = "topk must be an int or a list of ints"
+            raise ValueError(msg)
+        self.topk = sorted(topk)
+        self.query_column = query_column
+        self.item_column = item_column
+        self.rating_column = rating_column
+        self._mode = mode if mode is not None else Mean()
+
+    @property
+    def __name__(self) -> str:
+        suffix = self._mode.__name__
+        return type(self).__name__ + (f"-{suffix}" if suffix != "Mean" else "")
+
+    # -- input normalization ----------------------------------------------
+    def _to_frame(self, data):
+        """Convert optional-engine frames to pandas at the boundary."""
+        if isinstance(data, (pd.DataFrame, dict)):
+            return data
+        if hasattr(data, "to_pandas"):  # pragma: no cover - polars
+            return data.to_pandas()
+        if hasattr(data, "toPandas"):  # pragma: no cover - spark
+            return data.toPandas()
+        msg = f"Unsupported metric input type: {type(data)}"
+        raise TypeError(msg)
+
+    def _recs_to_dict(self, recommendations) -> dict:
+        """Per-query item lists sorted by score descending."""
+        recommendations = self._to_frame(recommendations)
+        if isinstance(recommendations, dict):
+            out = {}
+            for query, items in recommendations.items():
+                if items and isinstance(items[0], tuple):
+                    items = [item for item, _score in sorted(items, key=lambda x: x[1], reverse=True)]
+                out[query] = list(items)
+            return out
+        ordered = recommendations.sort_values(
+            by=[self.rating_column, self.item_column], ascending=False, kind="stable"
+        )
+        return ordered.groupby(self.query_column)[self.item_column].apply(list).to_dict()
+
+    def _gt_to_dict(self, ground_truth) -> dict:
+        ground_truth = self._to_frame(ground_truth)
+        if isinstance(ground_truth, dict):
+            return {q: list(items) for q, items in ground_truth.items()}
+        return ground_truth.groupby(self.query_column)[self.item_column].apply(list).to_dict()
+
+    def _warn_duplicates(self, recommendations: dict) -> None:
+        for items in recommendations.values():
+            if len(items) != len(set(items)):
+                warnings.warn(
+                    "The recommendations contain duplicated items per query; "
+                    "metric values may be inflated.",
+                    MetricDuplicatesWarning,
+                    stacklevel=3,
+                )
+                return
+
+    # -- evaluation --------------------------------------------------------
+    def __call__(self, recommendations, ground_truth) -> MetricsReturnType:
+        recs = self._recs_to_dict(recommendations)
+        self._warn_duplicates(recs)
+        gt = self._gt_to_dict(ground_truth)
+        return self._evaluate(gt, recs)
+
+    def _evaluate(self, keyed_by: dict, recs: dict, *extra_dicts: dict) -> MetricsReturnType:
+        """Evaluate per user over ``keyed_by``'s keys and aggregate."""
+        per_user: dict = {}
+        for user in keyed_by:
+            args = [d.get(user) for d in (keyed_by, recs, *extra_dicts)]
+            per_user[user] = self._user_metric(self.topk, *args)
+        if self._mode.__name__ == "PerUser":
+            return {
+                f"{self.__name__}@{k}": {u: vals[i] for u, vals in per_user.items()}
+                for i, k in enumerate(self.topk)
+            }
+        distribution = np.array(list(per_user.values()), dtype=np.float64).reshape(-1, len(self.topk))
+        return {
+            f"{self.__name__}@{k}": _normalize(self._mode.cpu(distribution[:, i]))
+            for i, k in enumerate(self.topk)
+        }
+
+    @staticmethod
+    @abstractmethod
+    def _user_metric(ks: List[int], *args) -> List[float]:
+        """Per-user metric values, one per k."""

@@ -1,0 +1,122 @@
+"""Batch computation of a metric battery + model-comparison Experiment.
+
+Capability parity with replay/metrics/offline_metrics.py:12 and experiment.py:7:
+``OfflineMetrics`` dispatches each metric to the arguments it needs (ground_truth /
+train / base_recommendations, with named multi-baseline support for Unexpectedness);
+``Experiment`` accumulates per-model result rows into a pandas comparison frame.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import pandas as pd
+
+from .base import Metric, MetricsDataFrameLike
+from .beyond_accuracy import CategoricalDiversity, Coverage, Novelty, Surprisal, Unexpectedness
+
+
+class OfflineMetrics:
+    """Compute several metrics over one set of recommendations efficiently."""
+
+    def __init__(
+        self,
+        metrics: List[Metric],
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+        rating_column: str = "rating",
+        category_column: str = "category_id",
+    ) -> None:
+        self.metrics = metrics
+        self.query_column = query_column
+        self.item_column = item_column
+        self.rating_column = rating_column
+        self.category_column = category_column
+
+    def __call__(
+        self,
+        recommendations: MetricsDataFrameLike,
+        ground_truth: MetricsDataFrameLike,
+        train: Optional[MetricsDataFrameLike] = None,
+        base_recommendations: Union[MetricsDataFrameLike, Dict[str, MetricsDataFrameLike], None] = None,
+    ) -> Dict[str, float]:
+        results: Dict[str, float] = {}
+        named_bases: Optional[Dict[str, MetricsDataFrameLike]] = None
+        if base_recommendations is not None:
+            if not isinstance(base_recommendations, dict) or (
+                base_recommendations and isinstance(next(iter(base_recommendations.values())), list)
+            ):
+                named_bases = {"base_recommendations": base_recommendations}
+            else:
+                named_bases = dict(base_recommendations)
+
+        for metric in self.metrics:
+            if isinstance(metric, (Novelty, Surprisal, Coverage)):
+                if train is None:
+                    msg = f"{metric.__name__} requires `train`."
+                    raise ValueError(msg)
+                results.update(metric(recommendations, train))
+            elif isinstance(metric, Unexpectedness):
+                if named_bases is None:
+                    msg = "Unexpectedness requires `base_recommendations`."
+                    raise ValueError(msg)
+                for name, base in named_bases.items():
+                    values = metric(recommendations, base)
+                    if len(named_bases) == 1 and name == "base_recommendations":
+                        results.update(values)
+                    else:
+                        # reference naming: "Unexpectedness_<model>@k"
+                        results.update(
+                            {key.replace("@", f"_{name}@", 1): value for key, value in values.items()}
+                        )
+            elif isinstance(metric, CategoricalDiversity):
+                results.update(metric(recommendations))
+            else:
+                results.update(metric(recommendations, ground_truth))
+        return results
+
+
+class Experiment:
+    """Accumulate metric rows from several models into one comparison DataFrame."""
+
+    def __init__(
+        self,
+        metrics: List[Metric],
+        ground_truth: MetricsDataFrameLike,
+        train: Optional[MetricsDataFrameLike] = None,
+        base_recommendations: Union[MetricsDataFrameLike, Dict[str, MetricsDataFrameLike], None] = None,
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+        rating_column: str = "rating",
+        category_column: str = "category_id",
+    ) -> None:
+        self.ground_truth = ground_truth
+        self.train = train
+        self.base_recommendations = base_recommendations
+        self._offline = OfflineMetrics(
+            metrics,
+            query_column=query_column,
+            item_column=item_column,
+            rating_column=rating_column,
+            category_column=category_column,
+        )
+        self.results = pd.DataFrame()
+
+    def add_result(self, name: str, recommendations: MetricsDataFrameLike) -> None:
+        """Evaluate ``recommendations`` and store the row under ``name``."""
+        values = self._offline(
+            recommendations,
+            self.ground_truth,
+            train=self.train,
+            base_recommendations=self.base_recommendations,
+        )
+        row = pd.DataFrame(values, index=[name])
+        self.results = pd.concat([self.results[~self.results.index.isin([name])], row])
+
+    def compare(self, baseline: str) -> pd.DataFrame:
+        """Relative change of every row against the named baseline row."""
+        if baseline not in self.results.index:
+            msg = f"No results stored for baseline '{baseline}'."
+            raise KeyError(msg)
+        base_row = self.results.loc[baseline]
+        return (self.results - base_row) / base_row

@@ -1,0 +1,132 @@
+"""Ranking accuracy metrics.
+
+Capability parity with the reference set (replay/metrics/hitrate.py … rocauc.py):
+HitRate, Precision, Recall, MAP, MRR, NDCG, RocAuc — identical per-user math.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+from .base import Metric
+
+
+class HitRate(Metric):
+    """1 if any of the top-k recommendations is relevant."""
+
+    @staticmethod
+    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
+        if not ground_truth or not pred:
+            return [0.0] * len(ks)
+        gt = set(ground_truth)
+        return [1.0 if any(item in gt for item in pred[:k]) else 0.0 for k in ks]
+
+
+class Precision(Metric):
+    """Fraction of the top-k recommendations that are relevant."""
+
+    @staticmethod
+    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
+        if not ground_truth or not pred:
+            return [0.0] * len(ks)
+        gt = set(ground_truth)
+        return [len(set(pred[:k]) & gt) / k for k in ks]
+
+
+class Recall(Metric):
+    """Fraction of the relevant items captured in the top-k recommendations."""
+
+    @staticmethod
+    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
+        if not ground_truth or not pred:
+            return [0.0] * len(ks)
+        gt = set(ground_truth)
+        return [len(set(pred[:k]) & gt) / len(gt) for k in ks]
+
+
+class MAP(Metric):
+    """Mean average precision at k."""
+
+    @staticmethod
+    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
+        if not ground_truth or not pred:
+            return [0.0] * len(ks)
+        gt = set(ground_truth)
+        out = []
+        for k in ks:
+            length = min(k, len(pred))
+            max_good = min(k, len(ground_truth))
+            hits = 0
+            total = 0.0
+            for i in range(length):
+                if pred[i] in gt:
+                    hits += 1
+                    total += hits / (i + 1)
+            out.append(total / max_good)
+        return out
+
+
+class MRR(Metric):
+    """Reciprocal rank of the first relevant recommendation."""
+
+    @staticmethod
+    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
+        if not ground_truth or not pred:
+            return [0.0] * len(ks)
+        gt = set(ground_truth)
+        out = []
+        for k in ks:
+            value = 0.0
+            for rank, item in enumerate(pred[:k]):
+                if item in gt:
+                    value = 1.0 / (rank + 1)
+                    break
+            out.append(value)
+        return out
+
+
+class NDCG(Metric):
+    """Normalized discounted cumulative gain at k."""
+
+    @staticmethod
+    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
+        if not ground_truth or not pred:
+            return [0.0] * len(ks)
+        gt = set(ground_truth)
+        out = []
+        for k in ks:
+            pred_len = min(k, len(pred))
+            gt_len = min(k, len(ground_truth))
+            discount = [1.0 / math.log2(i + 2) for i in range(k)]
+            dcg = sum(discount[i] for i in range(pred_len) if pred[i] in gt)
+            idcg = sum(discount[:gt_len])
+            out.append(dcg / idcg)
+        return out
+
+
+class RocAuc(Metric):
+    """AUC of relevant-vs-irrelevant ordering within the top-k list."""
+
+    @staticmethod
+    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
+        if not ground_truth or not pred:
+            return [0.0] * len(ks)
+        gt = set(ground_truth)
+        out = []
+        for k in ks:
+            length = min(k, len(pred))
+            fp_cur = 0
+            fp_cum = 0
+            for item in pred[:length]:
+                if item in gt:
+                    fp_cum += fp_cur
+                else:
+                    fp_cur += 1
+            if fp_cur == length:
+                out.append(0.0)
+            elif fp_cum == 0:
+                out.append(1.0)
+            else:
+                out.append(1 - fp_cum / (fp_cur * (length - fp_cur)))
+        return out

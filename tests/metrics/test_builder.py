@@ -1,0 +1,95 @@
+"""Cross-check the on-device MetricsBuilder against the dataframe metric battery."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.metrics import MAP, MRR, NDCG, HitRate, MetricsBuilder, Novelty, Precision, Recall, metrics_to_df
+
+
+@pytest.fixture
+def batch(rng):
+    n_users, n_items, k, gt_max, train_max = 32, 100, 10, 7, 12
+    preds = np.stack([rng.choice(n_items, size=k, replace=False) for _ in range(n_users)])
+    gt = np.full((n_users, gt_max), -1, dtype=np.int64)
+    train = np.full((n_users, train_max), -2, dtype=np.int64)
+    for u in range(n_users):
+        n_gt = rng.integers(1, gt_max + 1)
+        gt[u, :n_gt] = rng.choice(n_items, size=n_gt, replace=False)
+        n_tr = rng.integers(1, train_max + 1)
+        train[u, :n_tr] = rng.choice(n_items, size=n_tr, replace=False)
+    return preds, gt, train
+
+
+def _frames(preds, gt, train):
+    rows = [
+        {"query_id": u, "item_id": int(item), "rating": float(preds.shape[1] - i)}
+        for u in range(preds.shape[0])
+        for i, item in enumerate(preds[u])
+    ]
+    recs = pd.DataFrame(rows)
+    gt_df = pd.DataFrame(
+        [{"query_id": u, "item_id": int(i)} for u in range(gt.shape[0]) for i in gt[u] if i >= 0]
+    )
+    train_df = pd.DataFrame(
+        [{"query_id": u, "item_id": int(i)} for u in range(train.shape[0]) for i in train[u] if i >= 0]
+    )
+    return recs, gt_df, train_df
+
+
+def test_builder_matches_dataframe_metrics(batch):
+    preds, gt, train = batch
+    recs, gt_df, train_df = _frames(preds, gt, train)
+    ks = [1, 5, 10]
+
+    builder = MetricsBuilder(
+        metrics=["recall", "precision", "ndcg", "map", "mrr", "hitrate", "novelty", "coverage"],
+        top_k=ks,
+        item_count=100,
+    )
+    builder.add_prediction(preds, gt, train)
+    device_metrics = builder.get_metrics()
+
+    for k in ks:
+        assert device_metrics[f"recall@{k}"] == pytest.approx(Recall(k)(recs, gt_df)[f"Recall@{k}"], abs=1e-5)
+        assert device_metrics[f"precision@{k}"] == pytest.approx(
+            Precision(k)(recs, gt_df)[f"Precision@{k}"], abs=1e-5
+        )
+        assert device_metrics[f"ndcg@{k}"] == pytest.approx(NDCG(k)(recs, gt_df)[f"NDCG@{k}"], abs=1e-5)
+        assert device_metrics[f"map@{k}"] == pytest.approx(MAP(k)(recs, gt_df)[f"MAP@{k}"], abs=1e-5)
+        assert device_metrics[f"mrr@{k}"] == pytest.approx(MRR(k)(recs, gt_df)[f"MRR@{k}"], abs=1e-5)
+        assert device_metrics[f"hitrate@{k}"] == pytest.approx(HitRate(k)(recs, gt_df)[f"HitRate@{k}"], abs=1e-5)
+        assert device_metrics[f"novelty@{k}"] == pytest.approx(Novelty(k)(recs, train_df)[f"Novelty@{k}"], abs=1e-5)
+
+
+def test_builder_accumulates_batches(batch):
+    preds, gt, train = batch
+    one_shot = MetricsBuilder(metrics=["ndcg", "recall"], top_k=[5])
+    one_shot.add_prediction(preds, gt, train)
+    split = MetricsBuilder(metrics=["ndcg", "recall"], top_k=[5])
+    split.add_prediction(preds[:16], gt[:16], train[:16])
+    split.add_prediction(preds[16:], gt[16:], train[16:])
+    for key in one_shot.get_metrics():
+        assert one_shot.get_metrics()[key] == pytest.approx(split.get_metrics()[key], abs=1e-6)
+
+
+def test_builder_reset(batch):
+    preds, gt, train = batch
+    builder = MetricsBuilder(metrics=["recall"], top_k=[5])
+    builder.add_prediction(preds, gt)
+    builder.reset()
+    assert builder.get_metrics() == {}
+
+
+def test_builder_coverage_requires_item_count():
+    with pytest.raises(ValueError, match="item_count"):
+        MetricsBuilder(metrics=["coverage"])
+
+
+def test_metrics_to_df(batch):
+    preds, gt, train = batch
+    builder = MetricsBuilder(metrics=["recall", "ndcg"], top_k=[1, 5])
+    builder.add_prediction(preds, gt)
+    frame = metrics_to_df(builder.get_metrics())
+    assert frame.shape == (2, 2)
+    assert list(frame.columns) == ["@1", "@5"]

@@ -1,0 +1,165 @@
+"""Golden-value tests: numbers come from the reference implementation's doctests
+(replay/metrics/*.py docstrings evaluated on the replay/conftest.py fixtures)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.metrics import (
+    MAP,
+    MRR,
+    NDCG,
+    CategoricalDiversity,
+    ConfidenceInterval,
+    Coverage,
+    Experiment,
+    HitRate,
+    Median,
+    MetricDuplicatesWarning,
+    Novelty,
+    OfflineMetrics,
+    PerUser,
+    Precision,
+    Recall,
+    RocAuc,
+    Surprisal,
+    Unexpectedness,
+)
+
+RECS = pd.DataFrame(
+    [
+        (1, 3, 0.6), (1, 7, 0.5), (1, 10, 0.4), (1, 11, 0.3), (1, 2, 0.2),
+        (2, 5, 0.6), (2, 8, 0.5), (2, 11, 0.4), (2, 1, 0.3), (2, 3, 0.2),
+        (3, 4, 1.0), (3, 9, 0.5), (3, 2, 0.1),
+    ],
+    columns=["query_id", "item_id", "rating"],
+)
+GT = pd.DataFrame(
+    [
+        (1, 5), (1, 6), (1, 7), (1, 8), (1, 9), (1, 10),
+        (2, 6), (2, 7), (2, 4), (2, 10), (2, 11),
+        (3, 1), (3, 2), (3, 3), (3, 4), (3, 5),
+    ],
+    columns=["query_id", "item_id"],
+)
+TRAIN = pd.DataFrame(
+    [
+        (1, 5), (1, 6), (1, 8), (1, 9), (1, 2),
+        (2, 5), (2, 8), (2, 11), (2, 1), (2, 3),
+        (3, 4), (3, 9), (3, 2),
+    ],
+    columns=["query_id", "item_id"],
+)
+BASE_RECS = pd.DataFrame(
+    [
+        (1, 3, 0.5), (1, 7, 0.5), (1, 2, 0.7),
+        (2, 5, 0.6), (2, 8, 0.6), (2, 3, 0.3),
+        (3, 4, 1.0), (3, 9, 0.5),
+    ],
+    columns=["query_id", "item_id", "rating"],
+)
+
+
+def test_ndcg_golden():
+    assert NDCG(2)(RECS, GT) == pytest.approx({"NDCG@2": 0.3333333333333333})
+    per_user = NDCG(2, mode=PerUser())(RECS, GT)["NDCG-PerUser@2"]
+    assert per_user[1] == pytest.approx(0.38685280723454163)
+    assert per_user[2] == 0.0
+    assert per_user[3] == pytest.approx(0.6131471927654584)
+    assert NDCG(2, mode=Median())(RECS, GT)["NDCG-Median@2"] == pytest.approx(0.38685280723454163)
+    assert NDCG(2, mode=ConfidenceInterval(0.95))(RECS, GT)["NDCG-ConfidenceInterval@2"] == pytest.approx(
+        0.3508565839953337
+    )
+
+
+def test_map_golden():
+    assert MAP(2)(RECS, GT) == pytest.approx({"MAP@2": 0.25})
+    assert MAP(2, mode=PerUser())(RECS, GT)["MAP-PerUser@2"] == pytest.approx({1: 0.25, 2: 0.0, 3: 0.5})
+    assert MAP(2, mode=ConfidenceInterval(0.95))(RECS, GT)["MAP-ConfidenceInterval@2"] == pytest.approx(
+        0.282896433519043
+    )
+
+
+def test_coverage_golden():
+    assert Coverage(2)(RECS, TRAIN) == pytest.approx({"Coverage@2": 0.5555555555555556})
+
+
+def test_surprisal_golden():
+    assert Surprisal(2)(RECS, TRAIN) == pytest.approx({"Surprisal@2": 0.6845351232142715})
+    per_user = Surprisal(2, mode=PerUser())(RECS, TRAIN)["Surprisal-PerUser@2"]
+    assert per_user == pytest.approx({1: 1.0, 2: 0.3690702464285426, 3: 0.6845351232142713})
+
+
+def test_novelty_golden():
+    assert Novelty(2)(RECS, TRAIN) == pytest.approx({"Novelty@2": 0.3333333333333333})
+    assert Novelty(2, mode=PerUser())(RECS, TRAIN)["Novelty-PerUser@2"] == pytest.approx({1: 1.0, 2: 0.0, 3: 0.0})
+
+
+def test_categorical_diversity_golden():
+    cat_recs = RECS.rename(columns={"item_id": "category_id"})
+    out = CategoricalDiversity([3, 5])(cat_recs)
+    assert out == pytest.approx({"CategoricalDiversity@3": 1.0, "CategoricalDiversity@5": 0.8666666666666667})
+
+
+def test_unexpectedness_golden():
+    out = Unexpectedness([1, 2])(RECS, BASE_RECS)
+    assert out == pytest.approx({"Unexpectedness@1": 0.6666666666666666, "Unexpectedness@2": 0.16666666666666666})
+
+
+def test_hitrate_precision_recall_mrr():
+    assert HitRate(2)(RECS, GT)["HitRate@2"] == pytest.approx(2 / 3)
+    assert Precision(2)(RECS, GT)["Precision@2"] == pytest.approx(1 / 3)
+    # user1: {7}; user2: {}; user3: {4} of gt sizes 6, 5, 5
+    assert Recall(2)(RECS, GT)["Recall@2"] == pytest.approx((1 / 6 + 0 + 1 / 5) / 3)
+    assert MRR(2)(RECS, GT)["MRR@2"] == pytest.approx((1 / 2 + 0 + 1) / 3)
+
+
+def test_rocauc():
+    out = RocAuc(5)(RECS, GT)["RocAuc@5"]
+    assert 0.0 <= out <= 1.0
+
+
+def test_dict_inputs():
+    recs_dict = {
+        q: list(zip(df.sort_values("rating", ascending=False)["item_id"], df.sort_values("rating", ascending=False)["rating"]))
+        for q, df in RECS.groupby("query_id")
+    }
+    gt_dict = {q: df["item_id"].tolist() for q, df in GT.groupby("query_id")}
+    assert NDCG(2)(recs_dict, gt_dict)["NDCG@2"] == pytest.approx(0.3333333333333333)
+
+
+def test_duplicates_warn():
+    dup = pd.concat([RECS, RECS.iloc[:1]])
+    with pytest.warns(MetricDuplicatesWarning):
+        NDCG(2)(dup, GT)
+
+
+def test_offline_metrics_battery():
+    metrics = [Precision(2), NDCG(2), Coverage(2), Novelty(2)]
+    out = OfflineMetrics(metrics)(RECS, GT, train=TRAIN)
+    assert out["Precision@2"] == pytest.approx(1 / 3)
+    assert out["Coverage@2"] == pytest.approx(0.5555555555555556)
+
+
+def test_offline_metrics_named_bases():
+    out = OfflineMetrics([Precision(2), Unexpectedness([1, 2])])(
+        RECS, GT, base_recommendations={"ALS": BASE_RECS, "KNN": RECS}
+    )
+    assert out["Unexpectedness_ALS@1"] == pytest.approx(0.6666666666666666)
+    assert out["Unexpectedness_KNN@1"] == 0.0
+    assert out["Precision@2"] == pytest.approx(1 / 3)
+
+
+def test_offline_metrics_requires_train():
+    with pytest.raises(ValueError, match="train"):
+        OfflineMetrics([Coverage(2)])(RECS, GT)
+
+
+def test_experiment():
+    exp = Experiment([NDCG(2), HitRate(2)], GT)
+    exp.add_result("modelA", RECS)
+    exp.add_result("modelB", BASE_RECS)
+    assert exp.results.shape == (2, 2)
+    assert exp.results.loc["modelA", "NDCG@2"] == pytest.approx(0.3333333333333333)
+    cmp = exp.compare("modelA")
+    assert cmp.loc["modelA"].tolist() == [0.0, 0.0]
