@@ -1,0 +1,41 @@
+from . import loss
+from .agg import ConcatAggregator, PositionAwareAggregator, SumAggregator
+from .attention import MultiHeadAttention, MultiHeadDifferentialAttention, RMSNorm
+from .embedding import (
+    CategoricalEmbedding,
+    CategoricalListEmbedding,
+    IdentityEmbedding,
+    NumericalEmbedding,
+    SequenceEmbedding,
+)
+from .ffn import PointWiseFeedForward, SwiGLU, SwiGLUEncoder
+from .head import EmbeddingTyingHead
+from .mask import (
+    DefaultAttentionMask,
+    bidirectional_attention_mask,
+    causal_attention_mask,
+    padding_mask_from_ids,
+)
+
+__all__ = [
+    "CategoricalEmbedding",
+    "CategoricalListEmbedding",
+    "ConcatAggregator",
+    "DefaultAttentionMask",
+    "EmbeddingTyingHead",
+    "IdentityEmbedding",
+    "MultiHeadAttention",
+    "MultiHeadDifferentialAttention",
+    "NumericalEmbedding",
+    "PointWiseFeedForward",
+    "PositionAwareAggregator",
+    "RMSNorm",
+    "SequenceEmbedding",
+    "SumAggregator",
+    "SwiGLU",
+    "SwiGLUEncoder",
+    "bidirectional_attention_mask",
+    "causal_attention_mask",
+    "loss",
+    "padding_mask_from_ids",
+]

@@ -1,0 +1,77 @@
+"""Aggregators: merge the per-feature embedding dict into one [B, L, E] tensor.
+
+Capability parity with replay/nn/agg.py:23-162 and
+replay/nn/sequential/sasrec/agg.py:9-60: SumAggregator, ConcatAggregator (sorted-key
+concat + projection for determinism), PositionAwareAggregator (scale by sqrt(d), add a
+learned positional table, dropout — the SASRec input block).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from replay_tpu.data.nn.schema import TensorMap
+
+
+class SumAggregator(nn.Module):
+    """Elementwise sum of all feature embeddings (they must share a dim)."""
+
+    @nn.compact
+    def __call__(self, embeddings: TensorMap) -> jnp.ndarray:
+        arrays = [embeddings[name] for name in sorted(embeddings)]
+        dims = {a.shape[-1] for a in arrays}
+        if len(dims) != 1:
+            msg = f"SumAggregator requires equal embedding dims, got {sorted(dims)}"
+            raise ValueError(msg)
+        total = arrays[0]
+        for a in arrays[1:]:
+            total = total + a
+        return total
+
+
+class ConcatAggregator(nn.Module):
+    """Concatenate embeddings in sorted-key order and project to ``output_dim``."""
+
+    output_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, embeddings: TensorMap) -> jnp.ndarray:
+        arrays = [embeddings[name] for name in sorted(embeddings)]
+        stacked = jnp.concatenate(arrays, axis=-1)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="proj")(stacked)
+
+
+class PositionAwareAggregator(nn.Module):
+    """Sum features, scale by sqrt(d), add learned positional embeddings, dropout.
+
+    ``max_sequence_length`` bounds the positional table; shorter inputs take its tail
+    so the most-recent position always maps to the last table row.
+    """
+
+    embedding_dim: int
+    max_sequence_length: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, embeddings: TensorMap, deterministic: bool = True) -> jnp.ndarray:
+        total = SumAggregator(name="sum")(embeddings)
+        seq_len = total.shape[-2]
+        if seq_len > self.max_sequence_length:
+            msg = (
+                f"Sequence length {seq_len} exceeds positional table size "
+                f"{self.max_sequence_length}"
+            )
+            raise ValueError(msg)
+        positions = self.param(
+            "positional_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (self.max_sequence_length, self.embedding_dim),
+        )
+        scaled = total * jnp.sqrt(float(self.embedding_dim)).astype(total.dtype)
+        out = scaled + positions[self.max_sequence_length - seq_len :].astype(total.dtype)
+        return nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)

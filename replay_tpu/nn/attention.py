@@ -1,0 +1,127 @@
+"""Attention modules.
+
+Capability parity with replay/nn/attention.py:6 (Differential Transformer attention,
+arXiv 2410.05258: dual-softmax with a learned lambda and per-head RMSNorm) plus the
+standard multi-head attention used by the SASRec encoder
+(replay/nn/sequential/sasrec/transformer.py uses torch MultiheadAttention).
+
+Both modules take an ADDITIVE float mask [B, 1, L, L] (see replay_tpu.nn.mask) and are
+pure jnp — einsum contractions map straight onto the MXU and XLA fuses the
+mask+softmax chain. Sequence-parallel ring attention reuses these shapes
+(replay_tpu.parallel.ring).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, H, L, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,  # additive [B, 1, L, L]
+) -> jnp.ndarray:
+    scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], dtype=q.dtype))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + mask.astype(q.dtype)
+    weights = nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+class MultiHeadAttention(nn.Module):
+    """Standard multi-head self-attention with an additive mask."""
+
+    num_heads: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, mask: jnp.ndarray, deterministic: bool = True
+    ) -> jnp.ndarray:
+        dim = x.shape[-1]
+        if dim % self.num_heads:
+            msg = f"embedding dim {dim} not divisible by {self.num_heads} heads"
+            raise ValueError(msg)
+        head_dim = dim // self.num_heads
+
+        def split(name):
+            proj = nn.Dense(dim, dtype=self.dtype, name=name)(x)
+            return proj.reshape(*x.shape[:-1], self.num_heads, head_dim).swapaxes(-3, -2)
+
+        q, k, v = split("query"), split("key"), split("value")
+        out = dot_product_attention(q, k, v, mask)
+        out = out.swapaxes(-3, -2).reshape(*x.shape[:-1], dim)
+        out = nn.Dense(dim, dtype=self.dtype, name="out")(out)
+        return nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)
+
+
+class RMSNorm(nn.Module):
+    """RMS normalization over the last axis (no mean subtraction)."""
+
+    epsilon: float = 1e-6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        norm = jnp.sqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + self.epsilon)
+        return x / norm * scale.astype(x.dtype)
+
+
+class MultiHeadDifferentialAttention(nn.Module):
+    """Differential attention: softmax(Q1K1) - lambda * softmax(Q2K2) per head.
+
+    lambda = exp(lq1 . lk1) - exp(lq2 . lk2) + lambda_init, with per-head RMSNorm and
+    the (1 - lambda_init) output scaling from the paper.
+    """
+
+    num_heads: int
+    lambda_init: float = 0.8
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, mask: jnp.ndarray, deterministic: bool = True
+    ) -> jnp.ndarray:
+        dim = x.shape[-1]
+        if dim % (2 * self.num_heads):
+            msg = f"embedding dim {dim} must be divisible by 2*num_heads ({2 * self.num_heads})"
+            raise ValueError(msg)
+        head_dim = dim // (2 * self.num_heads)
+
+        def split(name):
+            proj = nn.Dense(dim, use_bias=False, dtype=self.dtype, name=name)(x)
+            # two attention maps per head: [B, 2H, L, D/2H]
+            return proj.reshape(*x.shape[:-1], 2 * self.num_heads, head_dim).swapaxes(-3, -2)
+
+        q, k = split("query"), split("key")
+        v_proj = nn.Dense(dim, use_bias=False, dtype=self.dtype, name="value")(x)
+        v = v_proj.reshape(*x.shape[:-1], self.num_heads, 2 * head_dim).swapaxes(-3, -2)
+
+        scale = 1.0 / jnp.sqrt(jnp.array(head_dim, dtype=x.dtype))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + mask.astype(x.dtype)
+        weights = nn.softmax(scores, axis=-1)
+        w1 = weights[:, 0::2]  # [B, H, L, L]
+        w2 = weights[:, 1::2]
+
+        init = nn.initializers.normal(stddev=0.1)
+        lq1 = self.param("lambda_q1", init, (head_dim,))
+        lk1 = self.param("lambda_k1", init, (head_dim,))
+        lq2 = self.param("lambda_q2", init, (head_dim,))
+        lk2 = self.param("lambda_k2", init, (head_dim,))
+        lam = (
+            jnp.exp(jnp.dot(lq1, lk1)) - jnp.exp(jnp.dot(lq2, lk2)) + self.lambda_init
+        ).astype(x.dtype)
+
+        attn = w1 - lam * w2
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)  # [B, H, L, 2*head_dim]
+        out = RMSNorm(dtype=self.dtype, name="head_norm")(out)
+        out = out * (1.0 - self.lambda_init)
+        out = out.swapaxes(-3, -2).reshape(*x.shape[:-1], dim)
+        out = nn.Dense(dim, use_bias=False, dtype=self.dtype, name="out")(out)
+        return nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)

@@ -1,0 +1,174 @@
+"""Per-feature sequence embeddings (flax).
+
+Capability parity with replay/nn/embedding.py:10-327: ``SequenceEmbedding`` dispatches
+each tensor-schema feature to a categorical table (cardinality+1 rows, one reserved for
+padding), a masked-pooling list embedding (sum/mean/max over the list axis — the
+EmbeddingBag equivalent), a linear numerical projection, or identity;
+``get_item_weights`` exposes the item table without its padding row for weight-tying
+heads. TPU note: lookups are gathers feeding the MXU matmuls downstream; compute dtype
+is configurable (bfloat16-friendly), parameters stay float32.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from replay_tpu.data.nn.schema import TensorFeatureInfo, TensorMap, TensorSchema
+
+logger = logging.getLogger("replay_tpu")
+
+
+class CategoricalEmbedding(nn.Module):
+    """Embedding table with one extra row reserved for the padding id."""
+
+    cardinality: int
+    embedding_dim: int
+    padding_value: int = 0
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        self.table = nn.Embed(
+            num_embeddings=self.cardinality + 1,
+            features=self.embedding_dim,
+            dtype=self.dtype,
+            name="table",
+        )
+
+    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+        return self.table(ids)
+
+    def item_weights(self) -> jnp.ndarray:
+        """All non-padding rows of the table, aligned with item ids [0, cardinality)."""
+        if self.padding_value != self.cardinality:
+            logger.warning(
+                "padding_value (%d) != cardinality (%d); item weights are the rows "
+                "excluding the padding row, which re-indexes ids above the padding value.",
+                self.padding_value,
+                self.cardinality,
+            )
+            keep = [i for i in range(self.cardinality + 1) if i != self.padding_value]
+            return self.table.embedding[jnp.array(keep)]
+        return self.table.embedding[: self.cardinality]
+
+
+class CategoricalListEmbedding(nn.Module):
+    """Embed a list feature and pool over the list axis (sum / mean / max)."""
+
+    cardinality: int
+    embedding_dim: int
+    padding_value: int = 0
+    pooling: str = "sum"
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        if self.pooling not in ("sum", "mean", "max"):
+            msg = f"Unknown pooling: {self.pooling}"
+            raise ValueError(msg)
+        self.table = nn.Embed(
+            num_embeddings=self.cardinality + 1,
+            features=self.embedding_dim,
+            dtype=self.dtype,
+            name="table",
+        )
+
+    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+        # ids: [..., list_len] -> [..., emb]
+        vectors = self.table(ids)
+        valid = (ids != self.padding_value)[..., None].astype(vectors.dtype)
+        if self.pooling == "sum":
+            return jnp.sum(vectors * valid, axis=-2)
+        if self.pooling == "mean":
+            total = jnp.sum(vectors * valid, axis=-2)
+            count = jnp.maximum(jnp.sum(valid, axis=-2), 1.0)
+            return total / count
+        neg_inf = jnp.finfo(vectors.dtype).min
+        masked = jnp.where(valid > 0, vectors, neg_inf)
+        pooled = jnp.max(masked, axis=-2)
+        return jnp.where(jnp.sum(valid, axis=-2) > 0, pooled, 0.0)
+
+
+class NumericalEmbedding(nn.Module):
+    """Linear projection tensor_dim → embedding_dim."""
+
+    embedding_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, values: jnp.ndarray) -> jnp.ndarray:
+        if values.ndim == 2:  # [B, L] scalar feature -> add feature axis
+            values = values[..., None]
+        return nn.Dense(self.embedding_dim, dtype=self.dtype, name="proj")(values.astype(self.dtype))
+
+
+class IdentityEmbedding(nn.Module):
+    """Pass a pre-embedded numerical tensor through unchanged."""
+
+    @nn.compact
+    def __call__(self, values: jnp.ndarray) -> jnp.ndarray:
+        return values
+
+
+class SequenceEmbedding(nn.Module):
+    """Embed every (sequential) feature of a tensor schema into a dict of [B, L, E] arrays.
+
+    The feature hinted ITEM_ID provides the weight-tying table via
+    :meth:`get_item_weights`.
+    """
+
+    schema: TensorSchema
+    categorical_list_pooling: str = "sum"
+    excluded_features: tuple = ()
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        embedders = {}
+        for feature in self.schema.all_features:
+            if feature.name in self.excluded_features:
+                continue
+            embedders[feature.name] = self._make_embedder(feature)
+        self.embedders = embedders
+
+    def _make_embedder(self, feature: TensorFeatureInfo):
+        if feature.is_cat:
+            if feature.cardinality is None:
+                msg = f"Feature '{feature.name}' has no cardinality set."
+                raise ValueError(msg)
+            cls = CategoricalListEmbedding if feature.is_list else CategoricalEmbedding
+            kwargs = {"pooling": self.categorical_list_pooling} if feature.is_list else {}
+            return cls(
+                cardinality=feature.cardinality,
+                embedding_dim=feature.embedding_dim,
+                padding_value=feature.padding_value,
+                dtype=self.dtype,
+                name=f"embedding_{feature.name}",
+                **kwargs,
+            )
+        if feature.is_list and feature.tensor_dim is not None and feature.tensor_dim == feature.embedding_dim:
+            return IdentityEmbedding(name=f"embedding_{feature.name}")
+        return NumericalEmbedding(
+            embedding_dim=feature.embedding_dim or TensorFeatureInfo.DEFAULT_EMBEDDING_DIM,
+            dtype=self.dtype,
+            name=f"embedding_{feature.name}",
+        )
+
+    def __call__(self, feature_tensors: TensorMap) -> TensorMap:
+        out = {}
+        for name, embedder in self.embedders.items():
+            if name in feature_tensors:
+                out[name] = embedder(feature_tensors[name])
+        return out
+
+    def get_item_weights(self, item_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """Item-embedding matrix [num_items, E] (or the rows of ``item_ids``)."""
+        item_feature_name = self.schema.item_id_feature_name
+        if item_feature_name is None:
+            msg = "Schema has no ITEM_ID feature; cannot produce item weights."
+            raise RuntimeError(msg)
+        embedder = self.embedders[item_feature_name]
+        if item_ids is not None:
+            return embedder(item_ids)
+        return embedder.item_weights()

@@ -1,0 +1,67 @@
+"""Feed-forward blocks.
+
+Capability parity with replay/nn/ffn.py:12-150: ``PointWiseFeedForward`` (the SASRec
+position-wise block — two 1x1 convs in the reference are two Dense layers here, which
+XLA fuses into MXU matmuls), ``SwiGLU`` and ``SwiGLUEncoder`` (the TwoTower item-tower
+MLP stack).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PointWiseFeedForward(nn.Module):
+    """ReLU MLP applied per position with residual connection."""
+
+    hidden_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="inner")(x)
+        h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
+        h = nn.relu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="outer")(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
+        return x + h
+
+
+class SwiGLU(nn.Module):
+    """SwiGLU gated unit: (silu(xW1) * xW3) W2."""
+
+    hidden_dim: int
+    output_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        gate = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype, name="gate")(x)
+        value = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype, name="value")(x)
+        return nn.Dense(self.output_dim, use_bias=False, dtype=self.dtype, name="out")(
+            nn.silu(gate) * value
+        )
+
+
+class SwiGLUEncoder(nn.Module):
+    """Stack of pre-norm SwiGLU blocks with residuals, then a final norm + projection."""
+
+    num_blocks: int
+    hidden_dim: int
+    output_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        for i in range(self.num_blocks):
+            h = nn.LayerNorm(dtype=self.dtype, name=f"norm_{i}")(x)
+            h = SwiGLU(self.hidden_dim, x.shape[-1], dtype=self.dtype, name=f"swiglu_{i}")(h)
+            h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
+            x = x + h
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="final_proj")(x)

@@ -1,0 +1,28 @@
+"""Scoring heads.
+
+Capability parity with replay/nn/head.py:4-49: ``EmbeddingTyingHead`` — dot-product
+scoring between hidden states and item embeddings supporting the reference's three
+shape dispatches: [B, *, E] x [I, E], [B, E] x [B, I, E] and [B, *, E] x [B, *, E].
+One einsum per case, all MXU-friendly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class EmbeddingTyingHead:
+    """Score hidden states against item embeddings by dot product."""
+
+    def __call__(self, hidden: jnp.ndarray, item_embeddings: jnp.ndarray) -> jnp.ndarray:
+        if item_embeddings.ndim == 2:
+            # [B, *, E] x [I, E] -> [B, *, I] — full-catalog scoring
+            return jnp.einsum("...e,ie->...i", hidden, item_embeddings)
+        if hidden.ndim == 2 and item_embeddings.ndim == 3:
+            # [B, E] x [B, I, E] -> [B, I] — per-query candidate scoring
+            return jnp.einsum("be,bie->bi", hidden, item_embeddings)
+        if hidden.ndim == item_embeddings.ndim:
+            # [B, *, E] x [B, *, E] -> [B, *] — paired scoring
+            return jnp.sum(hidden * item_embeddings, axis=-1)
+        msg = f"Unsupported head shapes: {hidden.shape} x {item_embeddings.shape}"
+        raise ValueError(msg)

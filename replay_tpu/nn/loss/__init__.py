@@ -1,0 +1,25 @@
+from .base import LossBase, broadcast_negatives, mask_negative_logits, masked_mean
+from .bce import BCE, BCESampled
+from .ce import CE, CESampled, CESampledWeighted, CEWeighted
+from .login_ce import LogInCE, LogInCESampled
+from .logout_ce import LogOutCE, LogOutCEWeighted
+from .sce import ScalableCrossEntropyLoss, SCEParams
+
+__all__ = [
+    "BCE",
+    "BCESampled",
+    "CE",
+    "CESampled",
+    "CESampledWeighted",
+    "CEWeighted",
+    "LogInCE",
+    "LogInCESampled",
+    "LogOutCE",
+    "LogOutCEWeighted",
+    "LossBase",
+    "SCEParams",
+    "ScalableCrossEntropyLoss",
+    "broadcast_negatives",
+    "mask_negative_logits",
+    "masked_mean",
+]

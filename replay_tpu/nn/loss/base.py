@@ -1,0 +1,86 @@
+"""Loss-function protocol and shared sampled-logit plumbing.
+
+Capability parity with replay/nn/loss/base.py:9-120. Every loss is a callable with the
+reference signature ``loss(model_embeddings, feature_tensors, positive_labels,
+negative_labels, padding_mask, target_padding_mask)`` and a ``logits_callback``
+injected by the model (the head's ``get_logits``).
+
+TPU-first deviation: the reference selects valid positions with boolean-mask gathers
+(``logits[target_padding_mask]``), which creates dynamic shapes. Here every loss keeps
+static shapes and weights per-position terms by the mask instead — identical values,
+jit/pjit-compatible.
+
+Shapes:
+  model_embeddings     [B, L, E]
+  positive_labels      [B, L, P]      (P = 1 unless multi-positive)
+  negative_labels      [N] | [B, N] | [B, L, N]
+  padding_mask         [B, L]   bool
+  target_padding_mask  [B, L, P] bool
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+LogitsCallback = Callable[..., jnp.ndarray]
+
+
+class LossBase:
+    """Shared logits-callback handling."""
+
+    def __init__(self) -> None:
+        self._logits_callback: Optional[LogitsCallback] = None
+
+    @property
+    def logits_callback(self) -> LogitsCallback:
+        if self._logits_callback is None:
+            msg = "The callback for getting logits is not defined"
+            raise AttributeError(msg)
+        return self._logits_callback
+
+    @logits_callback.setter
+    def logits_callback(self, func: Optional[LogitsCallback]) -> None:
+        self._logits_callback = func
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+def broadcast_negatives(negative_labels: jnp.ndarray, batch: int, length: int) -> jnp.ndarray:
+    """Normalize negative label shapes to [B, L, N]."""
+    if negative_labels.ndim == 1:
+        return jnp.broadcast_to(negative_labels[None, None, :], (batch, length, negative_labels.shape[0]))
+    if negative_labels.ndim == 2:
+        return jnp.broadcast_to(negative_labels[:, None, :], (batch, length, negative_labels.shape[1]))
+    if negative_labels.ndim == 3:
+        return negative_labels
+    msg = f"Unsupported negative_labels rank: {negative_labels.ndim}"
+    raise ValueError(msg)
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean of ``values`` over the True entries of ``mask`` (0 if empty)."""
+    mask = mask.astype(values.dtype)
+    total = jnp.sum(values * mask)
+    count = jnp.sum(mask)
+    return total / jnp.maximum(count, 1.0)
+
+
+def mask_negative_logits(
+    negative_logits: jnp.ndarray,
+    negative_labels: jnp.ndarray,
+    ignore_index: int,
+) -> jnp.ndarray:
+    """Push padded negatives to -inf so they vanish from the softmax."""
+    neg_inf = jnp.finfo(negative_logits.dtype).min
+    return jnp.where(negative_labels == ignore_index, neg_inf, negative_logits)

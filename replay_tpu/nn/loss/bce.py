@@ -1,0 +1,85 @@
+"""Binary cross-entropy losses.
+
+Capability parity with replay/nn/loss/bce.py:10-220 (BCE over the full catalog with
+multi-hot positive targets; BCESampled over positive + sampled negative logits with
+log-epsilon and clamping).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LossBase, broadcast_negatives, masked_mean
+
+
+class BCE(LossBase):
+    """Pointwise BCE-with-logits over the whole catalog (positives are multi-hot)."""
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        logits = self.logits_callback(model_embeddings)  # [B, L, I]
+        num_items = logits.shape[-1]
+        labels = jnp.clip(positive_labels, 0, num_items - 1)
+        valid = target_padding_mask.astype(logits.dtype)
+        targets = jnp.zeros_like(logits)
+        targets = jax.vmap(jax.vmap(lambda t, lab, v: t.at[lab].max(v)))(targets, labels, valid)
+        per_elem = jnp.maximum(logits, 0) - logits * targets + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        position_valid = target_padding_mask.any(axis=-1)  # [B, L]
+        per_position = per_elem.sum(axis=-1)
+        return jnp.sum(per_position * position_valid) / jnp.maximum(jnp.sum(position_valid), 1.0)
+
+
+class BCESampled(LossBase):
+    """BCE over positive (label 1) and sampled negative (label 0) logits."""
+
+    def __init__(
+        self,
+        log_epsilon: float = 1e-6,
+        clamp_border: float = 100.0,
+        negative_labels_ignore_index: int = -100,
+    ) -> None:
+        super().__init__()
+        self.log_epsilon = log_epsilon
+        self.clamp_border = clamp_border
+        self.negative_labels_ignore_index = negative_labels_ignore_index
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        batch, length, _ = positive_labels.shape
+        negatives = broadcast_negatives(negative_labels, batch, length)
+        safe_neg = jnp.where(negatives == self.negative_labels_ignore_index, 0, negatives)
+
+        positive_logits = self.logits_callback(model_embeddings, positive_labels)
+        negative_logits = self.logits_callback(model_embeddings, safe_neg)
+
+        def bce(logits, target):
+            probs = jax.nn.sigmoid(logits)
+            value = jnp.where(
+                target > 0,
+                -jnp.log(probs + self.log_epsilon),
+                -jnp.log1p(-probs + self.log_epsilon),
+            )
+            return jnp.clip(value, -self.clamp_border, self.clamp_border)
+
+        pos_loss = bce(positive_logits, 1.0)  # [B, L, P]
+        neg_loss = bce(negative_logits, 0.0)  # [B, L, N]
+        neg_valid = (negatives != self.negative_labels_ignore_index) & padding_mask[..., None]
+
+        total = jnp.sum(pos_loss * target_padding_mask) + jnp.sum(neg_loss * neg_valid)
+        count = jnp.sum(target_padding_mask) + jnp.sum(neg_valid)
+        return total / jnp.maximum(count, 1.0)

@@ -1,0 +1,105 @@
+"""Cross-entropy losses: full-catalog and negative-sampled variants.
+
+Capability parity with replay/nn/loss/ce.py:10-340 (CE, CEWeighted, CESampled,
+CESampledWeighted).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import LossBase, broadcast_negatives, mask_negative_logits, masked_mean
+
+
+class CE(LossBase):
+    """Full-softmax cross-entropy over the whole item catalog."""
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        if positive_labels.shape[-1] != 1:
+            msg = "Multi-positive labels are not supported by the CE loss"
+            raise NotImplementedError(msg)
+        logits = self.logits_callback(model_embeddings)  # [B, L, I]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        labels = jnp.clip(positive_labels[..., 0], 0, logits.shape[-1] - 1)
+        nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+        weights = self._label_weights(labels, nll.dtype)
+        mask = target_padding_mask[..., 0].astype(nll.dtype) * weights
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def _label_weights(self, labels, dtype):
+        return jnp.ones_like(labels, dtype=dtype)
+
+
+class CEWeighted(CE):
+    """CE with per-class weights (reference: torch CrossEntropyLoss(weight=...))."""
+
+    def __init__(self, weight) -> None:
+        super().__init__()
+        self.weight = jnp.asarray(weight)
+
+    def _label_weights(self, labels, dtype):
+        return self.weight[labels].astype(dtype)
+
+
+class CESampled(LossBase):
+    """Softmax CE between each positive and K sampled negatives.
+
+    Supports multi-positive labels and all three negative shapes; negatives equal to
+    ``negative_labels_ignore_index`` are excluded from the softmax.
+    """
+
+    def __init__(self, negative_labels_ignore_index: int = -100) -> None:
+        super().__init__()
+        self.negative_labels_ignore_index = negative_labels_ignore_index
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        batch, length, num_pos = positive_labels.shape
+        negatives = broadcast_negatives(negative_labels, batch, length)  # [B, L, N]
+
+        safe_neg = jnp.where(negatives == self.negative_labels_ignore_index, 0, negatives)
+        negative_logits = self.logits_callback(model_embeddings, safe_neg)  # [B, L, N]
+        negative_logits = mask_negative_logits(
+            negative_logits, negatives, self.negative_labels_ignore_index
+        )
+        positive_logits = self.logits_callback(model_embeddings, positive_labels)  # [B, L, P]
+
+        # per-positive softmax over [positive, negatives]
+        neg_lse = jax.nn.logsumexp(negative_logits, axis=-1, keepdims=True)  # [B, L, 1]
+        denom = jnp.logaddexp(positive_logits, neg_lse)  # [B, L, P]
+        nll = denom - positive_logits
+        weights = self._label_weights(positive_labels, nll.dtype)
+        mask = target_padding_mask.astype(nll.dtype) * weights
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def _label_weights(self, labels, dtype):
+        return jnp.ones_like(labels, dtype=dtype)
+
+
+class CESampledWeighted(CESampled):
+    """CESampled with per-item weights applied to the positive terms."""
+
+    def __init__(self, weight, negative_labels_ignore_index: int = -100) -> None:
+        super().__init__(negative_labels_ignore_index)
+        self.weight = jnp.asarray(weight)
+
+    def _label_weights(self, labels, dtype):
+        return self.weight[jnp.clip(labels, 0, self.weight.shape[0] - 1)].astype(dtype)

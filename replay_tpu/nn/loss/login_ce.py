@@ -1,0 +1,77 @@
+"""InfoNCE-style losses with the log OUTSIDE the positive sum ("log-in" family).
+
+Capability parity with replay/nn/loss/login_ce.py:102-300:
+``L = -log( sum_p exp(pos) / (sum_p exp(pos) + sum_n exp(neg)) )`` per position —
+``LogInCE`` uses the full catalog as negatives, ``LogInCESampled`` the sampled ones.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LossBase, broadcast_negatives, mask_negative_logits
+
+
+class LogInCE(LossBase):
+    """InfoNCE with the whole catalog as the negative pool."""
+
+    def __init__(self, cardinality: int, log_epsilon: float = 1e-6) -> None:
+        super().__init__()
+        self.cardinality = cardinality
+        self.log_epsilon = log_epsilon
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        logits = self.logits_callback(model_embeddings)  # [B, L, I]
+        labels = jnp.clip(positive_labels, 0, logits.shape[-1] - 1)
+        pos_logits = jnp.take_along_axis(logits, labels, axis=-1)  # [B, L, P]
+        neg_inf = jnp.finfo(logits.dtype).min
+        pos_logits = jnp.where(target_padding_mask, pos_logits, neg_inf)
+
+        pos_lse = jax.nn.logsumexp(pos_logits, axis=-1)  # [B, L]
+        all_lse = jax.nn.logsumexp(logits, axis=-1)  # [B, L] (includes positives)
+        nll = all_lse - pos_lse
+        position_valid = target_padding_mask.any(axis=-1)
+        return jnp.sum(nll * position_valid) / jnp.maximum(jnp.sum(position_valid), 1.0)
+
+
+class LogInCESampled(LossBase):
+    """InfoNCE over positive logits vs sampled negative logits."""
+
+    def __init__(self, log_epsilon: float = 1e-6, negative_labels_ignore_index: int = -100) -> None:
+        super().__init__()
+        self.log_epsilon = log_epsilon
+        self.negative_labels_ignore_index = negative_labels_ignore_index
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        batch, length, _ = positive_labels.shape
+        negatives = broadcast_negatives(negative_labels, batch, length)
+        safe_neg = jnp.where(negatives == self.negative_labels_ignore_index, 0, negatives)
+
+        pos_logits = self.logits_callback(model_embeddings, positive_labels)  # [B, L, P]
+        neg_logits = self.logits_callback(model_embeddings, safe_neg)  # [B, L, N]
+        neg_logits = mask_negative_logits(neg_logits, negatives, self.negative_labels_ignore_index)
+
+        neg_inf = jnp.finfo(pos_logits.dtype).min
+        pos_logits = jnp.where(target_padding_mask, pos_logits, neg_inf)
+        pos_lse = jax.nn.logsumexp(pos_logits, axis=-1)
+        total_lse = jnp.logaddexp(pos_lse, jax.nn.logsumexp(neg_logits, axis=-1))
+        nll = total_lse - pos_lse
+        position_valid = target_padding_mask.any(axis=-1)
+        return jnp.sum(nll * position_valid) / jnp.maximum(jnp.sum(position_valid), 1.0)

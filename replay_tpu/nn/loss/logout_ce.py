@@ -1,0 +1,65 @@
+"""Cross-entropy with other positives excluded from the denominator ("log-out" family).
+
+Capability parity with replay/nn/loss/logout_ce.py:10-240: for each positive p,
+``-log( exp(pos_p) / (exp(pos_p) + sum over catalog excluding ALL positives) )`` —
+avoids positives competing against each other in the multi-positive case.
+``LogOutCEWeighted`` scales each positive's term by a per-item weight.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LossBase
+
+
+class LogOutCE(LossBase):
+    """Full-catalog CE that masks the OTHER positives out of each positive's softmax."""
+
+    def __init__(self, cardinality: int) -> None:
+        super().__init__()
+        self.cardinality = cardinality
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        logits = self.logits_callback(model_embeddings)  # [B, L, I]
+        num_items = logits.shape[-1]
+        labels = jnp.clip(positive_labels, 0, num_items - 1)
+        valid = target_padding_mask
+
+        # positives-as-negatives mask: True at any positive of the position
+        is_positive = jnp.zeros(logits.shape, dtype=bool)
+        is_positive = jax.vmap(jax.vmap(lambda m, lab, v: m.at[lab].max(v)))(
+            is_positive, labels, valid
+        )
+        neg_inf = jnp.finfo(logits.dtype).min
+        negatives_only = jnp.where(is_positive, neg_inf, logits)
+        neg_lse = jax.nn.logsumexp(negatives_only, axis=-1, keepdims=True)  # [B, L, 1]
+
+        pos_logits = jnp.take_along_axis(logits, labels, axis=-1)  # [B, L, P]
+        denom = jnp.logaddexp(pos_logits, neg_lse)
+        nll = denom - pos_logits
+        weights = self._label_weights(labels, nll.dtype) * valid.astype(nll.dtype)
+        return jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+    def _label_weights(self, labels, dtype):
+        return jnp.ones_like(labels, dtype=dtype)
+
+
+class LogOutCEWeighted(LogOutCE):
+    """LogOutCE with per-item weights on the positive terms."""
+
+    def __init__(self, cardinality: int, weight) -> None:
+        super().__init__(cardinality)
+        self.weight = jnp.asarray(weight)
+
+    def _label_weights(self, labels, dtype):
+        return self.weight[jnp.clip(labels, 0, self.weight.shape[0] - 1)].astype(dtype)

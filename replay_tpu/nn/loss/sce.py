@@ -1,0 +1,85 @@
+"""Scalable Cross-Entropy for huge item catalogs (arXiv 2409.18721).
+
+Capability parity with replay/models/nn/loss/sce.py:27-124: bucket hidden states and
+item embeddings by a shared random projection, take the top ``bucket_size_x`` positions
+and top ``bucket_size_y`` items per bucket, and compute CE of each selected position's
+correct class against its bucket's hard negatives; per-position losses are reduced with
+a scatter-max. JAX version: the random projection takes an explicit PRNG key, the
+final masked selection is a static-shape weighted mean, and the bucket matmuls /
+top-k run on the MXU (jax.lax.top_k).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SCEParams:
+    n_buckets: int
+    bucket_size_x: int
+    bucket_size_y: int
+    mix_x: bool = False
+
+
+class ScalableCrossEntropyLoss:
+    """Bucketed hard-negative-mined cross-entropy."""
+
+    def __init__(self, sce_params: SCEParams) -> None:
+        if None in (sce_params.n_buckets, sce_params.bucket_size_x, sce_params.bucket_size_y):
+            msg = "n_buckets, bucket_size_x and bucket_size_y must all be set"
+            raise ValueError(msg)
+        self.params = sce_params
+
+    def __call__(
+        self,
+        embeddings: jnp.ndarray,  # [B, L, E]
+        positive_labels: jnp.ndarray,  # [B, L]
+        all_embeddings: jnp.ndarray,  # [I, E]
+        padding_mask: jnp.ndarray,  # [B, L] bool
+        rng: jax.Array,
+        tokens_mask: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        p = self.params
+        dim = embeddings.shape[-1]
+        x = embeddings.reshape(-1, dim)  # [T, E]
+        y = positive_labels.reshape(-1)  # [T]
+        w = all_embeddings  # [I, E]
+        flat_pad = padding_mask.reshape(-1)
+        loss_tokens = flat_pad if tokens_mask is None else (flat_pad & tokens_mask.reshape(-1))
+
+        correct_logits = jnp.sum(x * w[y], axis=1)  # [T]
+
+        scale = 1.0 / jnp.sqrt(jnp.sqrt(jnp.array(dim, dtype=x.dtype)))
+        if p.mix_x:
+            omega = scale * jax.random.normal(rng, (x.shape[0], p.n_buckets), dtype=x.dtype)
+            buckets = jax.lax.stop_gradient(omega.T @ x)  # [n_b, E]
+        else:
+            buckets = scale * jax.random.normal(rng, (p.n_buckets, dim), dtype=x.dtype)
+
+        # hardest positions and hardest items per bucket (no gradients through mining)
+        x_scores = jax.lax.stop_gradient(buckets @ x.T)  # [n_b, T]
+        x_scores = jnp.where(flat_pad[None, :], x_scores, jnp.finfo(x.dtype).min)
+        _, top_x = jax.lax.top_k(x_scores, p.bucket_size_x)  # [n_b, bs_x]
+        y_scores = jax.lax.stop_gradient(buckets @ w.T)  # [n_b, I]
+        _, top_y = jax.lax.top_k(y_scores, p.bucket_size_y)  # [n_b, bs_y]
+
+        x_bucket = x[top_x]  # [n_b, bs_x, E]
+        y_bucket = w[top_y]  # [n_b, bs_y, E]
+        wrong_logits = jnp.einsum("nxe,nye->nxy", x_bucket, y_bucket)
+        # mask bucket items that are the position's own positive
+        same = y[top_x][:, :, None] == top_y[:, None, :]
+        wrong_logits = jnp.where(same, jnp.finfo(x.dtype).min, wrong_logits)
+
+        pos = correct_logits[top_x][:, :, None]  # [n_b, bs_x, 1]
+        logits = jnp.concatenate([wrong_logits, pos], axis=2)
+        nll = jax.nn.logsumexp(logits, axis=2) - pos[..., 0]  # [n_b, bs_x]
+
+        # scatter-max per original position (a position can appear in several buckets)
+        per_token = jnp.zeros(x.shape[0], dtype=x.dtype).at[top_x.reshape(-1)].max(nll.reshape(-1))
+        counted = (per_token != 0) & loss_tokens
+        return jnp.sum(per_token * counted) / jnp.maximum(jnp.sum(counted), 1.0)

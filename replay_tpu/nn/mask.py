@@ -1,0 +1,69 @@
+"""Attention-mask construction.
+
+Capability parity with replay/nn/mask.py:14-87: merge a causal (lower-triangular)
+constraint with the key-padding mask and a diagonal rescue (a fully-masked row attends
+to itself instead of producing NaNs). The additive mask uses ``-inf`` during training
+and ``finfo.min`` at evaluation — the reference keeps this distinction deliberately so
+fully-masked softmax rows stay finite in eval (replay/nn/mask.py:40).
+
+Masks here are additive float arrays of shape [B, 1, L, L] broadcastable over heads,
+built by pure jnp functions (jit-friendly, no module state).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def padding_mask_from_ids(ids: jnp.ndarray, padding_value: int = 0) -> jnp.ndarray:
+    """Boolean [B, L] mask, True where the position holds a real token."""
+    return ids != padding_value
+
+
+def causal_attention_mask(
+    padding_mask: jnp.ndarray,
+    deterministic: bool = False,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Additive causal+padding mask [B, 1, L, L].
+
+    :param padding_mask: boolean [B, L], True at real tokens.
+    :param deterministic: eval mode — use ``finfo.min`` instead of ``-inf`` so rows
+        that are fully masked (cold queries) don't produce NaN softmax outputs.
+    """
+    batch, length = padding_mask.shape
+    causal = jnp.tril(jnp.ones((length, length), dtype=bool))
+    allowed = causal[None, :, :] & padding_mask[:, None, :]
+    # diagonal rescue: every position may attend to itself
+    eye = jnp.eye(length, dtype=bool)[None]
+    allowed = allowed | eye
+    neg = jnp.array(float("-inf") if not deterministic else jnp.finfo(dtype).min, dtype=dtype)
+    return jnp.where(allowed, jnp.zeros((), dtype=dtype), neg)[:, None, :, :]
+
+
+def bidirectional_attention_mask(
+    padding_mask: jnp.ndarray,
+    deterministic: bool = False,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Additive padding-only mask [B, 1, L, L] (BERT4Rec-style full attention)."""
+    length = padding_mask.shape[1]
+    allowed = jnp.broadcast_to(padding_mask[:, None, :], (padding_mask.shape[0], length, length))
+    eye = jnp.eye(length, dtype=bool)[None]
+    allowed = allowed | eye
+    neg = jnp.array(float("-inf") if not deterministic else jnp.finfo(dtype).min, dtype=dtype)
+    return jnp.where(allowed, jnp.zeros((), dtype=dtype), neg)[:, None, :, :]
+
+
+class DefaultAttentionMask:
+    """Build the causal mask from a reference feature's padding (config-friendly shim)."""
+
+    def __init__(self, reference_feature: str, padding_value: int = 0) -> None:
+        self.reference_feature = reference_feature
+        self.padding_value = padding_value
+
+    def __call__(self, feature_tensors, deterministic: bool = False, dtype=jnp.float32) -> jnp.ndarray:
+        ids = feature_tensors[self.reference_feature]
+        return causal_attention_mask(
+            padding_mask_from_ids(ids, self.padding_value), deterministic=deterministic, dtype=dtype
+        )

@@ -1,0 +1,3 @@
+from .sasrec.model import SasRec, SasRecBody
+
+__all__ = ["SasRec", "SasRecBody"]

@@ -1,0 +1,4 @@
+from .model import SasRec, SasRecBody
+from .transformer import DiffTransformerLayer, SasRecTransformerLayer
+
+__all__ = ["DiffTransformerLayer", "SasRec", "SasRecBody", "SasRecTransformerLayer"]

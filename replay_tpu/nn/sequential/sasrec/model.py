@@ -1,0 +1,163 @@
+"""SASRec: causal-attention next-item model.
+
+Capability parity with replay/nn/sequential/sasrec/model.py:43-378: ``SasRecBody``
+(embedder → position-aware aggregator → causal mask → transformer encoder → final
+norm) and ``SasRec`` with a weight-tying dot-product head.
+
+JAX design: ``SasRec`` is a flax module whose ``__call__`` produces hidden states;
+``get_logits`` scores hidden states against item embeddings (full catalog or
+candidates); ``forward_inference`` scores the LAST position, optionally restricted to
+``candidates_to_score``. Training loss lives OUTSIDE the module (see
+replay_tpu.nn.train): losses receive a ``logits_callback`` bound to
+``model.apply(..., method="get_logits")`` — the functional equivalent of the
+reference's injected callback. Encoder choice ``"sasrec" | "diff"`` mirrors the
+reference's SasRecTransformerLayer / DiffTransformerLayer options.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from replay_tpu.data.nn.schema import TensorMap, TensorSchema
+from replay_tpu.nn.agg import PositionAwareAggregator
+from replay_tpu.nn.embedding import SequenceEmbedding
+from replay_tpu.nn.head import EmbeddingTyingHead
+from replay_tpu.nn.mask import causal_attention_mask
+
+from .transformer import DiffTransformerLayer, SasRecTransformerLayer
+
+
+class SasRecBody(nn.Module):
+    """Embed → aggregate(+position) → causally-masked encoder → final LayerNorm."""
+
+    schema: TensorSchema
+    embedding_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 1
+    max_sequence_length: int = 50
+    hidden_dim: Optional[int] = None
+    dropout_rate: float = 0.0
+    encoder_type: str = "sasrec"
+    excluded_features: tuple = ()
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        self.embedder = SequenceEmbedding(
+            schema=self.schema,
+            excluded_features=self.excluded_features,
+            dtype=self.dtype,
+            name="embedder",
+        )
+        self.aggregator = PositionAwareAggregator(
+            embedding_dim=self.embedding_dim,
+            max_sequence_length=self.max_sequence_length,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="aggregator",
+        )
+        encoder_cls = {"sasrec": SasRecTransformerLayer, "diff": DiffTransformerLayer}.get(
+            self.encoder_type
+        )
+        if encoder_cls is None:
+            msg = f"Unknown encoder_type: {self.encoder_type}"
+            raise ValueError(msg)
+        self.encoder = encoder_cls(
+            num_blocks=self.num_blocks,
+            num_heads=self.num_heads,
+            hidden_dim=self.hidden_dim or self.embedding_dim * 4,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="encoder",
+        )
+        self.final_norm = nn.LayerNorm(dtype=self.dtype, name="final_norm")
+
+    def __call__(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,  # [B, L] bool
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        embeddings = self.embedder(feature_tensors)
+        x = self.aggregator(embeddings, deterministic=deterministic)
+        attention_mask = causal_attention_mask(
+            padding_mask, deterministic=deterministic, dtype=self.dtype
+        )
+        x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
+        return self.final_norm(x)
+
+
+class SasRec(nn.Module):
+    """SASRec with an embedding-tying head."""
+
+    schema: TensorSchema
+    embedding_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 1
+    max_sequence_length: int = 50
+    hidden_dim: Optional[int] = None
+    dropout_rate: float = 0.0
+    encoder_type: str = "sasrec"
+    excluded_features: tuple = ()
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        self.body = SasRecBody(
+            schema=self.schema,
+            embedding_dim=self.embedding_dim,
+            num_blocks=self.num_blocks,
+            num_heads=self.num_heads,
+            max_sequence_length=self.max_sequence_length,
+            hidden_dim=self.hidden_dim,
+            dropout_rate=self.dropout_rate,
+            encoder_type=self.encoder_type,
+            excluded_features=self.excluded_features,
+            dtype=self.dtype,
+            name="body",
+        )
+        self.head = EmbeddingTyingHead()
+
+    def __call__(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """Hidden states [B, L, E] (the training forward)."""
+        return self.body(feature_tensors, padding_mask, deterministic=deterministic)
+
+    def get_logits(
+        self, hidden: jnp.ndarray, candidates_to_score: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        """Score hidden states against the catalog (or given candidate ids).
+
+        Candidate shapes follow the loss protocol: None → [..., num_items];
+        [K] → [..., K]; [B, ..., K] → per-position candidate scores.
+        """
+        if candidates_to_score is None:
+            weights = self.body.embedder.get_item_weights()
+            return self.head(hidden, weights)
+        embedded = self.body.embedder.get_item_weights(candidates_to_score)
+        if candidates_to_score.ndim == 1:
+            return self.head(hidden, embedded)
+        # [B, ..., K, E] x hidden [B, ..., E] -> [B, ..., K]
+        return jnp.einsum("...e,...ke->...k", hidden, embedded)
+
+    def forward_inference(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        candidates_to_score: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Scores of the NEXT item after each sequence: [B, num_items] or [B, K]."""
+        hidden = self.body(feature_tensors, padding_mask, deterministic=True)
+        last_hidden = hidden[:, -1, :]
+        return self.get_logits(last_hidden, candidates_to_score)
+
+    def get_query_embeddings(
+        self, feature_tensors: TensorMap, padding_mask: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Last-position hidden state per query [B, E]."""
+        return self.body(feature_tensors, padding_mask, deterministic=True)[:, -1, :]

@@ -1,0 +1,90 @@
+"""SASRec encoder stacks.
+
+Capability parity with replay/nn/sequential/sasrec/transformer.py:10-110 (pre-LN
+multi-head attention + point-wise FFN blocks) and
+replay/nn/sequential/sasrec/diff_transformer.py:10-120 (Differential Transformer
+blocks with SwiGLU FFN).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from replay_tpu.nn.attention import MultiHeadAttention, MultiHeadDifferentialAttention, RMSNorm
+from replay_tpu.nn.ffn import PointWiseFeedForward, SwiGLU
+
+
+class SasRecTransformerLayer(nn.Module):
+    """N pre-LN blocks: LayerNorm → MHA → residual → LayerNorm → point-wise FFN."""
+
+    num_blocks: int
+    num_heads: int
+    hidden_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        attention_mask: jnp.ndarray,
+        padding_mask: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        keep = padding_mask[..., None].astype(x.dtype)
+        for i in range(self.num_blocks):
+            h = nn.LayerNorm(dtype=self.dtype, name=f"attn_norm_{i}")(x)
+            h = MultiHeadAttention(
+                num_heads=self.num_heads,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name=f"attention_{i}",
+            )(h, attention_mask, deterministic=deterministic)
+            x = x + h
+            h = nn.LayerNorm(dtype=self.dtype, name=f"ffn_norm_{i}")(x)
+            x = PointWiseFeedForward(
+                hidden_dim=self.hidden_dim,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name=f"ffn_{i}",
+            )(h, deterministic=deterministic)
+            x = x * keep  # zero out padded positions between blocks
+        return x
+
+
+class DiffTransformerLayer(nn.Module):
+    """N Differential-Transformer blocks: RMSNorm → DiffAttention → RMSNorm → SwiGLU."""
+
+    num_blocks: int
+    num_heads: int
+    hidden_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        attention_mask: jnp.ndarray,
+        padding_mask: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        keep = padding_mask[..., None].astype(x.dtype)
+        for i in range(self.num_blocks):
+            lambda_init = 0.8 - 0.6 * float(jnp.exp(-0.3 * i))
+            h = RMSNorm(dtype=self.dtype, name=f"attn_norm_{i}")(x)
+            h = MultiHeadDifferentialAttention(
+                num_heads=self.num_heads,
+                lambda_init=lambda_init,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name=f"attention_{i}",
+            )(h, attention_mask, deterministic=deterministic)
+            x = x + h
+            h = RMSNorm(dtype=self.dtype, name=f"ffn_norm_{i}")(x)
+            h = SwiGLU(self.hidden_dim, x.shape[-1], dtype=self.dtype, name=f"ffn_{i}")(h)
+            x = (x + nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)) * keep
+        return x

@@ -1,0 +1,71 @@
+"""Default per-split transform pipelines.
+
+Capability parity with replay/nn/transform/template/{sasrec,twotower}.py: train =
+next-token shift → rename masks → unsqueeze → group; val/test/predict = rename +
+group. A BERT4Rec MLM template (token-mask based) covers the legacy masking path
+(replay/models/nn/sequential/bert4rec/dataset.py:55).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from replay_tpu.data.nn.schema import TensorSchema
+
+from .transforms import (
+    GroupTransform,
+    NextTokenTransform,
+    RenameTransform,
+    TokenMaskTransform,
+    Transform,
+    UnsqueezeTransform,
+)
+
+
+def make_default_sasrec_transforms(tensor_schema: TensorSchema) -> Dict[str, List[Transform]]:
+    """Next-token-prediction pipelines keyed by split (train/validate/test/predict)."""
+    item_id = tensor_schema.item_id_feature_name
+    train = [
+        NextTokenTransform(label_name=item_id, shift=1),
+        RenameTransform({f"{item_id}_mask": "padding_mask", "positive_labels_mask": "target_padding_mask"}),
+        UnsqueezeTransform("target_padding_mask", -1),
+        UnsqueezeTransform("positive_labels", -1),
+        GroupTransform({"feature_tensors": list(tensor_schema.names)}),
+    ]
+    eval_pipeline = [
+        RenameTransform({f"{item_id}_mask": "padding_mask"}),
+        GroupTransform({"feature_tensors": list(tensor_schema.names)}),
+    ]
+    return {
+        "train": train,
+        "validate": list(eval_pipeline),
+        "test": list(eval_pipeline),
+        "predict": list(eval_pipeline),
+    }
+
+
+def make_default_twotower_transforms(tensor_schema: TensorSchema) -> Dict[str, List[Transform]]:
+    return make_default_sasrec_transforms(tensor_schema)
+
+
+def make_default_bert4rec_transforms(
+    tensor_schema: TensorSchema, mask_prob: float = 0.15
+) -> Dict[str, List[Transform]]:
+    """Masked-LM pipelines: targets are the items at masked positions."""
+    item_id = tensor_schema.item_id_feature_name
+    train = [
+        RenameTransform({f"{item_id}_mask": "padding_mask"}),
+        TokenMaskTransform(token_name="padding_mask", mask_prob=mask_prob),
+        UnsqueezeTransform("token_mask", -1),
+        GroupTransform({"feature_tensors": list(tensor_schema.names)}),
+    ]
+    eval_pipeline = [
+        RenameTransform({f"{item_id}_mask": "padding_mask"}),
+        GroupTransform({"feature_tensors": list(tensor_schema.names)}),
+    ]
+    return {
+        "train": train,
+        "validate": list(eval_pipeline),
+        "test": list(eval_pipeline),
+        "predict": list(eval_pipeline),
+    }

@@ -1,0 +1,356 @@
+"""Composable batch transforms over dict batches.
+
+Capability parity with replay/nn/transform/*.py (~830 LoC): NextToken, negative
+sampling (uniform + multi-class), TokenMask, SequenceRoll, Trim/AdaptiveTrim,
+EqualityMask, Copy, Rename, Select, Unsqueeze, Group, composed per split.
+
+JAX design: every transform is a pure callable ``batch, rng -> batch`` on jnp/numpy
+arrays (no module state); randomness comes from an explicit PRNG key threaded by
+:class:`Compose`. All ops are static-shape except ``AdaptiveTrimTransform``, which is
+host-side only (data-dependent length) and documented as such.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MASK_POSTFIX = "_mask"
+Batch = Dict[str, jnp.ndarray]
+
+
+class Transform:
+    """Base: a pure batch→batch function; ``needs_rng`` marks stochastic transforms."""
+
+    needs_rng = False
+
+    def __call__(self, batch: Batch, rng: Optional[jax.Array] = None) -> Batch:
+        raise NotImplementedError
+
+
+class Compose(Transform):
+    """Apply transforms in order, splitting the rng across the stochastic ones."""
+
+    def __init__(self, transforms: Sequence[Transform]) -> None:
+        self.transforms = list(transforms)
+
+    @property
+    def needs_rng(self) -> bool:  # type: ignore[override]
+        return any(t.needs_rng for t in self.transforms)
+
+    def __call__(self, batch: Batch, rng: Optional[jax.Array] = None) -> Batch:
+        for transform in self.transforms:
+            if transform.needs_rng:
+                if rng is None:
+                    msg = f"{type(transform).__name__} needs an rng key"
+                    raise ValueError(msg)
+                rng, sub = jax.random.split(rng)
+                batch = transform(batch, sub)
+            else:
+                batch = transform(batch)
+        return batch
+
+
+class NextTokenTransform(Transform):
+    """Shift ``label_name`` by ``shift`` to build ``positive_labels`` (+ its mask);
+    trim the last ``shift`` steps off every other sequence feature."""
+
+    def __init__(
+        self,
+        label_name: str,
+        shift: int = 1,
+        ignore: Union[List[str], str, None] = None,
+        out_feature_name: str = "positive_labels",
+        mask_postfix: str = DEFAULT_MASK_POSTFIX,
+    ) -> None:
+        self.label_name = label_name
+        self.shift = shift
+        self.ignore = [ignore] if isinstance(ignore, str) else list(ignore or [])
+        self.out_feature_name = out_feature_name
+        self.mask_postfix = mask_postfix
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        shift = self.shift
+        labels = batch[self.label_name][:, shift:]
+        label_mask_name = f"{self.label_name}{self.mask_postfix}"
+        out = {}
+        for name, value in batch.items():
+            if name in self.ignore or value.ndim < 2:
+                out[name] = value
+            else:
+                out[name] = value[:, :-shift]
+        out[self.out_feature_name] = labels
+        if label_mask_name in batch:
+            out[f"{self.out_feature_name}{self.mask_postfix}"] = batch[label_mask_name][:, shift:]
+        else:
+            out[f"{self.out_feature_name}{self.mask_postfix}"] = jnp.ones_like(labels, dtype=bool)
+        return out
+
+
+class UniformNegativeSamplingTransform(Transform):
+    """Sample ``num_negative_samples`` global negatives per batch (without replacement)."""
+
+    needs_rng = True
+
+    def __init__(
+        self,
+        cardinality: int,
+        num_negative_samples: int,
+        *,
+        out_feature_name: str = "negative_labels",
+        sample_distribution: Optional[jnp.ndarray] = None,
+    ) -> None:
+        if num_negative_samples >= cardinality:
+            msg = (
+                f"num_negative_samples ({num_negative_samples}) must be < cardinality "
+                f"({cardinality})"
+            )
+            raise ValueError(msg)
+        if sample_distribution is not None and sample_distribution.shape[-1] != cardinality:
+            msg = "sample_distribution size must match cardinality"
+            raise ValueError(msg)
+        self.cardinality = cardinality
+        self.num_negative_samples = num_negative_samples
+        self.out_feature_name = out_feature_name
+        self.sample_distribution = sample_distribution
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        if self.sample_distribution is None:
+            negatives = jax.random.choice(
+                rng, self.cardinality, shape=(self.num_negative_samples,), replace=False
+            )
+        else:
+            probs = self.sample_distribution / jnp.sum(self.sample_distribution)
+            negatives = jax.random.choice(
+                rng, self.cardinality, shape=(self.num_negative_samples,), replace=False, p=probs
+            )
+        return {**batch, self.out_feature_name: negatives}
+
+
+class MultiClassNegativeSamplingTransform(Transform):
+    """Per-row negatives sampled from class-conditional distributions.
+
+    ``class_assignment`` maps each item to a class; for each batch row the sampler
+    draws negatives from the items of the same class as the row's reference item
+    (reference: replay/nn/transform/negative_sampling.py:82).
+    """
+
+    needs_rng = True
+
+    def __init__(
+        self,
+        class_assignment: jnp.ndarray,  # [num_items] int class per item
+        num_negative_samples: int,
+        reference_name: str = "item_id",
+        out_feature_name: str = "negative_labels",
+    ) -> None:
+        self.class_assignment = jnp.asarray(class_assignment)
+        self.num_negative_samples = num_negative_samples
+        self.reference_name = reference_name
+        self.out_feature_name = out_feature_name
+        num_classes = int(self.class_assignment.max()) + 1
+        # class -> item one-hot weights used as sampling distributions
+        self._class_weights = jnp.stack(
+            [(self.class_assignment == c).astype(jnp.float32) for c in range(num_classes)]
+        )
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        reference = batch[self.reference_name]
+        last_items = reference[:, -1] if reference.ndim > 1 else reference
+        classes = self.class_assignment[jnp.clip(last_items, 0, self.class_assignment.shape[0] - 1)]
+        weights = self._class_weights[classes]  # [B, num_items]
+        keys = jax.random.split(rng, weights.shape[0])
+
+        def sample_row(key, w):
+            return jax.random.choice(
+                key, w.shape[0], shape=(self.num_negative_samples,), replace=True, p=w / jnp.sum(w)
+            )
+
+        negatives = jax.vmap(sample_row)(keys, weights)
+        return {**batch, self.out_feature_name: negatives}
+
+
+class TokenMaskTransform(Transform):
+    """BERT-style keep-mask: True = visible token, False = masked-out token.
+
+    Corner-case handling mirrors the reference (replay/nn/transform/token_mask.py:44):
+    a row with nothing masked gets its LAST valid token masked; a row with everything
+    masked gets its second-to-last position kept.
+    """
+
+    needs_rng = True
+
+    def __init__(
+        self,
+        token_name: str,
+        out_feature_name: str = "token_mask",
+        mask_prob: float = 0.15,
+        mask_postfix: str = DEFAULT_MASK_POSTFIX,
+    ) -> None:
+        self.token_name = token_name
+        self.out_feature_name = out_feature_name
+        self.mask_prob = mask_prob
+        self.mask_postfix = mask_postfix
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        padding = batch[self.token_name]
+        if padding.dtype != jnp.bool_:
+            msg = "Source tensor for token mask must be boolean (a padding mask)."
+            raise ValueError(msg)
+        uniform = jax.random.uniform(rng, padding.shape)
+        keep = (uniform * padding) >= self.mask_prob  # padded positions always False
+
+        valid_count = padding.sum(axis=1)
+        kept_count = (keep & padding).sum(axis=1)
+        # nothing masked -> mask the last valid position
+        all_kept = kept_count == valid_count
+        last_valid = padding.shape[1] - 1 - jnp.argmax(padding[:, ::-1], axis=1)
+        rows = jnp.arange(padding.shape[0])
+        keep = keep.at[rows, last_valid].set(
+            jnp.where(all_kept, False, keep[rows, last_valid])
+        )
+        # everything masked -> keep the position before the last valid one
+        none_kept = (kept_count == 0) & (valid_count > 1)
+        before_last = jnp.maximum(last_valid - 1, 0)
+        keep = keep.at[rows, before_last].set(
+            jnp.where(none_kept, True, keep[rows, before_last])
+        )
+        return {**batch, self.out_feature_name: keep}
+
+
+class SequenceRollTransform(Transform):
+    """Roll a sequence along the time axis, refilling the vacated slots with padding."""
+
+    def __init__(self, feature_name: str, roll: int = 1, padding_value: int = 0) -> None:
+        if roll == 0:
+            msg = "roll must be non-zero"
+            raise ValueError(msg)
+        self.feature_name = feature_name
+        self.roll = roll
+        self.padding_value = padding_value
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        rolled = jnp.roll(batch[self.feature_name], self.roll, axis=1)
+        if self.roll > 0:
+            rolled = rolled.at[:, : self.roll].set(self.padding_value)
+        else:
+            rolled = rolled.at[:, self.roll :].set(self.padding_value)
+        return {**batch, self.feature_name: rolled}
+
+
+class TrimTransform(Transform):
+    """Keep the LAST ``seq_len`` positions of the named (left-padded) sequences."""
+
+    def __init__(self, seq_len: int, feature_names: Union[List[str], str]) -> None:
+        self.seq_len = seq_len
+        self.feature_names = [feature_names] if isinstance(feature_names, str) else list(feature_names)
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        out = dict(batch)
+        for name in self.feature_names:
+            if batch[name].shape[1] < self.seq_len:
+                msg = f"Cannot trim '{name}' of length {batch[name].shape[1]} to {self.seq_len}"
+                raise ValueError(msg)
+            out[name] = batch[name][:, -self.seq_len :]
+        return out
+
+
+class AdaptiveTrimTransform(Transform):
+    """Trim to the batch's longest real sequence. HOST-ONLY: data-dependent shape,
+    do not use inside jit (reference: replay/nn/transform/trim.py:50)."""
+
+    def __init__(self, feature_names: Union[List[str], str], padding_mask_name: str = "padding_mask") -> None:
+        self.feature_names = [feature_names] if isinstance(feature_names, str) else list(feature_names)
+        self.padding_mask_name = padding_mask_name
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        if self.padding_mask_name not in batch:
+            msg = f"Padding mask '{self.padding_mask_name}' not found in batch."
+            raise KeyError(msg)
+        mask = batch[self.padding_mask_name]
+        max_len = int(mask.sum(axis=1).max())
+        if max_len == mask.shape[1]:
+            return batch
+        out = dict(batch)
+        for name in self.feature_names:
+            out[name] = batch[name][:, -max_len:]
+        return out
+
+
+class EqualityMaskTransform(Transform):
+    """Combine ``mask_name`` with (feature == value) under AND/OR/XOR."""
+
+    _OPS = {
+        "and": jnp.logical_and,
+        "or": jnp.logical_or,
+        "xor": jnp.logical_xor,
+    }
+
+    def __init__(self, feature_name: str, mask_name: str, equality_value, op: str = "and") -> None:
+        if op not in self._OPS:
+            msg = f"op must be one of {sorted(self._OPS)}"
+            raise ValueError(msg)
+        self.feature_name = feature_name
+        self.mask_name = mask_name
+        self.equality_value = equality_value
+        self.op = op
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        modification = batch[self.feature_name] == self.equality_value
+        combined = self._OPS[self.op](batch[self.mask_name], modification)
+        return {**batch, self.mask_name: combined}
+
+
+class CopyTransform(Transform):
+    def __init__(self, mapping: Dict[str, str]) -> None:
+        self.mapping = mapping
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        out = dict(batch)
+        for src, dst in self.mapping.items():
+            out[dst] = batch[src]
+        return out
+
+
+class RenameTransform(Transform):
+    def __init__(self, mapping: Dict[str, str]) -> None:
+        self.mapping = mapping
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        out = {}
+        for name, value in batch.items():
+            out[self.mapping.get(name, name)] = value
+        return out
+
+
+class SelectTransform(Transform):
+    def __init__(self, feature_names: List[str]) -> None:
+        self.feature_names = list(feature_names)
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        return {name: batch[name] for name in self.feature_names}
+
+
+class UnsqueezeTransform(Transform):
+    def __init__(self, feature_name: str, axis: int = -1) -> None:
+        self.feature_name = feature_name
+        self.axis = axis
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        return {**batch, self.feature_name: jnp.expand_dims(batch[self.feature_name], self.axis)}
+
+
+class GroupTransform(Transform):
+    """Nest the named features under a sub-dict key (e.g. ``feature_tensors``)."""
+
+    def __init__(self, mapping: Dict[str, List[str]]) -> None:
+        self.mapping = mapping
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        grouped_names = {name for names in self.mapping.values() for name in names}
+        out = {name: value for name, value in batch.items() if name not in grouped_names}
+        for group, names in self.mapping.items():
+            out[group] = {name: batch[name] for name in names if name in batch}
+        return out

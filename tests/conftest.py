@@ -6,7 +6,9 @@ sharding paths are validated on a host-only mesh, no TPUs required.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the session env pins JAX_PLATFORMS=axon (the one real TPU); tests must
+# never contend for that tunnel — they run on an 8-device virtual CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+from replay_tpu.data import FeatureHint, FeatureSource, FeatureType
+from replay_tpu.data.nn import TensorFeatureInfo, TensorFeatureSource, TensorSchema
+
+NUM_ITEMS = 20
+SEQ_LEN = 8
+BATCH = 4
+
+
+@pytest.fixture
+def tensor_schema() -> TensorSchema:
+    return TensorSchema(
+        [
+            TensorFeatureInfo(
+                "item_id",
+                FeatureType.CATEGORICAL,
+                is_seq=True,
+                feature_hint=FeatureHint.ITEM_ID,
+                feature_sources=[TensorFeatureSource(FeatureSource.INTERACTIONS, "item_id")],
+                cardinality=NUM_ITEMS,
+                padding_value=NUM_ITEMS,
+                embedding_dim=16,
+            ),
+            TensorFeatureInfo(
+                "cat_feature",
+                FeatureType.CATEGORICAL,
+                is_seq=True,
+                cardinality=5,
+                padding_value=5,
+                embedding_dim=16,
+            ),
+            TensorFeatureInfo(
+                "num_feature",
+                FeatureType.NUMERICAL,
+                is_seq=True,
+                tensor_dim=1,
+                embedding_dim=16,
+            ),
+        ]
+    )
+
+
+@pytest.fixture
+def item_only_schema() -> TensorSchema:
+    return TensorSchema(
+        TensorFeatureInfo(
+            "item_id",
+            FeatureType.CATEGORICAL,
+            is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID,
+            cardinality=NUM_ITEMS,
+            padding_value=NUM_ITEMS,
+            embedding_dim=16,
+        )
+    )
+
+
+@pytest.fixture
+def batch(rng):
+    lengths = rng.integers(2, SEQ_LEN + 1, size=BATCH)
+    items = np.full((BATCH, SEQ_LEN), NUM_ITEMS, dtype=np.int64)  # left-padded
+    for b, n in enumerate(lengths):
+        items[b, SEQ_LEN - n :] = rng.integers(0, NUM_ITEMS, size=n)
+    padding_mask = items != NUM_ITEMS
+    features = {
+        "item_id": items,
+        "cat_feature": np.where(padding_mask, rng.integers(0, 5, size=items.shape), 5),
+        "num_feature": rng.normal(size=(BATCH, SEQ_LEN)).astype(np.float32),
+    }
+    return features, padding_mask

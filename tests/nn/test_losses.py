@@ -1,0 +1,147 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replay_tpu.nn.loss import (
+    BCE,
+    CE,
+    BCESampled,
+    CESampled,
+    CESampledWeighted,
+    CEWeighted,
+    LogInCE,
+    LogInCESampled,
+    LogOutCE,
+    LogOutCEWeighted,
+    SCEParams,
+    ScalableCrossEntropyLoss,
+)
+
+B, L, E, I = 2, 4, 8, 12
+RNG = np.random.default_rng(0)
+EMB = jnp.asarray(RNG.normal(size=(B, L, E)), dtype=jnp.float32)
+ITEMS = jnp.asarray(RNG.normal(size=(I, E)), dtype=jnp.float32)
+POS = jnp.asarray(RNG.integers(0, I, size=(B, L, 1)))
+NEG = jnp.asarray(RNG.integers(0, I, size=(5,)))
+PAD = jnp.asarray([[True] * L, [False, False, True, True]])
+TGT = PAD[..., None]
+
+
+def full_logits_callback(embeddings, ids=None):
+    if ids is None:
+        return embeddings @ ITEMS.T
+    if ids.ndim == 1:
+        return embeddings @ ITEMS[ids].T
+    return jnp.einsum("...e,...ke->...k", embeddings, ITEMS[ids])
+
+
+def make(loss):
+    loss.logits_callback = full_logits_callback
+    return loss
+
+
+def call(loss, pos=POS, neg=NEG, tgt=TGT):
+    return loss(EMB, {}, pos, neg, PAD, tgt)
+
+
+def test_ce_matches_manual():
+    loss = make(CE())
+    value = call(loss)
+    logits = np.asarray(full_logits_callback(EMB))
+    log_probs = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    manual = []
+    for b in range(B):
+        for t in range(L):
+            if bool(PAD[b, t]):
+                manual.append(-log_probs[b, t, int(POS[b, t, 0])])
+    assert float(value) == pytest.approx(float(np.mean(manual)), rel=1e-4)
+
+
+def test_ce_multipositive_rejected():
+    loss = make(CE())
+    with pytest.raises(NotImplementedError):
+        call(loss, pos=jnp.zeros((B, L, 2), dtype=jnp.int32), tgt=jnp.ones((B, L, 2), dtype=bool))
+
+
+def test_ce_weighted_changes_value():
+    base = call(make(CE()))
+    weights = jnp.ones(I).at[int(POS[0, 0, 0])].set(10.0)
+    weighted = call(make(CEWeighted(weights)))
+    assert float(base) != pytest.approx(float(weighted))
+
+
+def test_ce_sampled_all_negative_shapes():
+    loss = make(CESampled())
+    v1 = call(loss, neg=NEG)  # [N]
+    v2 = call(loss, neg=jnp.broadcast_to(NEG, (B, 5)))  # [B, N]
+    v3 = call(loss, neg=jnp.broadcast_to(NEG, (B, L, 5)))  # [B, L, N]
+    assert float(v1) == pytest.approx(float(v2), rel=1e-5)
+    assert float(v1) == pytest.approx(float(v3), rel=1e-5)
+
+
+def test_ce_sampled_ignore_index():
+    loss = make(CESampled())
+    padded_negs = jnp.concatenate([NEG, jnp.array([-100, -100])])
+    v_padded = call(loss, neg=padded_negs)
+    v_plain = call(loss, neg=NEG)
+    assert float(v_padded) == pytest.approx(float(v_plain), rel=1e-5)
+
+
+def test_ce_sampled_multipositive():
+    pos2 = jnp.asarray(RNG.integers(0, I, size=(B, L, 3)))
+    tgt2 = jnp.broadcast_to(PAD[..., None], (B, L, 3))
+    value = call(make(CESampled()), pos=pos2, tgt=tgt2)
+    assert np.isfinite(float(value))
+
+
+def test_ce_sampled_weighted():
+    weights = jnp.linspace(0.1, 2.0, I)
+    value = call(make(CESampledWeighted(weights)))
+    assert np.isfinite(float(value))
+
+
+def test_bce_losses():
+    assert np.isfinite(float(call(make(BCE()))))
+    assert np.isfinite(float(call(make(BCESampled()))))
+
+
+def test_login_ce():
+    full = call(make(LogInCE(cardinality=I)))
+    sampled = call(make(LogInCESampled()))
+    assert np.isfinite(float(full)) and np.isfinite(float(sampled))
+    # sampled negatives are a subset of the catalog -> lower or equal denominator
+    assert float(sampled) <= float(full) + 1e-4
+
+
+def test_logout_ce():
+    value = call(make(LogOutCE(cardinality=I)))
+    assert np.isfinite(float(value))
+    weighted = call(make(LogOutCEWeighted(cardinality=I, weight=jnp.ones(I))))
+    assert float(weighted) == pytest.approx(float(value), rel=1e-5)
+
+
+def test_logout_ce_single_positive_close_to_ce():
+    # with P=1, logout-CE only removes the positive itself from the negatives pool
+    ce = float(call(make(CE())))
+    lo = float(call(make(LogOutCE(cardinality=I))))
+    assert lo < ce  # removing the positive from the denominator lowers the loss
+
+
+def test_sce_loss():
+    sce = ScalableCrossEntropyLoss(SCEParams(n_buckets=4, bucket_size_x=4, bucket_size_y=6))
+    value = sce(
+        EMB,
+        POS[..., 0],
+        ITEMS,
+        PAD,
+        rng=jax.random.PRNGKey(0),
+    )
+    assert np.isfinite(float(value))
+    assert float(value) > 0
+
+
+def test_missing_callback_raises():
+    loss = CE()
+    with pytest.raises(AttributeError):
+        _ = loss.logits_callback

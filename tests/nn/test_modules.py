@@ -1,0 +1,162 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replay_tpu.nn import (
+    CategoricalEmbedding,
+    CategoricalListEmbedding,
+    ConcatAggregator,
+    EmbeddingTyingHead,
+    MultiHeadAttention,
+    MultiHeadDifferentialAttention,
+    PointWiseFeedForward,
+    PositionAwareAggregator,
+    SequenceEmbedding,
+    SumAggregator,
+    SwiGLUEncoder,
+    bidirectional_attention_mask,
+    causal_attention_mask,
+    padding_mask_from_ids,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_categorical_embedding_and_item_weights():
+    module = CategoricalEmbedding(cardinality=10, embedding_dim=8, padding_value=10)
+    variables = module.init(KEY, jnp.zeros((2, 3), dtype=jnp.int32))
+    out = module.apply(variables, jnp.array([[0, 9, 10]]))
+    assert out.shape == (1, 3, 8)
+    weights = module.apply(variables, method=CategoricalEmbedding.item_weights)
+    assert weights.shape == (10, 8)
+    table = variables["params"]["table"]["embedding"]
+    np.testing.assert_allclose(weights, table[:10])
+
+
+def test_categorical_list_embedding_pooling():
+    for pooling in ("sum", "mean", "max"):
+        module = CategoricalListEmbedding(cardinality=6, embedding_dim=4, padding_value=6, pooling=pooling)
+        ids = jnp.array([[[0, 1, 6], [6, 6, 6]]])  # [B=1, L=2, list=3]
+        variables = module.init(KEY, ids)
+        out = module.apply(variables, ids)
+        assert out.shape == (1, 2, 4)
+        # fully-padded list position embeds to zero for sum/mean/max
+        np.testing.assert_allclose(out[0, 1], np.zeros(4), atol=1e-6)
+
+
+def test_sequence_embedding(tensor_schema, batch):
+    features, _ = batch
+    module = SequenceEmbedding(schema=tensor_schema)
+    variables = module.init(KEY, features)
+    out = module.apply(variables, features)
+    assert set(out) == {"item_id", "cat_feature", "num_feature"}
+    assert all(v.shape == (4, 8, 16) for v in out.values())
+    item_w = module.apply(variables, method=SequenceEmbedding.get_item_weights)
+    assert item_w.shape == (20, 16)
+
+
+def test_aggregators(tensor_schema, batch):
+    features, _ = batch
+    emb = SequenceEmbedding(schema=tensor_schema)
+    variables = emb.init(KEY, features)
+    embedded = emb.apply(variables, features)
+
+    agg = SumAggregator()
+    out = agg.apply(agg.init(KEY, embedded), embedded)
+    assert out.shape == (4, 8, 16)
+
+    cat = ConcatAggregator(output_dim=16)
+    out = cat.apply(cat.init(KEY, embedded), embedded)
+    assert out.shape == (4, 8, 16)
+
+    pos = PositionAwareAggregator(embedding_dim=16, max_sequence_length=8, dropout_rate=0.5)
+    out_det = pos.apply(pos.init(KEY, embedded), embedded, deterministic=True)
+    assert out_det.shape == (4, 8, 16)
+    out_rng = pos.apply(
+        pos.init(KEY, embedded), embedded, deterministic=False, rngs={"dropout": KEY}
+    )
+    assert not np.allclose(out_det, out_rng)
+
+
+def test_positional_table_tail():
+    # shorter sequences use the TAIL of the positional table
+    emb = {"x": jnp.ones((1, 3, 4))}
+    pos = PositionAwareAggregator(embedding_dim=4, max_sequence_length=10)
+    variables = pos.init(KEY, emb)
+    out = pos.apply(variables, emb)
+    table = variables["params"]["positional_embedding"]
+    expected = jnp.ones((1, 3, 4)) * 2.0 + table[7:]
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_causal_mask_semantics():
+    padding = jnp.array([[False, True, True]])
+    mask = causal_attention_mask(padding, deterministic=False)
+    assert mask.shape == (1, 1, 3, 3)
+    m = np.asarray(mask[0, 0])
+    assert m[1, 2] == -np.inf  # future masked
+    assert m[1, 1] == 0  # self allowed
+    assert m[2, 1] == 0  # past allowed
+    assert m[1, 0] == -np.inf  # padded key masked
+    assert m[0, 0] == 0  # diagonal rescue on padded row
+    eval_mask = causal_attention_mask(padding, deterministic=True)
+    assert np.asarray(eval_mask[0, 0])[1, 2] == np.finfo(np.float32).min
+
+
+def test_bidirectional_mask():
+    padding = jnp.array([[False, True, True]])
+    mask = bidirectional_attention_mask(padding, deterministic=False)
+    m = np.asarray(mask[0, 0])
+    assert m[1, 2] == 0  # future allowed
+    assert m[1, 0] == -np.inf  # padding masked
+
+
+def test_mha_respects_mask():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 5, 16)), dtype=jnp.float32)
+    padding = jnp.ones((2, 5), dtype=bool)
+    mask = causal_attention_mask(padding)
+    module = MultiHeadAttention(num_heads=2)
+    variables = module.init(KEY, x, mask)
+    out = module.apply(variables, x, mask)
+    assert out.shape == (2, 5, 16)
+    # causality: output at position 0 must not change when future positions change
+    x2 = x.at[:, 3:].set(0.0)
+    out2 = module.apply(variables, x2, mask)
+    np.testing.assert_allclose(out[:, :3], out2[:, :3], atol=1e-5)
+
+
+def test_diff_attention_shapes():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 5, 16)), dtype=jnp.float32)
+    mask = causal_attention_mask(jnp.ones((2, 5), dtype=bool))
+    module = MultiHeadDifferentialAttention(num_heads=2)
+    variables = module.init(KEY, x, mask)
+    out = module.apply(variables, x, mask)
+    assert out.shape == (2, 5, 16)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_ffn_and_swiglu():
+    x = jnp.ones((2, 3, 8))
+    ffn = PointWiseFeedForward(hidden_dim=16)
+    out = ffn.apply(ffn.init(KEY, x), x)
+    assert out.shape == x.shape
+    enc = SwiGLUEncoder(num_blocks=2, hidden_dim=16, output_dim=4)
+    out = enc.apply(enc.init(KEY, x), x)
+    assert out.shape == (2, 3, 4)
+
+
+def test_tying_head_dispatch():
+    head = EmbeddingTyingHead()
+    hidden_ble = jnp.ones((2, 3, 4))
+    items = jnp.ones((7, 4))
+    assert head(hidden_ble, items).shape == (2, 3, 7)
+    hidden_be = jnp.ones((2, 4))
+    per_query = jnp.ones((2, 5, 4))
+    assert head(hidden_be, per_query).shape == (2, 5)
+    assert head(hidden_ble, jnp.ones((2, 3, 4))).shape == (2, 3)
+
+
+def test_padding_mask_from_ids():
+    ids = jnp.array([[3, 0, 1]])
+    np.testing.assert_array_equal(padding_mask_from_ids(ids, 0), [[True, False, True]])
