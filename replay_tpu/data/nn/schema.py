@@ -53,7 +53,7 @@ class TensorFeatureInfo:
         feature_hint: Optional[FeatureHint] = None,
         feature_sources: Optional[List[TensorFeatureSource]] = None,
         cardinality: Optional[int] = None,
-        padding_value: int = 0,
+        padding_value: Optional[int] = None,
         embedding_dim: Optional[int] = None,
         tensor_dim: Optional[int] = None,
     ) -> None:
@@ -80,7 +80,20 @@ class TensorFeatureInfo:
     feature_type = property(lambda self: self._feature_type)
     is_seq = property(lambda self: self._is_seq)
     feature_hint = property(lambda self: self._feature_hint)
-    padding_value = property(lambda self: self._padding_value)
+
+    @property
+    def padding_value(self) -> int:
+        """Padding id of this feature.
+
+        Defaults to ``cardinality`` for categorical features (the embedding layer
+        reserves the LAST table row for padding so item ids align with logit
+        columns — see replay_tpu/nn/embedding.py) and to 0 otherwise.
+        """
+        if self._padding_value is not None:
+            return self._padding_value
+        if self.is_cat and self.cardinality is not None:
+            return self.cardinality
+        return 0
 
     @property
     def feature_sources(self) -> Optional[List[TensorFeatureSource]]:
@@ -172,7 +185,7 @@ class TensorFeatureInfo:
             if sources
             else None,
             cardinality=data.get("cardinality") if feature_type.is_categorical else None,
-            padding_value=data.get("padding_value", 0),
+            padding_value=data.get("padding_value"),
             embedding_dim=data.get("embedding_dim") if feature_type.is_categorical else None,
             tensor_dim=data.get("tensor_dim") if not feature_type.is_categorical else None,
         )

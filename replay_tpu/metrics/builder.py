@@ -126,10 +126,8 @@ class MetricsBuilder:
 
     def reset(self) -> None:
         self._sums: Dict[str, jnp.ndarray] = {}
-        self._count = 0
-        self._seen_items = (
-            jnp.zeros(self._item_count, dtype=bool) if self._need_coverage else None
-        )
+        self._count = jnp.zeros((), dtype=jnp.int32)
+        self._coverage: Dict[str, jnp.ndarray] = {}
 
     def add_prediction(self, predictions, ground_truth, train=None) -> None:
         """Accumulate one batch.
@@ -150,29 +148,36 @@ class MetricsBuilder:
         if self._need_coverage:
             for k in self._ks:
                 bitmap = _coverage_bitmap(predictions, k, self._item_count)
-                key = f"__coverage_map@{k}"
-                prev = self._sums.get(key)
-                self._sums[key] = bitmap if prev is None else (prev | bitmap)
-        self._count += predictions.shape[0]
+                key = f"coverage@{k}"
+                prev = self._coverage.get(key)
+                self._coverage[key] = bitmap if prev is None else (prev | bitmap)
+        self._count = self._count + predictions.shape[0]
 
     # -- distributed seam --------------------------------------------------
     def state(self) -> dict:
-        """Accumulated sums + user count as a pytree (psum-able across hosts)."""
-        return {"sums": dict(self._sums), "count": self._count}
+        """Accumulated state as a pytree of jnp arrays, safe to ``jax.lax.psum``.
+
+        ``sums`` and ``count`` are additive. ``coverage`` entries are boolean
+        item-presence bitmaps: psum turns them into per-item multiplicities, which
+        :meth:`load_state` collapses back to booleans (``!= 0``) so items seen on
+        several hosts are not double-counted.
+        """
+        return {"sums": dict(self._sums), "count": self._count, "coverage": dict(self._coverage)}
 
     def load_state(self, state: dict) -> None:
         self._sums = dict(state["sums"])
-        self._count = int(state["count"])
+        self._count = jnp.asarray(state["count"], dtype=jnp.int32)
+        self._coverage = {
+            key: jnp.asarray(value) != 0 for key, value in state.get("coverage", {}).items()
+        }
 
     def get_metrics(self) -> Mapping[str, float]:
         """Mean per-user metrics (+ coverage fraction) accumulated so far."""
         out: Dict[str, float] = {}
         for name, value in self._sums.items():
-            if name.startswith("__coverage_map@"):
-                k = name.split("@")[1]
-                out[f"coverage@{k}"] = float(jnp.sum(value)) / float(self._item_count)
-            else:
-                out[name] = float(value) / max(self._count, 1)
+            out[name] = float(value) / max(float(self._count), 1.0)
+        for name, bitmap in self._coverage.items():
+            out[name] = float(jnp.sum(bitmap != 0)) / float(self._item_count)
         return dict(sorted(out.items()))
 
 

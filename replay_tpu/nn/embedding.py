@@ -11,15 +11,12 @@ is configurable (bfloat16-friendly), parameters stay float32.
 
 from __future__ import annotations
 
-import logging
 from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from replay_tpu.data.nn.schema import TensorFeatureInfo, TensorMap, TensorSchema
-
-logger = logging.getLogger("replay_tpu")
 
 
 class CategoricalEmbedding(nn.Module):
@@ -42,16 +39,24 @@ class CategoricalEmbedding(nn.Module):
         return self.table(ids)
 
     def item_weights(self) -> jnp.ndarray:
-        """All non-padding rows of the table, aligned with item ids [0, cardinality)."""
+        """All non-padding rows of the table, aligned with item ids [0, cardinality).
+
+        Requires ``padding_value == cardinality`` (the LAST table row is the padding
+        row, like the reference model's padding_idx — see
+        replay/nn/sequential/sasrec/model.py:62). Any other padding value would make
+        full-catalog logit column ``i`` correspond to a different table row than item
+        id ``i``, silently scoring the wrong items in every loss and in
+        ``forward_inference`` — so it is an error here, not a warning.
+        """
         if self.padding_value != self.cardinality:
-            logger.warning(
-                "padding_value (%d) != cardinality (%d); item weights are the rows "
-                "excluding the padding row, which re-indexes ids above the padding value.",
-                self.padding_value,
-                self.cardinality,
+            msg = (
+                f"Weight tying requires padding_value == cardinality "
+                f"({self.cardinality}), got {self.padding_value}: with any other "
+                "padding row, logit columns would misalign with item ids. Set "
+                f"padding_value={self.cardinality} on the ITEM_ID tensor feature "
+                "(the sequence tokenizer does this by default)."
             )
-            keep = [i for i in range(self.cardinality + 1) if i != self.padding_value]
-            return self.table.embedding[jnp.array(keep)]
+            raise ValueError(msg)
         return self.table.embedding[: self.cardinality]
 
 

@@ -25,8 +25,9 @@ from .transforms import (
 def make_default_sasrec_transforms(tensor_schema: TensorSchema) -> Dict[str, List[Transform]]:
     """Next-token-prediction pipelines keyed by split (train/validate/test/predict)."""
     item_id = tensor_schema.item_id_feature_name
+    sequential = [f.name for f in tensor_schema.all_features if f.is_seq]
     train = [
-        NextTokenTransform(label_name=item_id, shift=1),
+        NextTokenTransform(label_name=item_id, shift=1, apply_to=sequential),
         RenameTransform({f"{item_id}_mask": "padding_mask", "positive_labels_mask": "target_padding_mask"}),
         UnsqueezeTransform("target_padding_mask", -1),
         UnsqueezeTransform("positive_labels", -1),

@@ -55,21 +55,44 @@ class Compose(Transform):
 
 class NextTokenTransform(Transform):
     """Shift ``label_name`` by ``shift`` to build ``positive_labels`` (+ its mask);
-    trim the last ``shift`` steps off every other sequence feature."""
+    trim the last ``shift`` steps off the declared sequential features.
+
+    ``apply_to`` names the sequential features (and their masks) to trim — only
+    those are touched, so non-sequence [B, N] tensors such as sampled
+    ``negative_labels`` pass through untouched (the reference trims only schema
+    sequential features). When ``apply_to`` is None every ndim>=2 tensor not in
+    ``ignore`` is trimmed, which is only safe if the batch holds nothing but
+    sequences.
+    """
 
     def __init__(
         self,
         label_name: str,
         shift: int = 1,
         ignore: Union[List[str], str, None] = None,
+        apply_to: Union[List[str], str, None] = None,
         out_feature_name: str = "positive_labels",
         mask_postfix: str = DEFAULT_MASK_POSTFIX,
     ) -> None:
         self.label_name = label_name
         self.shift = shift
         self.ignore = [ignore] if isinstance(ignore, str) else list(ignore or [])
+        if apply_to is not None:
+            apply_to = [apply_to] if isinstance(apply_to, str) else list(apply_to)
+            # trim a feature's mask together with the feature
+            apply_to = list(
+                dict.fromkeys(apply_to + [f"{name}{mask_postfix}" for name in apply_to])
+            )
+        self.apply_to = apply_to
         self.out_feature_name = out_feature_name
         self.mask_postfix = mask_postfix
+
+    def _should_trim(self, name: str, value) -> bool:
+        if name in self.ignore or value.ndim < 2:
+            return False
+        if self.apply_to is not None:
+            return name in self.apply_to
+        return True
 
     def __call__(self, batch: Batch, rng=None) -> Batch:
         shift = self.shift
@@ -77,10 +100,7 @@ class NextTokenTransform(Transform):
         label_mask_name = f"{self.label_name}{self.mask_postfix}"
         out = {}
         for name, value in batch.items():
-            if name in self.ignore or value.ndim < 2:
-                out[name] = value
-            else:
-                out[name] = value[:, :-shift]
+            out[name] = value[:, :-shift] if self._should_trim(name, value) else value
         out[self.out_feature_name] = labels
         if label_mask_name in batch:
             out[f"{self.out_feature_name}{self.mask_postfix}"] = batch[label_mask_name][:, shift:]
@@ -146,29 +166,42 @@ class MultiClassNegativeSamplingTransform(Transform):
         reference_name: str = "item_id",
         out_feature_name: str = "negative_labels",
     ) -> None:
+        import numpy as np
+
+        class_assignment = np.asarray(class_assignment)
         self.class_assignment = jnp.asarray(class_assignment)
         self.num_negative_samples = num_negative_samples
         self.reference_name = reference_name
         self.out_feature_name = out_feature_name
-        num_classes = int(self.class_assignment.max()) + 1
-        # class -> item one-hot weights used as sampling distributions
-        self._class_weights = jnp.stack(
-            [(self.class_assignment == c).astype(jnp.float32) for c in range(num_classes)]
-        )
+        # per-class item-id index lists padded to the largest class: sampling draws a
+        # random index into the class's list instead of materializing a [B, num_items]
+        # probability matrix (which would blow up memory on large catalogs)
+        num_classes = int(class_assignment.max()) + 1
+        members = [np.flatnonzero(class_assignment == c) for c in range(num_classes)]
+        empty = [c for c, m in enumerate(members) if len(m) == 0]
+        if empty:
+            msg = (
+                f"class_assignment has empty classes {empty}: every draw for such a "
+                "class would silently return item 0. Use contiguous class ids."
+            )
+            raise ValueError(msg)
+        sizes = np.array([len(m) for m in members], dtype=np.int32)
+        table = np.zeros((num_classes, int(sizes.max())), dtype=np.int32)
+        for c, m in enumerate(members):
+            if len(m):
+                table[c, : len(m)] = m
+        self._class_items = jnp.asarray(table)  # [num_classes, max_class_size]
+        self._class_sizes = jnp.asarray(sizes)  # [num_classes]
 
     def __call__(self, batch: Batch, rng=None) -> Batch:
         reference = batch[self.reference_name]
         last_items = reference[:, -1] if reference.ndim > 1 else reference
         classes = self.class_assignment[jnp.clip(last_items, 0, self.class_assignment.shape[0] - 1)]
-        weights = self._class_weights[classes]  # [B, num_items]
-        keys = jax.random.split(rng, weights.shape[0])
-
-        def sample_row(key, w):
-            return jax.random.choice(
-                key, w.shape[0], shape=(self.num_negative_samples,), replace=True, p=w / jnp.sum(w)
-            )
-
-        negatives = jax.vmap(sample_row)(keys, weights)
+        draws = jax.random.randint(
+            rng, (classes.shape[0], self.num_negative_samples), 0, jnp.iinfo(jnp.int32).max
+        )
+        indices = draws % self._class_sizes[classes][:, None]
+        negatives = jnp.take_along_axis(self._class_items[classes], indices, axis=1)
         return {**batch, self.out_feature_name: negatives}
 
 

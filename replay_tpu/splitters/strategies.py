@@ -119,9 +119,14 @@ class TimeSplitter(Splitter):
     def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
         ts = interactions[self.timestamp_column]
         if isinstance(self.time_threshold, float):
-            # reference semantics: threshold = timestamp at row int(n * (1 - ratio)) when sorted
+            # threshold = timestamp at row int(n * (1 - ratio)) when sorted; ratio 0.0
+            # lands past the end and yields an empty test split instead of crashing
             ordered = ts.sort_values(kind="stable")
-            threshold = ordered.iloc[int(len(ordered) * (1 - self.time_threshold))]
+            position = int(len(ordered) * (1 - self.time_threshold))
+            if position >= len(ordered):
+                # ratio 0.0: nothing is recent enough -> empty test split
+                return np.zeros(len(ts), dtype=bool)
+            threshold = ordered.iloc[position]
             return (ts >= threshold).to_numpy()
         threshold = self.time_threshold
         if np.issubdtype(ts.dtype, np.datetime64):
@@ -266,8 +271,15 @@ class RandomSplitter(Splitter):
         self.seed = seed
 
     def _test_mask(self, interactions: pd.DataFrame) -> np.ndarray:
-        train_idx = interactions.sample(frac=1 - self.test_size, random_state=self.seed).index
-        return (~interactions.index.isin(train_idx)).astype(bool)
+        # positional mask: index-label based membership over-selects when the frame
+        # carries duplicate index labels (common after concat)
+        n = len(interactions)
+        rng = np.random.default_rng(self.seed)
+        order = rng.permutation(n)
+        n_train = round(n * (1 - self.test_size))
+        mask = np.ones(n, dtype=bool)
+        mask[order[:n_train]] = False
+        return mask
 
 
 class ColdUserRandomSplitter(Splitter):

@@ -2,9 +2,44 @@
 
 Mirrors the reference's trick of faking torch.distributed (SURVEY.md §4): the multi-chip
 sharding paths are validated on a host-only mesh, no TPUs required.
+
+The environment injects a TPU-relay PJRT plugin into every interpreter via
+``PYTHONPATH`` sitecustomize; its registration serializes on the single TPU grant and
+can block for minutes. Tests must never contend for that tunnel, so if the plugin's
+site dir is on PYTHONPATH we re-exec pytest once with a cleaned environment.
 """
 
 import os
+import sys
+
+
+def pytest_configure(config):
+    """Re-exec pytest with a cleaned environment if the TPU-relay site dir is active.
+
+    Runs in ``pytest_configure`` (not at import) so the capture manager exists and can
+    restore the real stdout/stderr fds before ``execve`` — otherwise the child writes
+    into the dead parent's capture temp file and all output vanishes.
+    """
+    if ".axon_site" not in os.environ.get("PYTHONPATH", "") or os.environ.get(
+        "REPLAY_TPU_CLEAN_REEXEC"
+    ):
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    clean_pythonpath = os.pathsep.join(
+        [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p and ".axon_site" not in p]
+        + [repo_root]
+    )
+    env = {
+        **os.environ,
+        "PYTHONPATH": clean_pythonpath,
+        "REPLAY_TPU_CLEAN_REEXEC": "1",
+        "JAX_PLATFORMS": "cpu",
+    }
+    args = list(config.invocation_params.args)
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *args], env)
 
 # FORCE cpu: the session env pins JAX_PLATFORMS=axon (the one real TPU); tests must
 # never contend for that tunnel — they run on an 8-device virtual CPU platform.
@@ -52,3 +87,20 @@ def dataset(feature_schema, interactions_pandas) -> Dataset:
 @pytest.fixture
 def rng() -> np.random.Generator:
     return np.random.default_rng(0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-mark tests: ``jax`` for device-touching paths, ``core`` for the rest.
+
+    Mirrors the reference's core/torch marker split (its CI runs them as separate
+    job families) so the fast dataframe tier stays seconds-fast.
+    """
+    import pytest as _pytest
+
+    jax_paths = ("tests/nn", "tests/parallel", "tests/models/nn", "test_builder", "test_train")
+    for item in items:
+        path = str(item.fspath)
+        if any(fragment in path for fragment in jax_paths):
+            item.add_marker(_pytest.mark.jax)
+        else:
+            item.add_marker(_pytest.mark.core)
