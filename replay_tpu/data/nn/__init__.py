@@ -1,3 +1,18 @@
+from .iterator import SequenceBatcher, validation_batches
+from .partitioning import Partitioning, ReplicasInfo
 from .schema import TensorFeatureInfo, TensorFeatureSource, TensorMap, TensorSchema
+from .sequence_tokenizer import SequenceTokenizer
+from .sequential_dataset import SequentialDataset
 
-__all__ = ["TensorFeatureInfo", "TensorFeatureSource", "TensorMap", "TensorSchema"]
+__all__ = [
+    "Partitioning",
+    "ReplicasInfo",
+    "SequenceBatcher",
+    "SequenceTokenizer",
+    "SequentialDataset",
+    "TensorFeatureInfo",
+    "TensorFeatureSource",
+    "TensorMap",
+    "TensorSchema",
+    "validation_batches",
+]

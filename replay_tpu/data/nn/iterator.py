@@ -1,0 +1,182 @@
+"""Fixed-shape batches from per-query sequences.
+
+Capability parity with replay/data/nn/torch_sequential_dataset.py:29-302 (left-pad
+to ``max_sequence_length``, sliding-window expansion of long histories, validation
+variant carrying padded ground-truth/train id sets) and the exact-batch semantics
+of the parquet pipeline (fixed_batch_dataset.py:68, compute_length.py:62).
+
+TPU design: XLA wants ONE shape for the whole epoch, so every batch is exactly
+``[batch_size, max_sequence_length]`` — the final short batch is padded with
+repeated rows and flagged via a ``valid`` row mask that zeroes their loss and
+metric contributions. Sharding across hosts happens here through the
+:class:`~replay_tpu.data.nn.partitioning.Partitioning` seam (every replica sees a
+disjoint strided slice); sharding across a host's chips happens later via
+NamedSharding in the trainer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from replay_tpu.data.nn.partitioning import Partitioning
+from replay_tpu.data.nn.sequential_dataset import SequentialDataset
+
+Batch = Dict[str, np.ndarray]
+
+
+def _windows(length: int, max_len: int, stride: Optional[int]) -> List[Tuple[int, int]]:
+    """(start, stop) windows covering a sequence; the LAST window always ends at
+    the sequence end (recency matters for next-item training)."""
+    if length <= max_len:
+        return [(0, length)]
+    stride = stride or max_len
+    stops = list(range(max_len, length, stride)) + [length]
+    return [(stop - max_len, stop) for stop in stops]
+
+
+@dataclass
+class SequenceBatcher:
+    """Iterates fixed-shape raw batches ``{feature: [B, L], feature_mask: [B, L]}``.
+
+    The output feeds the transform pipelines (replay_tpu.nn.transform.template)
+    unchanged — masks are emitted per feature under ``<name>_mask``.
+
+    :param windows: expand sequences longer than ``max_sequence_length`` into
+        several windows (training); when False only the LAST ``max_sequence_length``
+        events are kept (inference — the reference predict path).
+    :param partitioning: replica-sharding seam; defaults to the single-replica
+        identity partitioning.
+    """
+
+    dataset: SequentialDataset
+    batch_size: int
+    max_sequence_length: int
+    windows: bool = False
+    window_stride: Optional[int] = None
+    shuffle: bool = False
+    seed: int = 0
+    partitioning: Optional[Partitioning] = None
+    epoch: int = field(default=0)
+
+    def __post_init__(self) -> None:
+        self._schema = self.dataset.schema
+        self._seq_names = [f.name for f in self._schema.all_features if f.is_seq]
+        self._scalar_names = [f.name for f in self._schema.all_features if not f.is_seq]
+        self._index: List[Tuple[int, int, int]] = []  # (row, start, stop)
+        for row in range(len(self.dataset)):
+            length = self.dataset.get_sequence_length(row)
+            spans = (
+                _windows(length, self.max_sequence_length, self.window_stride)
+                if self.windows
+                else [(max(0, length - self.max_sequence_length), length)]
+            )
+            self._index.extend((row, start, stop) for start, stop in spans)
+
+    def __len__(self) -> int:
+        """Number of fixed-size batches for THIS replica (ceil semantics)."""
+        part = self.partitioning or Partitioning()
+        per_replica = len(part.generate(len(self._index), self.epoch))
+        return -(-per_replica // self.batch_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the shuffle epoch (folds into the partitioning seed)."""
+        self.epoch = epoch
+
+    def _entry_order(self) -> np.ndarray:
+        part = self.partitioning or Partitioning(shuffle=self.shuffle, seed=self.seed)
+        if self.shuffle and not part.shuffle:
+            # honor shuffle=True even when an (unshuffled) partitioning was injected
+            part = Partitioning(part.replicas, shuffle=True, seed=self.seed)
+        return part.generate(len(self._index), self.epoch)
+
+    def _padding_value(self, name: str):
+        return self._schema[name].padding_value
+
+    def _dtype(self, name: str):
+        sample = self.dataset.get_sequence(0, name) if len(self.dataset) else np.zeros(0)
+        return np.int32 if np.issubdtype(np.asarray(sample).dtype, np.integer) else np.float32
+
+    def __iter__(self) -> Iterator[Batch]:
+        order = self._entry_order()
+        L = self.max_sequence_length
+        dtypes = {name: self._dtype(name) for name in self._seq_names}
+        for chunk_start in range(0, len(order), self.batch_size):
+            chunk = order[chunk_start : chunk_start + self.batch_size]
+            n_real = len(chunk)
+            if n_real < self.batch_size:  # pad final batch by repeating its first row
+                chunk = np.concatenate(
+                    [chunk, np.full(self.batch_size - n_real, chunk[0], dtype=chunk.dtype)]
+                )
+            batch: Batch = {}
+            for name in self._seq_names:
+                pad = self._padding_value(name)
+                arr = np.full((self.batch_size, L), pad, dtype=dtypes[name])
+                mask = np.zeros((self.batch_size, L), dtype=bool)
+                for b, entry in enumerate(chunk):
+                    row, start, stop = self._index[entry]
+                    seq = self.dataset.get_sequence(row, name)[start:stop]
+                    arr[b, L - len(seq) :] = seq
+                    mask[b, L - len(seq) :] = True
+                batch[name] = arr
+                batch[f"{name}_mask"] = mask
+            for name in self._scalar_names:
+                batch[name] = np.asarray(
+                    [
+                        np.asarray(
+                            self.dataset.get_sequence(self._index[entry][0], name)
+                        ).reshape(-1)[0]
+                        for entry in chunk
+                    ]
+                )
+            batch["query_id"] = np.asarray(
+                [self.dataset.get_query_id(self._index[entry][0]) for entry in chunk]
+            )
+            valid = np.zeros(self.batch_size, dtype=bool)
+            valid[:n_real] = True
+            batch["valid"] = valid
+            yield batch
+
+
+def validation_batches(
+    train: SequentialDataset,
+    ground_truth: SequentialDataset,
+    batch_size: int,
+    max_sequence_length: int,
+    partitioning: Optional[Partitioning] = None,
+) -> Iterator[Batch]:
+    """Batches for Trainer.validate: input histories from ``train`` plus padded
+    ``ground_truth``/``train`` id sets (−1 padding, MetricsBuilder's contract).
+
+    Mirrors the reference validation dataset (torch_sequential_dataset.py:184):
+    only queries present in both splits are evaluated.
+    """
+    train_common, gt_common = SequentialDataset.keep_common_query_ids(train, ground_truth)
+    item_col = train_common.item_id_column
+    gt_max = max((gt_common.get_sequence_length(i) for i in range(len(gt_common))), default=1)
+    train_max = max(
+        (train_common.get_sequence_length(i) for i in range(len(train_common))), default=1
+    )
+    batcher = SequenceBatcher(
+        train_common,
+        batch_size=batch_size,
+        max_sequence_length=max_sequence_length,
+        windows=False,
+        partitioning=partitioning,
+    )
+    for batch in batcher:
+        n = len(batch["query_id"])
+        gt = np.full((n, gt_max), -1, dtype=np.int64)
+        seen = np.full((n, train_max), -1, dtype=np.int64)
+        for b, query_id in enumerate(batch["query_id"]):
+            if not batch["valid"][b]:
+                continue
+            gt_seq = gt_common.get_sequence_by_query_id(query_id, item_col)
+            gt[b, : len(gt_seq)] = gt_seq
+            seen_seq = train_common.get_sequence_by_query_id(query_id, item_col)
+            seen[b, : len(seen_seq)] = seen_seq
+        batch["ground_truth"] = gt
+        batch["train"] = seen
+        yield batch

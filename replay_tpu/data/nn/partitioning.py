@@ -1,0 +1,73 @@
+"""Input-sharding seam for distributed training.
+
+Capability parity with replay/data/nn/parquet/info/{replicas,partitioning,
+distributed_info,worker_info}.py: a replica = (host, local worker) pair; every
+replica reads a disjoint strided slice of the (padded, optionally shuffled) index
+space so the union covers each row exactly once per epoch.
+
+TPU design: the reference derives replica identity from ``torch.distributed`` rank
+and dataloader worker id; here it comes from ``jax.process_index()`` /
+``jax.process_count()`` — one process per host feeds all its local chips, and the
+trainer shards each host's batch over the local devices via NamedSharding. The
+seam stays a plain dataclass so tests can inject fake replica layouts without any
+distributed runtime (the reference's FakeReplicasInfo trick, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ReplicasInfo:
+    """Identity of one data-loading replica in the global layout."""
+
+    num_replicas: int = 1
+    replica_id: int = 0
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.replica_id < self.num_replicas:
+            msg = f"replica_id {self.replica_id} out of range [0, {self.num_replicas})"
+            raise ValueError(msg)
+
+    @classmethod
+    def from_jax(cls, worker_id: int = 0, num_workers: int = 1) -> "ReplicasInfo":
+        """Replica layout of the current jax process (× optional host workers)."""
+        import jax
+
+        return cls(
+            num_replicas=jax.process_count() * num_workers,
+            replica_id=jax.process_index() * num_workers + worker_id,
+        )
+
+
+@dataclass
+class Partitioning:
+    """Deterministic strided partition of ``n`` row indices for one replica.
+
+    The index space is padded by wrap-around to a multiple of ``num_replicas``
+    (so every replica yields the same number of rows — a collective-friendly
+    invariant: all hosts take the same number of steps), optionally permuted with
+    a seed that folds in the epoch, then strided ``replica_id::num_replicas``.
+    """
+
+    replicas: ReplicasInfo = None
+    shuffle: bool = False
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.replicas is None:
+            self.replicas = ReplicasInfo()
+
+    def generate(self, n: int, epoch: int = 0) -> np.ndarray:
+        if n == 0:
+            return np.zeros(0, dtype=np.int64)
+        num = self.replicas.num_replicas
+        padded_len = -(-n // num) * num
+        indices = np.arange(padded_len, dtype=np.int64) % n  # wrap-around padding
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, epoch))
+            indices = indices[rng.permutation(padded_len)]
+        return indices[self.replicas.replica_id :: num]

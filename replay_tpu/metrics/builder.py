@@ -28,10 +28,14 @@ def _batch_metric_sums(
     predictions: jnp.ndarray,  # [B, max_k] int item ids, ranked
     ground_truth: jnp.ndarray,  # [B, G] int item ids, padded with negative values
     train: Optional[jnp.ndarray],  # [B, T] or None
+    valid: Optional[jnp.ndarray],  # [B] bool — False rows (batch padding) contribute 0
     ks: tuple,
     metrics: tuple,
 ) -> Dict[str, jnp.ndarray]:
     """Sum of each per-user metric over the batch, for every k."""
+    row_weight = (
+        jnp.ones(predictions.shape[0], jnp.float32) if valid is None else valid.astype(jnp.float32)
+    )
     valid_gt = ground_truth >= 0
     gt_count = valid_gt.sum(axis=1)  # [B]
     # hits[b, i] — is predictions[b, i] a ground-truth item of user b
@@ -51,6 +55,9 @@ def _batch_metric_sums(
     inv_rank = 1.0 / (positions + 1.0)  # map / mrr weights
     cum_hits = jnp.cumsum(hits, axis=1)
 
+    def gated_sum(per_user: jnp.ndarray) -> jnp.ndarray:
+        return jnp.sum(per_user * row_weight)
+
     out: Dict[str, jnp.ndarray] = {}
     for k in ks:
         h = hits[:, :k]
@@ -59,35 +66,41 @@ def _batch_metric_sums(
         safe_gt = jnp.maximum(gt_at_k, 1.0)
         users_with_gt = (gt_count > 0).astype(jnp.float32)
         if "recall" in metrics:
-            out[f"recall@{k}"] = jnp.sum(hit_count / jnp.maximum(gt_count, 1) * users_with_gt)
+            out[f"recall@{k}"] = gated_sum(hit_count / jnp.maximum(gt_count, 1) * users_with_gt)
         if "precision" in metrics:
-            out[f"precision@{k}"] = jnp.sum(hit_count / k * users_with_gt)
+            out[f"precision@{k}"] = gated_sum(hit_count / k * users_with_gt)
         if "hitrate" in metrics:
-            out[f"hitrate@{k}"] = jnp.sum((hit_count > 0).astype(jnp.float32))
+            out[f"hitrate@{k}"] = gated_sum((hit_count > 0).astype(jnp.float32))
         if "ndcg" in metrics:
             dcg = jnp.sum(h * inv_log[:k], axis=1)
             # idcg = sum of the first min(gt, k) discounts
             idcg_table = jnp.concatenate([jnp.zeros(1), jnp.cumsum(inv_log[:k])])
             idcg = idcg_table[jnp.minimum(gt_count, k)]
-            out[f"ndcg@{k}"] = jnp.sum(dcg / jnp.maximum(idcg, 1e-9) * users_with_gt)
+            out[f"ndcg@{k}"] = gated_sum(dcg / jnp.maximum(idcg, 1e-9) * users_with_gt)
         if "map" in metrics:
             ap = jnp.sum(h * cum_hits[:, :k] * inv_rank[:k], axis=1) / safe_gt
-            out[f"map@{k}"] = jnp.sum(ap * users_with_gt)
+            out[f"map@{k}"] = gated_sum(ap * users_with_gt)
         if "mrr" in metrics:
             first_hit = jnp.argmax(h, axis=1)
             any_hit = hit_count > 0
-            out[f"mrr@{k}"] = jnp.sum(jnp.where(any_hit, 1.0 / (first_hit + 1.0), 0.0))
+            out[f"mrr@{k}"] = gated_sum(jnp.where(any_hit, 1.0 / (first_hit + 1.0), 0.0))
         if "novelty" in metrics and train_hits is not None:
-            out[f"novelty@{k}"] = jnp.sum(1.0 - jnp.sum(train_hits[:, :k], axis=1) / k)
+            out[f"novelty@{k}"] = gated_sum(1.0 - jnp.sum(train_hits[:, :k], axis=1) / k)
     return out
 
 
 @partial(jax.jit, static_argnames=("k", "item_count"))
-def _coverage_bitmap(predictions: jnp.ndarray, k: int, item_count: int) -> jnp.ndarray:
+def _coverage_bitmap(
+    predictions: jnp.ndarray, k: int, item_count: int, valid: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
     """Boolean item-presence map of the batch's top-k recommendations."""
-    flat = predictions[:, :k].reshape(-1)
-    flat = jnp.clip(flat, 0, item_count - 1)
-    return jnp.zeros(item_count, dtype=bool).at[flat].set(True)
+    top = predictions[:, :k]
+    if valid is not None:
+        # batch-padding rows must not mark items; redirect them out of range
+        top = jnp.where(valid[:, None], top, -1)
+    flat = top.reshape(-1)
+    bitmap = jnp.zeros(item_count + 1, dtype=bool).at[jnp.clip(flat, -1, item_count - 1)].set(True)
+    return bitmap[:item_count]
 
 
 class MetricsBuilder:
@@ -129,29 +142,34 @@ class MetricsBuilder:
         self._count = jnp.zeros((), dtype=jnp.int32)
         self._coverage: Dict[str, jnp.ndarray] = {}
 
-    def add_prediction(self, predictions, ground_truth, train=None) -> None:
+    def add_prediction(self, predictions, ground_truth, train=None, valid=None) -> None:
         """Accumulate one batch.
 
         :param predictions: [B, >=max_k] ranked item ids.
         :param ground_truth: [B, G] item ids padded with a negative value.
         :param train: [B, T] seen item ids padded with a negative value
             (required for novelty).
+        :param valid: [B] bool — False marks batch-padding rows (fixed-shape final
+            batches); they contribute nothing to sums, count, or coverage.
         """
         predictions = jnp.asarray(predictions)[:, : self.max_k]
         ground_truth = jnp.asarray(ground_truth)
         train = jnp.asarray(train) if train is not None else None
+        valid = jnp.asarray(valid) if valid is not None else None
         per_user = tuple(m for m in self._metrics if m in PER_USER_METRICS)
         if per_user:
-            sums = _batch_metric_sums(predictions, ground_truth, train, self._ks, per_user)
+            sums = _batch_metric_sums(predictions, ground_truth, train, valid, self._ks, per_user)
             for name, value in sums.items():
                 self._sums[name] = self._sums.get(name, jnp.zeros(())) + value
         if self._need_coverage:
             for k in self._ks:
-                bitmap = _coverage_bitmap(predictions, k, self._item_count)
+                bitmap = _coverage_bitmap(predictions, k, self._item_count, valid)
                 key = f"coverage@{k}"
                 prev = self._coverage.get(key)
                 self._coverage[key] = bitmap if prev is None else (prev | bitmap)
-        self._count = self._count + predictions.shape[0]
+        self._count = self._count + (
+            predictions.shape[0] if valid is None else valid.sum(dtype=jnp.int32)
+        )
 
     # -- distributed seam --------------------------------------------------
     def state(self) -> dict:

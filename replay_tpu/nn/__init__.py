@@ -16,6 +16,14 @@ from .mask import (
     causal_attention_mask,
     padding_mask_from_ids,
 )
+from .postprocess import SeenItemsFilter
+from .train import (
+    LRSchedulerFactory,
+    OptimizerFactory,
+    Trainer,
+    TrainState,
+    make_mesh,
+)
 
 __all__ = [
     "CategoricalEmbedding",
@@ -24,18 +32,24 @@ __all__ = [
     "DefaultAttentionMask",
     "EmbeddingTyingHead",
     "IdentityEmbedding",
+    "LRSchedulerFactory",
     "MultiHeadAttention",
     "MultiHeadDifferentialAttention",
     "NumericalEmbedding",
+    "OptimizerFactory",
     "PointWiseFeedForward",
     "PositionAwareAggregator",
     "RMSNorm",
+    "SeenItemsFilter",
     "SequenceEmbedding",
     "SumAggregator",
     "SwiGLU",
     "SwiGLUEncoder",
+    "TrainState",
+    "Trainer",
     "bidirectional_attention_mask",
     "causal_attention_mask",
     "loss",
+    "make_mesh",
     "padding_mask_from_ids",
 ]

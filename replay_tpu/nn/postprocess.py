@@ -1,0 +1,56 @@
+"""Logit postprocessors applied between model scoring and top-k selection.
+
+Capability parity with replay/nn/lightning/postprocessor/{_base,seen_items}.py: a
+postprocessor is a pure callable ``(logits, batch) -> logits`` run before top-k in
+validation/prediction. ``SeenItemsFilter`` pushes the logits of items the query has
+already interacted with to the dtype minimum so they cannot be recommended again.
+
+TPU design: the filter is a static-shape scatter (``.at[...].set``) over the padded
+seen-ids tensor — no boolean gathers, safe under jit; it vectorizes over the batch
+with one scatter per row via vmap-free advanced indexing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+class SeenItemsFilter:
+    """Mask logits of already-seen items.
+
+    Seen ids are taken from ``batch[seen_field]`` — by default the input item-id
+    sequence itself (shape [B, L]); out-of-range ids (e.g. the padding id
+    ``cardinality``) are redirected to a scratch column appended to the logits and
+    dropped afterwards, so padding never masks a real item.
+
+    :param seen_field: batch key holding the seen item ids per query.
+    :param candidates_field: optional batch key with candidate ids [K] or [B, K];
+        when present, logits are assumed to be candidate-indexed and seen ids are
+        matched against the candidates instead of used as direct columns.
+    """
+
+    def __init__(self, seen_field: str = "item_id", candidates_field: Optional[str] = None) -> None:
+        self.seen_field = seen_field
+        self.candidates_field = candidates_field
+
+    def __call__(self, logits: jnp.ndarray, batch: dict) -> jnp.ndarray:
+        seen = batch[self.seen_field]
+        if seen.ndim == 1:
+            seen = seen[:, None]
+        neg_inf = jnp.finfo(logits.dtype).min
+        if self.candidates_field is not None and self.candidates_field in batch:
+            candidates = batch[self.candidates_field]
+            if candidates.ndim == 1:
+                candidates = candidates[None, :]
+            # mask candidate k where candidates[b, k] appears in seen[b, :]
+            is_seen = (candidates[:, :, None] == seen[:, None, :]).any(axis=2)
+            return jnp.where(is_seen, neg_inf, logits)
+        num_items = logits.shape[-1]
+        # scratch column absorbs padding / out-of-range ids
+        padded = jnp.concatenate([logits, jnp.zeros((*logits.shape[:-1], 1), logits.dtype)], axis=-1)
+        safe = jnp.where((seen >= 0) & (seen < num_items), seen, num_items)
+        rows = jnp.arange(logits.shape[0])[:, None]
+        padded = padded.at[rows, safe].set(neg_inf)
+        return padded[..., :num_items]

@@ -1,0 +1,462 @@
+"""Mesh-parallel trainer: the Lightning-module/Trainer replacement.
+
+Capability parity with replay/nn/lightning/module.py:14-120 (universal model
+wrapper: signature-filtered forward, loss with injected logits callback, optimizer/
+scheduler factories from replay/nn/lightning/optimizer.py:26 and scheduler.py:24-45)
+and the fit/validate/predict flow of notebook 09 (SURVEY.md §3.2-3.3).
+
+TPU design — one SPMD program instead of DDP:
+
+* A :class:`jax.sharding.Mesh` over all devices with axes ``("data", "model")``.
+  Batches are sharded on ``data`` (the DDP replacement: gradients are all-reduced
+  by XLA automatically because parameters are replicated); the item-embedding table
+  can optionally be sharded on ``model`` (vocab tensor-parallelism for huge
+  catalogs, SURVEY.md §2.9 TP row) — XLA inserts the all-gathers/psums over ICI.
+* ``train_step`` / ``eval_step`` are jitted once and reused; batches are
+  ``device_put`` with a ``NamedSharding`` so computation follows data.
+* Static shapes everywhere: final short batches must be padded by the loader
+  (see replay_tpu.data.nn.iterator) and flagged with a ``valid`` row mask which
+  flows into the loss (zero weight) and the metrics builder.
+
+The trainer is model-agnostic: the forward kwargs are filtered from the batch by
+signature introspection (the reference wrapper's trick), so SasRec (feature_tensors,
+padding_mask), Bert4Rec (+ token_mask) and TwoTower share one loop.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from replay_tpu.metrics.builder import MetricsBuilder
+
+logger = logging.getLogger("replay_tpu")
+
+Batch = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------- #
+# Optimizer / scheduler factories (replay/nn/lightning/optimizer.py:26,
+# scheduler.py:24-45 — same roles, optax-native)
+# --------------------------------------------------------------------------- #
+@dataclass
+class LRSchedulerFactory:
+    """Learning-rate schedule factory.
+
+    ``kind="constant"`` | ``"step"`` (decay by ``gamma`` every ``step_size``
+    optimizer steps, the StepLR equivalent) | ``"warmup_linear"`` (linear 0→lr
+    over ``warmup_steps``, the LambdaLR-warmup equivalent) |
+    ``"warmup_cosine"`` (linear warmup then cosine decay to 0 over
+    ``total_steps``).
+    """
+
+    kind: str = "constant"
+    step_size: int = 1000
+    gamma: float = 0.5
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+
+    def create(self, learning_rate: float) -> optax.Schedule:
+        if self.kind == "constant":
+            return optax.constant_schedule(learning_rate)
+        if self.kind == "step":
+            return optax.exponential_decay(
+                learning_rate,
+                transition_steps=self.step_size,
+                decay_rate=self.gamma,
+                staircase=True,
+            )
+        if self.kind == "warmup_linear":
+            return optax.linear_schedule(0.0, learning_rate, transition_steps=self.warmup_steps)
+        if self.kind == "warmup_cosine":
+            return optax.warmup_cosine_decay_schedule(
+                0.0, learning_rate, self.warmup_steps, self.total_steps
+            )
+        msg = f"Unknown scheduler kind: {self.kind}"
+        raise ValueError(msg)
+
+
+@dataclass
+class OptimizerFactory:
+    """Optimizer factory: ``adam`` | ``adamw`` | ``sgd`` (+ optional momentum),
+    with gradient clipping and a pluggable LR schedule."""
+
+    name: str = "adam"
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    betas: Tuple[float, float] = (0.9, 0.999)
+    momentum: float = 0.0
+    clip_grad_norm: Optional[float] = None
+    scheduler: Optional[LRSchedulerFactory] = None
+
+    def create(self) -> optax.GradientTransformation:
+        lr = self.scheduler.create(self.learning_rate) if self.scheduler else self.learning_rate
+        if self.name == "adam":
+            core = optax.adam(lr, b1=self.betas[0], b2=self.betas[1])
+            if self.weight_decay:
+                core = optax.chain(optax.add_decayed_weights(self.weight_decay), core)
+        elif self.name == "adamw":
+            core = optax.adamw(
+                lr, b1=self.betas[0], b2=self.betas[1], weight_decay=self.weight_decay
+            )
+        elif self.name == "sgd":
+            core = optax.sgd(lr, momentum=self.momentum or None)
+            if self.weight_decay:
+                core = optax.chain(optax.add_decayed_weights(self.weight_decay), core)
+        else:
+            msg = f"Unknown optimizer: {self.name}"
+            raise ValueError(msg)
+        if self.clip_grad_norm:
+            return optax.chain(optax.clip_by_global_norm(self.clip_grad_norm), core)
+        return core
+
+
+# --------------------------------------------------------------------------- #
+# TrainState
+# --------------------------------------------------------------------------- #
+class TrainState(struct.PyTreeNode):
+    """Pure pytree of everything a train step mutates."""
+
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    rng: jnp.ndarray
+
+
+# --------------------------------------------------------------------------- #
+# Mesh helpers
+# --------------------------------------------------------------------------- #
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, model_parallel: int = 1
+) -> Mesh:
+    """All (or given) devices arranged as a ``("data", "model")`` mesh.
+
+    ``model_parallel`` chips shard the vocab/model axis; the rest are data
+    parallel. On a v5e-8 slice ``model_parallel=1`` gives pure DP over ICI.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) % model_parallel:
+        msg = f"{len(devices)} devices not divisible by model_parallel={model_parallel}"
+        raise ValueError(msg)
+    grid = np.array(devices).reshape(len(devices) // model_parallel, model_parallel)
+    return Mesh(grid, ("data", "model"))
+
+
+def _batch_sharding(mesh: Mesh) -> Callable[[Any], Any]:
+    """device_put a batch pytree with the leading axis sharded over ``data``."""
+    def put(batch):
+        def leaf_sharding(x):
+            x = jnp.asarray(x) if not isinstance(x, (jnp.ndarray, np.ndarray)) else x
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] % mesh.shape["data"] == 0:
+                return NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+            return NamedSharding(mesh, P())  # e.g. shared [N] negative ids
+
+        return jax.tree.map(lambda x: jax.device_put(x, leaf_sharding(np.asarray(x))), batch)
+
+    return put
+
+
+def _params_shardings(mesh: Mesh, params: Any, shard_vocab: bool) -> Any:
+    """Replicated everywhere, except (optionally) embedding tables row-sharded
+    over the ``model`` axis — the vocab-TP story for huge catalogs."""
+
+    def spec(path, leaf) -> NamedSharding:
+        if shard_vocab and leaf.ndim == 2:
+            path_str = jax.tree_util.keystr(path)
+            if "embedding" in path_str and leaf.shape[0] % mesh.shape["model"] == 0:
+                return NamedSharding(mesh, P("model", None))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+# --------------------------------------------------------------------------- #
+# Trainer
+# --------------------------------------------------------------------------- #
+@dataclass
+class Trainer:
+    """Fit / validate / predict driver around a flax model + loss.
+
+    :param model: flax module with ``__call__`` (training forward → hidden
+        states), ``get_logits(hidden, candidates)`` and ``forward_inference``.
+    :param loss: a replay_tpu.nn.loss callable; its ``logits_callback`` is bound
+        per step to the model's ``get_logits``.
+    :param optimizer: optimizer factory (default Adam 1e-3).
+    :param mesh: device mesh; default = all devices, pure data parallel.
+    :param shard_vocab: shard embedding tables over the ``model`` mesh axis.
+    :param label_field / mask fields: batch keys produced by the transform
+        templates (replay_tpu.nn.transform.template).
+    """
+
+    model: Any
+    loss: Any
+    optimizer: OptimizerFactory = field(default_factory=OptimizerFactory)
+    mesh: Optional[Mesh] = None
+    shard_vocab: bool = False
+    seed: int = 0
+    feature_field: str = "feature_tensors"
+    padding_mask_field: str = "padding_mask"
+    label_field: str = "positive_labels"
+    target_mask_field: str = "target_padding_mask"
+    negative_field: str = "negative_labels"
+
+    def __post_init__(self) -> None:
+        if self.mesh is None:
+            self.mesh = make_mesh()
+        self._tx = self.optimizer.create()
+        self._put_batch = _batch_sharding(self.mesh)
+        self._train_step = None
+        self._eval_logits = None
+        self._forward_params = [
+            p.name
+            for p in inspect.signature(type(self.model).__call__).parameters.values()
+            if p.name not in ("self",)
+        ]
+        self.history: List[Dict[str, float]] = []
+
+    # -- state ------------------------------------------------------------- #
+    def init_state(self, example_batch: Batch) -> TrainState:
+        """Initialize parameters (replicated / vocab-sharded over the mesh)."""
+        rng = jax.random.PRNGKey(self.seed)
+        init_rng, state_rng = jax.random.split(rng)
+        kwargs = self._forward_kwargs(example_batch)
+        params = self.model.init({"params": init_rng, "dropout": init_rng}, **kwargs)["params"]
+        shardings = _params_shardings(self.mesh, params, self.shard_vocab)
+        params = jax.tree.map(jax.device_put, params, shardings)
+        opt_state = self._tx.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state, rng=state_rng
+        )
+
+    def _forward_kwargs(self, batch: Batch, **overrides) -> Dict[str, Any]:
+        """Filter the batch down to the model's forward signature (the reference
+        wrapper's introspection trick, replay/nn/lightning/module.py:59)."""
+        pool = {**batch, **overrides}
+        return {name: pool[name] for name in self._forward_params if name in pool}
+
+    # -- train ------------------------------------------------------------- #
+    def _build_train_step(self):
+        model, loss, tx = self.model, self.loss, self._tx
+        label_f, tmask_f, neg_f = self.label_field, self.target_mask_field, self.negative_field
+        pad_f = self.padding_mask_field
+
+        def train_step(state: TrainState, batch: Batch):
+            rng, dropout_rng = jax.random.split(state.rng)
+            # batch-padding rows (fixed-shape final batch) get zero loss weight:
+            # gate the target mask by the `valid` row flags from the batcher
+            target_mask = batch[tmask_f]
+            if "valid" in batch:
+                target_mask = target_mask & batch["valid"][
+                    (slice(None),) + (None,) * (target_mask.ndim - 1)
+                ]
+
+            def loss_fn(params):
+                kwargs = {
+                    name: batch[name] for name in self._forward_params if name in batch
+                }
+                if "deterministic" in self._forward_params:
+                    kwargs["deterministic"] = False
+                hidden = model.apply({"params": params}, rngs={"dropout": dropout_rng}, **kwargs)
+                loss.logits_callback = partial(
+                    model.apply, {"params": params}, method=type(model).get_logits
+                )
+                return loss(
+                    hidden,
+                    batch.get("feature_tensors", {}),
+                    batch[label_f],
+                    batch.get(neg_f),
+                    batch[pad_f],
+                    target_mask,
+                )
+
+            loss_value, grads = jax.value_and_grad(loss_fn)(state.params)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(
+                step=state.step + 1, params=params, opt_state=opt_state, rng=rng
+            )
+            return new_state, loss_value
+
+        return jax.jit(train_step, donate_argnums=0)
+
+    def train_step(self, state: TrainState, batch: Batch) -> Tuple[TrainState, jnp.ndarray]:
+        """One jitted optimizer step on a (data-sharded) batch."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step(state, self._put_batch(batch))
+
+    def fit(
+        self,
+        train_batches: Iterable[Batch] | Callable[[], Iterable[Batch]],
+        epochs: int = 1,
+        state: Optional[TrainState] = None,
+        val_batches: Optional[Callable[[], Iterable[Batch]]] = None,
+        metrics: Sequence[str] = ("ndcg", "recall", "map"),
+        top_k: Sequence[int] = (1, 5, 10),
+        item_count: Optional[int] = None,
+        postprocessors: Sequence[Callable] = (),
+        log_every: int = 100,
+    ) -> TrainState:
+        """Train for ``epochs`` passes; validates after each epoch when
+        ``val_batches`` is given, appending to :attr:`history`.
+
+        ``train_batches`` may be a re-iterable (e.g. a SequenceBatcher — its
+        ``set_epoch`` is called so shuffling advances per epoch), a zero- or
+        one-arg callable returning an iterable (the arg is the epoch), or a plain
+        one-shot iterator (materialized once if several epochs are requested).
+        """
+        one_shot = None
+        if not callable(train_batches) and iter(train_batches) is train_batches:
+            # a generator: re-iteration is impossible, materialize once
+            one_shot = list(train_batches) if epochs > 1 else train_batches
+
+        def batches_for(epoch: int):
+            if one_shot is not None:
+                return one_shot
+            if callable(train_batches):
+                try:
+                    return train_batches(epoch)
+                except TypeError:
+                    return train_batches()
+            if hasattr(train_batches, "set_epoch"):
+                train_batches.set_epoch(epoch)
+            return train_batches
+
+        for epoch in range(epochs):
+            epoch_loss, n_steps = None, 0
+            for batch in batches_for(epoch):
+                if state is None:
+                    state = self.init_state(batch)
+                state, loss_value = self.train_step(state, batch)
+                # accumulate on device: float() here would sync every step
+                epoch_loss = loss_value if epoch_loss is None else epoch_loss + loss_value
+                n_steps += 1
+                if log_every and n_steps % log_every == 0:
+                    logger.info("epoch %d step %d loss %.4f", epoch, n_steps, float(loss_value))
+            record = {
+                "epoch": epoch,
+                "train_loss": float(epoch_loss) / n_steps if n_steps else 0.0,
+            }
+            if val_batches is not None:
+                record.update(
+                    self.validate(
+                        state,
+                        val_batches(),
+                        metrics=metrics,
+                        top_k=top_k,
+                        item_count=item_count,
+                        postprocessors=postprocessors,
+                    )
+                )
+            self.history.append(record)
+            logger.info("epoch %d: %s", epoch, record)
+        if state is None:
+            msg = "fit() received no batches"
+            raise ValueError(msg)
+        return state
+
+    # -- eval / predict ---------------------------------------------------- #
+    def _build_eval_logits(self):
+        model = self.model
+
+        def eval_logits(params, batch: Batch, candidates: Optional[jnp.ndarray]):
+            kwargs = {name: batch[name] for name in self._forward_params if name in batch}
+            return model.apply(
+                {"params": params},
+                **kwargs,
+                candidates_to_score=candidates,
+                method=type(model).forward_inference,
+            )
+
+        return jax.jit(eval_logits)
+
+    def predict_logits(
+        self, state: TrainState, batch: Batch, candidates: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        """Next-item logits [B, num_items] (or [B, K] for candidates)."""
+        if self._eval_logits is None:
+            self._eval_logits = self._build_eval_logits()
+        return self._eval_logits(state.params, self._put_batch(batch), candidates)
+
+    def validate(
+        self,
+        state: TrainState,
+        batches: Iterable[Batch],
+        metrics: Sequence[str] = ("ndcg", "recall", "map"),
+        top_k: Sequence[int] = (1, 5, 10),
+        item_count: Optional[int] = None,
+        postprocessors: Sequence[Callable] = (),
+    ) -> Mapping[str, float]:
+        """Top-k metrics over validation batches (ground_truth/train padded with
+        −1, per MetricsBuilder's contract)."""
+        builder = MetricsBuilder(metrics=metrics, top_k=top_k, item_count=item_count)
+        max_k = builder.max_k
+        for batch in batches:
+            logits = self.predict_logits(state, batch)
+            for post in postprocessors:
+                logits = post(logits, batch)
+            _, top_ids = jax.lax.top_k(logits, max_k)
+            builder.add_prediction(
+                top_ids, batch["ground_truth"], batch.get("train"), batch.get("valid")
+            )
+        return builder.get_metrics()
+
+    def predict_top_k(
+        self,
+        state: TrainState,
+        batches: Iterable[Batch],
+        k: int,
+        postprocessors: Sequence[Callable] = (),
+        candidates: Optional[jnp.ndarray] = None,
+        query_id_field: str = "query_id",
+    ):
+        """Top-k recommendations as (query_ids, item_ids, scores) numpy arrays.
+
+        The per-batch path mirrors the reference predictions callback
+        (replay/nn/lightning/callback/predictions_callback.py:81-108): score →
+        postprocess → top-k → accumulate; candidate ids are mapped back to
+        catalog ids when ``candidates`` is given.
+        """
+        all_queries, all_items, all_scores = [], [], []
+        for batch in batches:
+            logits = self.predict_logits(state, batch, candidates)
+            for post in postprocessors:
+                logits = post(logits, batch)
+            scores, top_idx = jax.lax.top_k(logits, k)
+            if candidates is not None:
+                top_ids = jnp.asarray(candidates)[top_idx]
+            else:
+                top_ids = top_idx
+            valid = np.asarray(batch.get("valid", np.ones(top_ids.shape[0], bool)))
+            all_items.append(np.asarray(top_ids)[valid])
+            all_scores.append(np.asarray(scores)[valid])
+            if query_id_field in batch:
+                all_queries.append(np.asarray(batch[query_id_field])[valid])
+        items = np.concatenate(all_items) if all_items else np.zeros((0, k), np.int32)
+        scores = np.concatenate(all_scores) if all_scores else np.zeros((0, k), np.float32)
+        queries = np.concatenate(all_queries) if all_queries else np.arange(items.shape[0])
+        return queries, items, scores
+
+    def predict_dataframe(self, state, batches, k, **kwargs):
+        """predict_top_k as a tidy (query_id, item_id, rating) pandas frame —
+        the PandasTopItemsCallback equivalent."""
+        import pandas as pd
+
+        queries, items, scores = self.predict_top_k(state, batches, k, **kwargs)
+        return pd.DataFrame(
+            {
+                "query_id": np.repeat(queries, k),
+                "item_id": items.reshape(-1),
+                "rating": scores.reshape(-1),
+            }
+        )

@@ -1,0 +1,266 @@
+"""Data→tensor bridge: tokenizer, sequential dataset, batcher, partitioning."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureSource, FeatureType
+from replay_tpu.data.nn import (
+    Partitioning,
+    ReplicasInfo,
+    SequenceBatcher,
+    SequenceTokenizer,
+    SequentialDataset,
+    TensorFeatureInfo,
+    TensorFeatureSource,
+    TensorSchema,
+    validation_batches,
+)
+
+
+@pytest.fixture
+def rich_dataset() -> Dataset:
+    interactions = pd.DataFrame(
+        {
+            "user_id": ["u1", "u1", "u1", "u2", "u2", "u3"],
+            "item_id": ["a", "b", "c", "b", "a", "c"],
+            "rating": [1.0, 2.0, 3.0, 4.0, 5.0, 1.5],
+            # deliberately unsorted timestamps inside u1
+            "timestamp": [2, 0, 1, 5, 4, 6],
+        }
+    )
+    item_features = pd.DataFrame({"item_id": ["a", "b", "c"], "genre": ["x", "y", "x"]})
+    query_features = pd.DataFrame({"user_id": ["u1", "u2", "u3"], "age": [10.0, 20.0, 30.0]})
+    schema = FeatureSchema(
+        [
+            FeatureInfo("user_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+            FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+            FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+            FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+            FeatureInfo("genre", FeatureType.CATEGORICAL, feature_source=FeatureSource.ITEM_FEATURES),
+            FeatureInfo("age", FeatureType.NUMERICAL, feature_source=FeatureSource.QUERY_FEATURES),
+        ]
+    )
+    return Dataset(
+        feature_schema=schema,
+        interactions=interactions,
+        item_features=item_features,
+        query_features=query_features,
+    )
+
+
+@pytest.fixture
+def tensor_schema_rich() -> TensorSchema:
+    return TensorSchema(
+        [
+            TensorFeatureInfo(
+                "item_id",
+                FeatureType.CATEGORICAL,
+                is_seq=True,
+                feature_hint=FeatureHint.ITEM_ID,
+                feature_sources=[TensorFeatureSource(FeatureSource.INTERACTIONS, "item_id")],
+                embedding_dim=8,
+            ),
+            TensorFeatureInfo(
+                "rating",
+                FeatureType.NUMERICAL,
+                is_seq=True,
+                feature_sources=[TensorFeatureSource(FeatureSource.INTERACTIONS, "rating")],
+                tensor_dim=1,
+                embedding_dim=8,
+            ),
+            TensorFeatureInfo(
+                "genre",
+                FeatureType.CATEGORICAL,
+                is_seq=True,
+                feature_sources=[TensorFeatureSource(FeatureSource.ITEM_FEATURES, "genre")],
+                embedding_dim=8,
+            ),
+            TensorFeatureInfo(
+                "age",
+                FeatureType.NUMERICAL,
+                is_seq=False,
+                feature_sources=[TensorFeatureSource(FeatureSource.QUERY_FEATURES, "age")],
+                tensor_dim=1,
+                embedding_dim=8,
+            ),
+        ]
+    )
+
+
+class TestSequenceTokenizer:
+    def test_fit_transform_sequences(self, rich_dataset, tensor_schema_rich):
+        tokenizer = SequenceTokenizer(tensor_schema_rich)
+        seq = tokenizer.fit_transform(rich_dataset)
+        assert len(seq) == 3
+        # cardinality assigned from the fitted encoder
+        assert tensor_schema_rich["item_id"].cardinality == 3
+        # padding defaults to cardinality for ITEM_ID (weight-tying alignment)
+        assert tensor_schema_rich["item_id"].padding_value == 3
+        # u1's items sorted by timestamp: b(0) < c(1) < a(2) in raw time order
+        u1 = tokenizer.query_id_encoder.mapping["user_id"]["u1"]
+        items_u1 = seq.get_sequence_by_query_id(u1, "item_id")
+        item_map = tokenizer.item_id_encoder.mapping["item_id"]
+        assert items_u1.tolist() == [item_map["b"], item_map["c"], item_map["a"]]
+        # item-side sequential feature follows the item sequence
+        genre_u1 = seq.get_sequence_by_query_id(u1, "genre")
+        assert len(genre_u1) == 3
+        # query-side scalar feature: one value per query
+        age_u1 = seq.get_sequence_by_query_id(u1, "age")
+        assert np.asarray(age_u1).reshape(-1)[0] == 10.0
+
+    def test_unfitted_transform_raises(self, rich_dataset, tensor_schema_rich):
+        with pytest.raises(RuntimeError, match="not fitted"):
+            SequenceTokenizer(tensor_schema_rich).transform(rich_dataset)
+
+    def test_save_load_roundtrip(self, tmp_path, rich_dataset, tensor_schema_rich):
+        tokenizer = SequenceTokenizer(tensor_schema_rich)
+        before = tokenizer.fit_transform(rich_dataset)
+        tokenizer.save(str(tmp_path / "tok"))
+        restored = SequenceTokenizer.load(str(tmp_path / "tok"))
+        after = restored.transform(rich_dataset)
+        assert len(before) == len(after)
+        for i in range(len(before)):
+            np.testing.assert_array_equal(
+                before.get_sequence(i, "item_id"), after.get_sequence(i, "item_id")
+            )
+        assert restored.item_id_encoder.mapping == tokenizer.item_id_encoder.mapping
+
+
+class TestSequentialDataset:
+    def make(self, ids, schema=None):
+        schema = schema or TensorSchema(
+            TensorFeatureInfo(
+                "item_id",
+                FeatureType.CATEGORICAL,
+                is_seq=True,
+                feature_hint=FeatureHint.ITEM_ID,
+                cardinality=10,
+            )
+        )
+        frame = pd.DataFrame(
+            {"query_id": ids, "item_id": [np.arange(i + 1) for i in range(len(ids))]}
+        )
+        return SequentialDataset(schema, "query_id", "item_id", frame)
+
+    def test_lookup_and_lengths(self):
+        ds = self.make([5, 7, 9])
+        assert len(ds) == 3
+        assert ds.get_query_id(1) == 7
+        assert ds.get_sequence_length(2) == 3
+        assert ds.get_max_sequence_length() == 3
+        np.testing.assert_array_equal(ds.get_sequence_by_query_id(9, "item_id"), [0, 1, 2])
+
+    def test_keep_common(self):
+        left, right = self.make([1, 2, 3]), self.make([2, 3, 4])
+        a, b = SequentialDataset.keep_common_query_ids(left, right)
+        assert a.query_ids.tolist() == [2, 3] and b.query_ids.tolist() == [2, 3]
+
+    def test_save_load(self, tmp_path):
+        ds = self.make([1, 2, 3])
+        ds.save(str(tmp_path / "seq"))
+        restored = SequentialDataset.load(str(tmp_path / "seq"))
+        assert len(restored) == 3
+        np.testing.assert_array_equal(
+            restored.get_sequence(2, "item_id"), ds.get_sequence(2, "item_id")
+        )
+
+
+class TestPartitioning:
+    @pytest.mark.parametrize("n", [16, 17, 23])
+    def test_disjoint_exhaustive(self, n):
+        """8 fake replicas cover every row; overlap only from wrap-around padding."""
+        shards = [
+            Partitioning(ReplicasInfo(8, r)).generate(n) for r in range(8)
+        ]
+        sizes = {len(s) for s in shards}
+        assert len(sizes) == 1  # every replica yields the same count
+        union = np.concatenate(shards)
+        assert set(union.tolist()) == set(range(n))
+        padded_len = -(-n // 8) * 8
+        assert len(union) == padded_len
+
+    def test_shuffle_deterministic_and_epoch_dependent(self):
+        p = Partitioning(ReplicasInfo(4, 1), shuffle=True, seed=3)
+        a, b = p.generate(32, epoch=0), p.generate(32, epoch=0)
+        np.testing.assert_array_equal(a, b)
+        c = p.generate(32, epoch=1)
+        assert not np.array_equal(a, c)
+
+    def test_bad_replica_raises(self):
+        with pytest.raises(ValueError):
+            ReplicasInfo(4, 4)
+
+
+class TestSequenceBatcher:
+    def make_seq_dataset(self, lengths, num_items=10):
+        schema = TensorSchema(
+            TensorFeatureInfo(
+                "item_id",
+                FeatureType.CATEGORICAL,
+                is_seq=True,
+                feature_hint=FeatureHint.ITEM_ID,
+                cardinality=num_items,
+            )
+        )
+        frame = pd.DataFrame(
+            {
+                "query_id": np.arange(len(lengths)),
+                "item_id": [np.arange(n) % num_items for n in lengths],
+            }
+        )
+        return SequentialDataset(schema, "query_id", "item_id", frame)
+
+    def test_fixed_shapes_and_left_padding(self):
+        ds = self.make_seq_dataset([3, 5, 2])
+        batches = list(SequenceBatcher(ds, batch_size=2, max_sequence_length=4))
+        assert len(batches) == 2
+        for batch in batches:
+            assert batch["item_id"].shape == (2, 4)
+            assert batch["item_id_mask"].shape == (2, 4)
+        first = batches[0]
+        # left padding: row 0 (len 3) has one pad slot at position 0 with padding id 10
+        assert first["item_id"][0, 0] == 10 and not first["item_id_mask"][0, 0]
+        np.testing.assert_array_equal(first["item_id"][0, 1:], [0, 1, 2])
+        # row 1 (len 5) keeps only the LAST 4 events in no-window mode
+        np.testing.assert_array_equal(first["item_id"][1], [1, 2, 3, 4])
+        # final batch padded with repeated row + valid mask
+        last = batches[1]
+        np.testing.assert_array_equal(last["valid"], [True, False])
+
+    def test_window_expansion(self):
+        ds = self.make_seq_dataset([10])
+        batcher = SequenceBatcher(ds, batch_size=4, max_sequence_length=4, windows=True)
+        batches = list(batcher)
+        rows = np.concatenate([b["item_id"][b["valid"]] for b in batches])
+        # stride=max_len: windows [0:4], [4:8], then the tail window [6:10]
+        assert rows.shape == (3, 4)
+        np.testing.assert_array_equal(rows[0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(rows[-1], [6, 7, 8, 9])
+
+    def test_replica_sharded_batches_cover_all_rows(self):
+        ds = self.make_seq_dataset([4] * 10)
+        seen = []
+        for r in range(4):
+            batcher = SequenceBatcher(
+                ds,
+                batch_size=2,
+                max_sequence_length=4,
+                partitioning=Partitioning(ReplicasInfo(4, r)),
+            )
+            for batch in batcher:
+                seen.extend(batch["query_id"][batch["valid"]].tolist())
+        assert set(seen) == set(range(10))
+
+    def test_validation_batches(self):
+        train = self.make_seq_dataset([3, 4, 5])
+        gt = self.make_seq_dataset([2, 2])  # only queries 0 and 1 have ground truth
+        batches = list(validation_batches(train, gt, batch_size=2, max_sequence_length=4))
+        assert len(batches) == 1
+        batch = batches[0]
+        assert set(batch["query_id"].tolist()) == {0, 1}
+        assert batch["ground_truth"].shape[0] == 2
+        assert (batch["ground_truth"] >= -1).all()
+        assert batch["train"].shape[0] == 2
+        # padding slots are -1
+        assert (batch["ground_truth"][batch["ground_truth"] < 0] == -1).all()

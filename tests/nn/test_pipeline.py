@@ -1,0 +1,166 @@
+"""End-to-end: raw log → split → tokenize → batch → train SASRec over the mesh →
+validate → predict top-k. The notebook-09 flow (SURVEY.md §3.2) in one test."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.data.nn import (
+    SequenceBatcher,
+    SequenceTokenizer,
+    SequentialDataset,
+    TensorFeatureInfo,
+    TensorFeatureSource,
+    TensorSchema,
+    validation_batches,
+)
+from replay_tpu.data.schema import FeatureSource
+from replay_tpu.nn import OptimizerFactory, SeenItemsFilter, Trainer
+from replay_tpu.nn.loss import CE
+from replay_tpu.nn.sequential.sasrec import SasRec
+from replay_tpu.nn.transform import Compose
+from replay_tpu.nn.transform.template import make_default_sasrec_transforms
+from replay_tpu.splitters import LastNSplitter
+
+NUM_USERS = 24
+NUM_ITEMS = 30  # > max history length + k, so unseen top-5 always exists
+SEQ_LEN = 8
+BATCH = 8
+
+
+def synthetic_log(rng: np.random.Generator) -> pd.DataFrame:
+    """Each user walks the catalog cyclically from a random start — a learnable
+    next-item pattern with user-specific histories."""
+    rows = []
+    for user in range(NUM_USERS):
+        start = rng.integers(0, NUM_ITEMS)
+        length = rng.integers(6, 14)
+        for t in range(length):
+            rows.append((f"user{user}", f"item{(start + t) % NUM_ITEMS}", t))
+    return pd.DataFrame(rows, columns=["user_id", "item_id", "timestamp"])
+
+
+@pytest.fixture(scope="module")
+def pipeline_run():
+    rng = np.random.default_rng(0)
+    log = synthetic_log(rng)
+    train_log, val_log = LastNSplitter(
+        N=2, divide_column="user_id", query_column="user_id", timestamp_column="timestamp"
+    ).split(log)
+
+    schema = FeatureSchema(
+        [
+            FeatureInfo("user_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+            FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+            FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+        ]
+    )
+    tensor_schema = TensorSchema(
+        TensorFeatureInfo(
+            "item_id",
+            FeatureType.CATEGORICAL,
+            is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID,
+            feature_sources=[TensorFeatureSource(FeatureSource.INTERACTIONS, "item_id")],
+            embedding_dim=16,
+        )
+    )
+    tokenizer = SequenceTokenizer(tensor_schema, handle_unknown_rule="drop")
+    train_seq = tokenizer.fit_transform(
+        Dataset(feature_schema=schema, interactions=train_log)
+    )
+    val_seq = tokenizer.transform(Dataset(feature_schema=schema, interactions=val_log))
+
+    num_items = tensor_schema["item_id"].cardinality
+    pipelines = {
+        split: Compose(t) for split, t in make_default_sasrec_transforms(tensor_schema).items()
+    }
+    model = SasRec(schema=tensor_schema, embedding_dim=16, num_blocks=1,
+                   max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(learning_rate=3e-2))
+
+    def train_iter(epoch=0):
+        batcher = SequenceBatcher(train_seq, batch_size=BATCH, max_sequence_length=SEQ_LEN,
+                                  windows=True, shuffle=True, seed=1)
+        batcher.set_epoch(epoch)
+        return (pipelines["train"](b) for b in batcher)
+
+    state, losses = None, []
+    for epoch in range(5):
+        for batch in train_iter(epoch):
+            if state is None:
+                state = trainer.init_state(batch)
+            state, loss_value = trainer.train_step(state, batch)
+            losses.append(float(loss_value))
+
+    return {
+        "trainer": trainer, "state": state, "losses": losses,
+        "train_seq": train_seq, "val_seq": val_seq, "pipelines": pipelines,
+        "tokenizer": tokenizer, "num_items": num_items,
+    }
+
+
+@pytest.mark.jax
+def test_loss_decreases(pipeline_run):
+    losses = pipeline_run["losses"]
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.85
+
+
+@pytest.mark.jax
+def test_validation_metrics(pipeline_run):
+    trainer, state = pipeline_run["trainer"], pipeline_run["state"]
+
+    def val_iter():
+        for batch in validation_batches(
+            pipeline_run["train_seq"], pipeline_run["val_seq"],
+            batch_size=BATCH, max_sequence_length=SEQ_LEN,
+        ):
+            yield pipeline_run["pipelines"]["validate"](batch)
+
+    metrics = trainer.validate(
+        state, val_iter(), metrics=("ndcg", "recall", "coverage"),
+        top_k=(1, 5, 10), item_count=pipeline_run["num_items"],
+    )
+    # the next-item pattern is deterministic: a trained model must beat random
+    assert metrics["recall@5"] > 0.3, metrics
+    assert 0 < metrics["coverage@10"] <= 1.0
+
+
+@pytest.mark.jax
+def test_predict_with_seen_filter_and_decode(pipeline_run):
+    trainer, state = pipeline_run["trainer"], pipeline_run["state"]
+    tokenizer = pipeline_run["tokenizer"]
+    num_items = pipeline_run["num_items"]
+
+    train_seq = pipeline_run["train_seq"]
+    full_max = train_seq.get_max_sequence_length()
+
+    def predict_iter():
+        batcher = SequenceBatcher(train_seq, batch_size=BATCH, max_sequence_length=SEQ_LEN)
+        for batch in batcher:
+            out = pipeline_run["pipelines"]["predict"](batch)
+            # the seen filter needs FULL histories, not just the model's window
+            seen = np.full((len(batch["query_id"]), full_max), -1, dtype=np.int64)
+            for b, query_id in enumerate(batch["query_id"]):
+                history = train_seq.get_sequence_by_query_id(query_id, "item_id")
+                seen[b, : len(history)] = history
+            out["seen_ids"] = seen
+            yield out
+
+    frame = trainer.predict_dataframe(
+        state, predict_iter(), k=5,
+        postprocessors=[SeenItemsFilter(seen_field="seen_ids")],
+    )
+    assert len(frame) == NUM_USERS * 5
+    assert frame["item_id"].between(0, num_items - 1).all()
+    # decode item ids back to raw labels through the tokenizer's encoder
+    inverse = tokenizer.item_id_encoder.inverse_mapping["item_id"]
+    decoded = frame["item_id"].map(inverse)
+    assert decoded.str.startswith("item").all()
+    # no recommended item was seen in that user's history
+    train_seq = pipeline_run["train_seq"]
+    for query_id, group in frame.groupby("query_id"):
+        seen = set(train_seq.get_sequence_by_query_id(query_id, "item_id").tolist())
+        assert not seen.intersection(group["item_id"].tolist())
