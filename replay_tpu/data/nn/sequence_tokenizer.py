@@ -141,10 +141,8 @@ class SequenceTokenizer:
                 )
 
         grouped = interactions.groupby(query_col, sort=True)
-        data: dict = {query_col: []}
-        for query_id, _ in grouped:
-            data[query_col].append(query_id)
-        query_order = pd.Index(data[query_col])
+        query_order = pd.Index(list(grouped.groups))
+        data: dict = {query_col: list(query_order)}
 
         for feature in schema.all_features:
             source = feature.feature_source

@@ -26,12 +26,17 @@ class SeenItemsFilter:
     dropped afterwards, so padding never masks a real item.
 
     :param seen_field: batch key holding the seen item ids per query.
-    :param candidates_field: optional batch key with candidate ids [K] or [B, K];
-        when present, logits are assumed to be candidate-indexed and seen ids are
-        matched against the candidates instead of used as direct columns.
+    :param candidates_field: batch key with candidate ids [K] or [B, K]; when the
+        key is present in the batch, logits are treated as candidate-indexed and
+        seen ids are matched against the candidates instead of used as direct
+        columns. The Trainer injects ``candidates_to_score`` into every batch it
+        scores with candidates, so the default composes with
+        ``predict_top_k(..., candidates=...)`` out of the box.
     """
 
-    def __init__(self, seen_field: str = "item_id", candidates_field: Optional[str] = None) -> None:
+    def __init__(
+        self, seen_field: str = "item_id", candidates_field: Optional[str] = "candidates_to_score"
+    ) -> None:
         self.seen_field = seen_field
         self.candidates_field = candidates_field
 

@@ -1,0 +1,215 @@
+"""BERT4Rec: bidirectional masked-LM next-item model.
+
+Capability parity with replay/models/nn/sequential/bert4rec/model.py:10-425
+(BertEmbedding = item + positional embeddings with LayerNorm/dropout, N transformer
+blocks with ``num_passes_over_block``, tying or classification head) and its MLM
+datasets (dataset.py:55,95,264 — uniform masking for training, mask-token append
+for next-item inference).
+
+TPU design differences from the reference:
+* the ``<MASK>`` token is a learned vector substituted into the summed feature
+  embedding BEFORE positions are added — no vocabulary surgery, the item table
+  keeps its ``cardinality+1`` rows and weight tying stays aligned;
+* inference appends the mask token by shifting the (left-padded) sequence one
+  slot left and masking the last position — a static-shape roll, jit-safe;
+* attention is the padding-only bidirectional mask (replay_tpu/nn/mask.py).
+
+Training batches carry ``token_mask`` (True = visible) from TokenMaskTransform;
+targets are the original ids at masked positions (see
+make_default_bert4rec_transforms).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from replay_tpu.data.nn.schema import TensorMap, TensorSchema
+from replay_tpu.nn.embedding import SequenceEmbedding
+from replay_tpu.nn.head import EmbeddingTyingHead
+from replay_tpu.nn.mask import bidirectional_attention_mask
+
+from ..sasrec.transformer import SasRecTransformerLayer
+
+
+class Bert4RecBody(nn.Module):
+    """Embed → mask-substitute → +position → LN/dropout → bidirectional encoder."""
+
+    schema: TensorSchema
+    embedding_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 4
+    max_sequence_length: int = 50
+    hidden_dim: Optional[int] = None
+    dropout_rate: float = 0.0
+    num_passes_over_block: int = 1
+    excluded_features: tuple = ()
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        self.embedder = SequenceEmbedding(
+            schema=self.schema,
+            excluded_features=self.excluded_features,
+            dtype=self.dtype,
+            name="embedder",
+        )
+        self.mask_embedding = self.param(
+            "mask_embedding", nn.initializers.normal(stddev=0.02), (self.embedding_dim,)
+        )
+        self.positional_embedding = self.param(
+            "positional_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (self.max_sequence_length, self.embedding_dim),
+        )
+        self.input_norm = nn.LayerNorm(dtype=self.dtype, name="input_norm")
+        self.input_dropout = nn.Dropout(self.dropout_rate)
+        self.encoder = SasRecTransformerLayer(
+            num_blocks=self.num_blocks,
+            num_heads=self.num_heads,
+            hidden_dim=self.hidden_dim or self.embedding_dim * 4,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="encoder",
+        )
+        self.final_norm = nn.LayerNorm(dtype=self.dtype, name="final_norm")
+
+    def __call__(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,  # [B, L] bool
+        token_mask: Optional[jnp.ndarray] = None,  # [B, L] (or [B, L, 1]) bool, True=visible
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        embeddings = self.embedder(feature_tensors)
+        total = sum(embeddings[name] for name in sorted(embeddings))
+        if token_mask is not None:
+            visible = token_mask.reshape(token_mask.shape[0], token_mask.shape[1])
+            total = jnp.where(
+                visible[..., None], total, self.mask_embedding.astype(total.dtype)
+            )
+        seq_len = total.shape[1]
+        if seq_len > self.max_sequence_length:
+            msg = (
+                f"Sequence length {seq_len} exceeds positional table size "
+                f"{self.max_sequence_length}"
+            )
+            raise ValueError(msg)
+        # left-padded inputs: the most recent position maps to the last table row
+        x = total + self.positional_embedding[self.max_sequence_length - seq_len :].astype(
+            total.dtype
+        )
+        x = self.input_dropout(self.input_norm(x), deterministic=deterministic)
+        attention_mask = bidirectional_attention_mask(
+            padding_mask, deterministic=deterministic, dtype=self.dtype
+        )
+        for _ in range(self.num_passes_over_block):
+            x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
+        return self.final_norm(x)
+
+
+class Bert4Rec(nn.Module):
+    """BERT4Rec with an embedding-tying head."""
+
+    schema: TensorSchema
+    embedding_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 4
+    max_sequence_length: int = 50
+    hidden_dim: Optional[int] = None
+    dropout_rate: float = 0.0
+    num_passes_over_block: int = 1
+    excluded_features: tuple = ()
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        self.body = Bert4RecBody(
+            schema=self.schema,
+            embedding_dim=self.embedding_dim,
+            num_blocks=self.num_blocks,
+            num_heads=self.num_heads,
+            max_sequence_length=self.max_sequence_length,
+            hidden_dim=self.hidden_dim,
+            dropout_rate=self.dropout_rate,
+            num_passes_over_block=self.num_passes_over_block,
+            excluded_features=self.excluded_features,
+            dtype=self.dtype,
+            name="body",
+        )
+        self.head = EmbeddingTyingHead()
+
+    def __call__(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        token_mask: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """Hidden states [B, L, E]; masked positions are the MLM prediction sites."""
+        return self.body(
+            feature_tensors, padding_mask, token_mask=token_mask, deterministic=deterministic
+        )
+
+    def get_logits(
+        self, hidden: jnp.ndarray, candidates_to_score: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        """Score hidden states against the catalog (or candidate ids)."""
+        if candidates_to_score is None:
+            return self.head(hidden, self.body.embedder.get_item_weights())
+        embedded = self.body.embedder.get_item_weights(candidates_to_score)
+        if candidates_to_score.ndim == 1:
+            return self.head(hidden, embedded)
+        return jnp.einsum("...e,...ke->...k", hidden, embedded)
+
+    def forward_inference(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        candidates_to_score: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Next-item scores: append ``<MASK>`` after the last event and read its
+        logits (ref bert4rec/dataset.py:264 — prediction dataset appends the mask
+        token; here it's a static-shape left-shift)."""
+        shifted_features = {
+            name: jnp.concatenate([value[:, 1:], value[:, -1:]], axis=1)
+            if value.ndim >= 2
+            else value
+            for name, value in feature_tensors.items()
+        }
+        shifted_padding = jnp.concatenate(
+            [padding_mask[:, 1:], jnp.ones_like(padding_mask[:, -1:])], axis=1
+        )
+        # only the appended slot is masked
+        token_mask = jnp.concatenate(
+            [
+                jnp.ones_like(shifted_padding[:, :-1]),
+                jnp.zeros_like(shifted_padding[:, -1:]),
+            ],
+            axis=1,
+        )
+        hidden = self.body(
+            shifted_features, shifted_padding, token_mask=token_mask, deterministic=True
+        )
+        return self.get_logits(hidden[:, -1, :], candidates_to_score)
+
+    def get_query_embeddings(
+        self, feature_tensors: TensorMap, padding_mask: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Mask-position hidden state per query [B, E]."""
+        shifted = {
+            name: jnp.concatenate([value[:, 1:], value[:, -1:]], axis=1)
+            if value.ndim >= 2
+            else value
+            for name, value in feature_tensors.items()
+        }
+        shifted_padding = jnp.concatenate(
+            [padding_mask[:, 1:], jnp.ones_like(padding_mask[:, -1:])], axis=1
+        )
+        token_mask = jnp.concatenate(
+            [jnp.ones_like(shifted_padding[:, :-1]), jnp.zeros_like(shifted_padding[:, -1:])],
+            axis=1,
+        )
+        return self.body(shifted, shifted_padding, token_mask=token_mask, deterministic=True)[
+            :, -1, :
+        ]

@@ -1,0 +1,4 @@
+from .model import TwoTower
+from .reader import FeaturesReader
+
+__all__ = ["FeaturesReader", "TwoTower"]

@@ -1,0 +1,64 @@
+"""Catalog feature tensors for the item tower.
+
+Capability parity with replay/nn/sequential/twotower/reader.py:18 (FeaturesReader:
+encoded item-features parquet → per-feature tensors ordered by item id). Here the
+reader accepts a pandas frame (or parquet path) whose item-id column holds ENCODED
+ids in [0, num_items) and emits ``{feature_name: np.ndarray[num_items, ...]}``
+aligned with the shared embedding table, plus schema validation against the
+model's ``item_schema``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.nn.schema import TensorSchema
+
+
+class FeaturesReader:
+    """Materialize item-tower feature tensors ordered by encoded item id."""
+
+    def __init__(
+        self,
+        item_schema: TensorSchema,
+        item_id_column: str = "item_id",
+        num_items: Optional[int] = None,
+    ) -> None:
+        self.item_schema = item_schema
+        self.item_id_column = item_id_column
+        self.num_items = num_items
+
+    def read(self, source) -> Dict[str, np.ndarray]:
+        frame = pd.read_parquet(source) if isinstance(source, str) else source
+        if self.item_id_column not in frame.columns:
+            msg = f"Item id column '{self.item_id_column}' not found."
+            raise ValueError(msg)
+        ids = frame[self.item_id_column].to_numpy()
+        num_items = self.num_items or int(ids.max()) + 1
+        if (ids < 0).any() or (ids >= num_items).any():
+            msg = "Item ids must be encoded into [0, num_items) before reading."
+            raise ValueError(msg)
+        order = np.argsort(ids)
+        if len(np.unique(ids)) != len(ids):
+            msg = "Duplicate item ids in the features frame."
+            raise ValueError(msg)
+        tensors: Dict[str, np.ndarray] = {}
+        for feature in self.item_schema.all_features:
+            source_column = (
+                feature.feature_source.column if feature.feature_source else feature.name
+            )
+            if source_column not in frame.columns:
+                msg = f"Feature column '{source_column}' not found in item features."
+                raise ValueError(msg)
+            values = frame[source_column].to_numpy()[order]
+            dtype = np.int32 if feature.is_cat else np.float32
+            dense = np.zeros(
+                (num_items,), dtype=dtype
+            ) if values.ndim == 1 else np.zeros((num_items, values.shape[1]), dtype=dtype)
+            # rows may be a subset: missing items keep zeros (cold-item default)
+            dense[ids[order]] = values.astype(dtype)
+            tensors[feature.name] = dense
+        return tensors

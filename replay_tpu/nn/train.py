@@ -45,6 +45,12 @@ logger = logging.getLogger("replay_tpu")
 Batch = Dict[str, Any]
 
 
+def _signature_names(func) -> List[str]:
+    if func is None:
+        return []
+    return [p.name for p in inspect.signature(func).parameters.values() if p.name != "self"]
+
+
 # --------------------------------------------------------------------------- #
 # Optimizer / scheduler factories (replay/nn/lightning/optimizer.py:26,
 # scheduler.py:24-45 — same roles, optax-native)
@@ -173,7 +179,9 @@ def _params_shardings(mesh: Mesh, params: Any, shard_vocab: bool) -> Any:
     def spec(path, leaf) -> NamedSharding:
         if shard_vocab and leaf.ndim == 2:
             path_str = jax.tree_util.keystr(path)
-            if "embedding" in path_str and leaf.shape[0] % mesh.shape["model"] == 0:
+            # per-feature vocab tables live under SequenceEmbedding's
+            # "embedding_<feature>" scope — positional/mask tables do not
+            if "embedding_" in path_str and leaf.shape[0] % mesh.shape["model"] == 0:
                 return NamedSharding(mesh, P("model", None))
         return NamedSharding(mesh, P())
 
@@ -217,10 +225,18 @@ class Trainer:
         self._put_batch = _batch_sharding(self.mesh)
         self._train_step = None
         self._eval_logits = None
-        self._forward_params = [
-            p.name
-            for p in inspect.signature(type(self.model).__call__).parameters.values()
-            if p.name not in ("self",)
+        self._forward_params = _signature_names(type(self.model).__call__)
+        self._inference_params = (
+            _signature_names(type(self.model).forward_inference)
+            if hasattr(type(self.model), "forward_inference")
+            else self._forward_params
+        )
+        # extra batch-supplied kwargs for get_logits (e.g. TwoTower's
+        # item_feature_tensors catalog arrays)
+        self._logits_extra_params = [
+            name
+            for name in _signature_names(getattr(type(self.model), "get_logits", None))
+            if name not in ("hidden", "candidates_to_score")
         ]
         self.history: List[Dict[str, float]] = []
 
@@ -230,7 +246,21 @@ class Trainer:
         rng = jax.random.PRNGKey(self.seed)
         init_rng, state_rng = jax.random.split(rng)
         kwargs = self._forward_kwargs(example_batch)
-        params = self.model.init({"params": init_rng, "dropout": init_rng}, **kwargs)["params"]
+        logits_extra = {
+            name: example_batch[name] for name in self._logits_extra_params if name in example_batch
+        }
+
+        def init_fn(module):
+            # touch EVERY parameter path: the training forward plus the scoring
+            # head (which owns e.g. TwoTower's item tower)
+            hidden = module(**kwargs)
+            if hasattr(module, "get_logits"):
+                module.get_logits(hidden, None, **logits_extra)
+            return hidden
+
+        params = self.model.init({"params": init_rng, "dropout": init_rng}, method=init_fn)[
+            "params"
+        ]
         shardings = _params_shardings(self.mesh, params, self.shard_vocab)
         params = jax.tree.map(jax.device_put, params, shardings)
         opt_state = self._tx.init(params)
@@ -267,8 +297,11 @@ class Trainer:
                 if "deterministic" in self._forward_params:
                     kwargs["deterministic"] = False
                 hidden = model.apply({"params": params}, rngs={"dropout": dropout_rng}, **kwargs)
+                logits_extra = {
+                    name: batch[name] for name in self._logits_extra_params if name in batch
+                }
                 loss.logits_callback = partial(
-                    model.apply, {"params": params}, method=type(model).get_logits
+                    model.apply, {"params": params}, method=type(model).get_logits, **logits_extra
                 )
                 return loss(
                     hidden,
@@ -324,10 +357,8 @@ class Trainer:
             if one_shot is not None:
                 return one_shot
             if callable(train_batches):
-                try:
-                    return train_batches(epoch)
-                except TypeError:
-                    return train_batches()
+                takes_epoch = len(_signature_names(train_batches)) >= 1
+                return train_batches(epoch) if takes_epoch else train_batches()
             if hasattr(train_batches, "set_epoch"):
                 train_batches.set_epoch(epoch)
             return train_batches
@@ -370,7 +401,7 @@ class Trainer:
         model = self.model
 
         def eval_logits(params, batch: Batch, candidates: Optional[jnp.ndarray]):
-            kwargs = {name: batch[name] for name in self._forward_params if name in batch}
+            kwargs = {name: batch[name] for name in self._inference_params if name in batch}
             return model.apply(
                 {"params": params},
                 **kwargs,
@@ -430,6 +461,9 @@ class Trainer:
         all_queries, all_items, all_scores = [], [], []
         for batch in batches:
             logits = self.predict_logits(state, batch, candidates)
+            if candidates is not None:
+                # visible to postprocessors (SeenItemsFilter's candidate matching)
+                batch = {**batch, "candidates_to_score": jnp.asarray(candidates)}
             for post in postprocessors:
                 logits = post(logits, batch)
             scores, top_idx = jax.lax.top_k(logits, k)

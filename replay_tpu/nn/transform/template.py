@@ -13,7 +13,10 @@ from typing import Dict, List
 from replay_tpu.data.nn.schema import TensorSchema
 
 from .transforms import (
+    CopyTransform,
+    EqualityMaskTransform,
     GroupTransform,
+    InBatchNegativeSamplingTransform,
     NextTokenTransform,
     RenameTransform,
     TokenMaskTransform,
@@ -46,18 +49,34 @@ def make_default_sasrec_transforms(tensor_schema: TensorSchema) -> Dict[str, Lis
 
 
 def make_default_twotower_transforms(tensor_schema: TensorSchema) -> Dict[str, List[Transform]]:
-    return make_default_sasrec_transforms(tensor_schema)
+    """SASRec's next-token pipelines + in-batch negatives for retrieval training
+    (ref nn/transform/template/twotower.py:8; the in-batch pool replaces global
+    uniform sampling — SURVEY.md §6 TwoTower config)."""
+    pipelines = make_default_sasrec_transforms(tensor_schema)
+    pipelines["train"].append(InBatchNegativeSamplingTransform())
+    return pipelines
 
 
 def make_default_bert4rec_transforms(
     tensor_schema: TensorSchema, mask_prob: float = 0.15
 ) -> Dict[str, List[Transform]]:
-    """Masked-LM pipelines: targets are the items at masked positions."""
+    """Masked-LM pipelines: targets are the original items at masked positions
+    (token_mask False = masked = predict here), matching the Bert4Rec training
+    contract (ref bert4rec/dataset.py:95)."""
     item_id = tensor_schema.item_id_feature_name
     train = [
         RenameTransform({f"{item_id}_mask": "padding_mask"}),
         TokenMaskTransform(token_name="padding_mask", mask_prob=mask_prob),
-        UnsqueezeTransform("token_mask", -1),
+        CopyTransform({item_id: "positive_labels", "padding_mask": "target_padding_mask"}),
+        # target positions = real tokens that were masked out
+        EqualityMaskTransform(
+            feature_name="token_mask",
+            mask_name="target_padding_mask",
+            equality_value=False,
+            op="and",
+        ),
+        UnsqueezeTransform("positive_labels", -1),
+        UnsqueezeTransform("target_padding_mask", -1),
         GroupTransform({"feature_tensors": list(tensor_schema.names)}),
     ]
     eval_pipeline = [

@@ -205,6 +205,30 @@ class MultiClassNegativeSamplingTransform(Transform):
         return {**batch, self.out_feature_name: negatives}
 
 
+class InBatchNegativeSamplingTransform(Transform):
+    """Use the batch's own positives as the shared negative pool (two-tower
+    retrieval training: every query scores against every other query's target).
+
+    Emits ``out_feature_name`` of shape [B] — the `[N]` shared-pool form the
+    sampled losses broadcast; own-positive collisions stay in the denominator,
+    the standard in-batch-softmax formulation.
+    """
+
+    def __init__(
+        self,
+        label_name: str = "positive_labels",
+        out_feature_name: str = "negative_labels",
+    ) -> None:
+        self.label_name = label_name
+        self.out_feature_name = out_feature_name
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        labels = batch[self.label_name]
+        while labels.ndim > 1:  # [B, L, P] -> last position's positive per row
+            labels = labels[:, -1]
+        return {**batch, self.out_feature_name: labels}
+
+
 class TokenMaskTransform(Transform):
     """BERT-style keep-mask: True = visible token, False = masked-out token.
 
