@@ -1,0 +1,29 @@
+from .als import ALS
+from .base import BaseRecommender
+from .bandits import KLUCB, UCB, ThompsonSampling, Wilson
+from .cluster import ClusterRec
+from .knn import AssociationRulesItemRec, ItemKNN
+from .lin_ucb import LinUCB
+from .pop_rec import CatPopRec, PopRec, QueryPopRec
+from .random_rec import RandomRec
+from .slim import SLIM
+from .word2vec import Word2VecRec
+
+__all__ = [
+    "ALS",
+    "AssociationRulesItemRec",
+    "BaseRecommender",
+    "CatPopRec",
+    "ClusterRec",
+    "ItemKNN",
+    "KLUCB",
+    "LinUCB",
+    "PopRec",
+    "QueryPopRec",
+    "RandomRec",
+    "SLIM",
+    "ThompsonSampling",
+    "UCB",
+    "Wilson",
+    "Word2VecRec",
+]

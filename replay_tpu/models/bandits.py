@@ -1,0 +1,174 @@
+"""Non-personalized bandits on binary ratings.
+
+Capability parity with replay/models/{wilson,ucb,kl_ucb,thompson_sampling}.py:
+each treats an item as an arm with successes = positive ratings and trials =
+all ratings, and scores arms by an exploration-aware statistic. All math is
+vectorized numpy over the item axis.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+
+from .base import BaseRecommender
+
+
+class _BinaryRatingBandit(BaseRecommender):
+    """Shared fit: per-item success/trial counts from a 0/1 rating column."""
+
+    can_predict_cold_queries = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.item_popularity: Optional[pd.DataFrame] = None
+
+    def _fit(self, dataset: Dataset) -> None:
+        interactions = dataset.interactions
+        if self.rating_column is None:
+            msg = f"{type(self).__name__} needs a RATING column with 0/1 values."
+            raise ValueError(msg)
+        ratings = interactions[self.rating_column]
+        if not ratings.isin([0, 1]).all():
+            msg = f"{type(self).__name__} requires binary ratings (0 or 1)."
+            raise ValueError(msg)
+        grouped = interactions.groupby(self.item_column)[self.rating_column]
+        stats = grouped.agg(successes="sum", trials="count").reset_index()
+        stats["rating"] = self._arm_scores(
+            stats["successes"].to_numpy(np.float64),
+            stats["trials"].to_numpy(np.float64),
+            float(len(interactions)),
+        )
+        self.item_popularity = stats[[self.item_column, "rating"]]
+
+    def _arm_scores(
+        self, successes: np.ndarray, trials: np.ndarray, total_trials: float
+    ) -> np.ndarray:
+        raise NotImplementedError
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        return self._broadcast_item_scores(
+            self.item_popularity, dataset, queries, items
+        ).fillna({"rating": 0.0})
+
+    def _save_model(self, target: Path) -> None:
+        self.item_popularity.to_parquet(target / "item_popularity.parquet")
+
+    def _load_model(self, source: Path) -> None:
+        self.item_popularity = pd.read_parquet(source / "item_popularity.parquet")
+
+
+class Wilson(_BinaryRatingBandit):
+    """Lower bound of the Wilson score confidence interval (ref wilson.py:12)."""
+
+    _init_arg_names = ["alpha"]
+
+    def __init__(self, alpha: float = 0.05) -> None:
+        super().__init__()
+        self.alpha = alpha
+
+    def _arm_scores(self, successes, trials, total_trials) -> np.ndarray:
+        from math import sqrt
+
+        # two-sided z for confidence 1-alpha via the probit approximation
+        z = _probit(1 - self.alpha / 2)
+        p = successes / np.maximum(trials, 1.0)
+        denom = 1 + z**2 / trials
+        center = p + z**2 / (2 * trials)
+        margin = z * np.sqrt((p * (1 - p) + z**2 / (4 * trials)) / trials)
+        return (center - margin) / denom
+
+
+class UCB(_BinaryRatingBandit):
+    """Mean + sqrt(exploration_coef * ln(T) / n) upper confidence bound
+    (ref ucb.py:14)."""
+
+    _init_arg_names = ["exploration_coef"]
+
+    def __init__(self, exploration_coef: float = 2.0) -> None:
+        super().__init__()
+        self.exploration_coef = exploration_coef
+
+    def _arm_scores(self, successes, trials, total_trials) -> np.ndarray:
+        mean = successes / np.maximum(trials, 1.0)
+        bonus = np.sqrt(self.exploration_coef * np.log(max(total_trials, 2.0)) / trials)
+        return mean + bonus
+
+
+class KLUCB(_BinaryRatingBandit):
+    """KL-UCB: the largest q with n*KL(p̂‖q) ≤ ln(T) + c·ln(ln(T)), solved by a
+    vectorized bisection (ref kl_ucb.py:14)."""
+
+    _init_arg_names = ["exploration_coef"]
+
+    def __init__(self, exploration_coef: float = 0.0) -> None:
+        super().__init__()
+        self.exploration_coef = exploration_coef
+
+    @staticmethod
+    def _kl(p: np.ndarray, q: np.ndarray) -> np.ndarray:
+        eps = 1e-12
+        p = np.clip(p, eps, 1 - eps)
+        q = np.clip(q, eps, 1 - eps)
+        return p * np.log(p / q) + (1 - p) * np.log((1 - p) / (1 - q))
+
+    def _arm_scores(self, successes, trials, total_trials) -> np.ndarray:
+        p = successes / np.maximum(trials, 1.0)
+        log_t = np.log(max(total_trials, 2.0))
+        budget = (log_t + self.exploration_coef * np.log(max(log_t, 1.0 + 1e-9))) / trials
+        low, high = p.copy(), np.ones_like(p) - 1e-9
+        for _ in range(32):  # bisection to ~1e-9 precision
+            mid = (low + high) / 2
+            too_far = self._kl(p, mid) > budget
+            high = np.where(too_far, mid, high)
+            low = np.where(too_far, low, mid)
+        return (low + high) / 2
+
+
+class ThompsonSampling(_BinaryRatingBandit):
+    """One Beta(1+succ, 1+fail) posterior draw per item (ref
+    thompson_sampling.py:12)."""
+
+    _init_arg_names = ["seed"]
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        super().__init__()
+        self.seed = seed
+
+    def _arm_scores(self, successes, trials, total_trials) -> np.ndarray:
+        rng = np.random.default_rng(self.seed)
+        return rng.beta(1.0 + successes, 1.0 + (trials - successes))
+
+
+def _probit(p: float) -> float:
+    """Inverse standard-normal CDF (Acklam's rational approximation — avoids a
+    scipy dependency for one constant)."""
+    a = [-3.969683028665376e01, 2.209460984245205e02, -2.759285104469687e02,
+         1.383577518672690e02, -3.066479806614716e01, 2.506628277459239e00]
+    b = [-5.447609879822406e01, 1.615858368580409e02, -1.556989798598866e02,
+         6.680131188771972e01, -1.328068155288572e01]
+    c = [-7.784894002430293e-03, -3.223964580411365e-01, -2.400758277161838e00,
+         -2.549732539343734e00, 4.374664141464968e00, 2.938163982698783e00]
+    d = [7.784695709041462e-03, 3.224671290700398e-01, 2.445134137142996e00,
+         3.754408661907416e00]
+    p_low = 0.02425
+    if p < p_low:
+        q = np.sqrt(-2 * np.log(p))
+        return (((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q + c[5]) / (
+            (((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1
+        )
+    if p <= 1 - p_low:
+        q = p - 0.5
+        r = q * q
+        return (((((a[0] * r + a[1]) * r + a[2]) * r + a[3]) * r + a[4]) * r + a[5]) * q / (
+            ((((b[0] * r + b[1]) * r + b[2]) * r + b[3]) * r + b[4]) * r + 1
+        )
+    q = np.sqrt(-2 * np.log(1 - p))
+    return -(((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q + c[5]) / (
+        (((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1
+    )

@@ -1,0 +1,220 @@
+"""Classical-model contract: fit / predict / fit_predict / predict_pairs / save-load.
+
+Capability parity with replay/models/base_rec.py:86-1143 (BaseRecommender and its
+Recommender / NonPersonalizedRecommender subfamilies): the generic predict pipeline
+— resolve queries/items, score, drop seen interactions, keep top-k per query —
+plus `.replay` persistence via captured init args.
+
+Engine design: the dataframe engine is pandas (SURVEY.md §7 treats Spark as an
+input adapter, not an execution engine); scoring hot loops are numpy/JAX inside
+each model's ``_predict_scores``. Non-personalized models short-circuit the
+query×item cross join by pruning to the top ``k + max_seen`` candidate items
+before joining (the reference's same-for-all-users trick).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+
+
+class BaseRecommender:
+    """fit/predict contract shared by every classical model."""
+
+    _init_arg_names: Sequence[str] = []
+    can_predict_cold_queries: bool = False
+
+    def __init__(self) -> None:
+        self.query_column: str = "query_id"
+        self.item_column: str = "item_id"
+        self.rating_column: Optional[str] = "rating"
+        self.timestamp_column: Optional[str] = "timestamp"
+        self.fit_queries: Optional[np.ndarray] = None
+        self.fit_items: Optional[np.ndarray] = None
+
+    # -- fit ---------------------------------------------------------------- #
+    def fit(self, dataset: Dataset) -> "BaseRecommender":
+        schema = dataset.feature_schema
+        self.query_column = schema.query_id_column
+        self.item_column = schema.item_id_column
+        self.rating_column = schema.interactions_rating_column
+        self.timestamp_column = schema.interactions_timestamp_column
+        interactions = dataset.interactions
+        self.fit_queries = np.sort(interactions[self.query_column].unique())
+        self.fit_items = np.sort(interactions[self.item_column].unique())
+        self._fit(dataset)
+        return self
+
+    def _fit(self, dataset: Dataset) -> None:
+        raise NotImplementedError
+
+    def _check_fitted(self) -> None:
+        if self.fit_items is None:
+            msg = f"{type(self).__name__} is not fitted; call fit() first."
+            raise RuntimeError(msg)
+
+    # -- predict ------------------------------------------------------------ #
+    def predict(
+        self,
+        dataset: Optional[Dataset],
+        k: int,
+        queries=None,
+        items=None,
+        filter_seen_items: bool = True,
+    ) -> pd.DataFrame:
+        """Top-k recommendations as a (query, item, rating) frame.
+
+        :param dataset: interactions used for seen-item filtering and per-query
+            personalization context (may be None for non-personalized models with
+            ``filter_seen_items=False``).
+        :param queries: subset of queries to recommend for (default: the
+            dataset's, else the fit-time queries).
+        :param items: candidate item pool (default: fit-time items).
+        """
+        self._check_fitted()
+        interactions = dataset.interactions if dataset is not None else None
+        if queries is None:
+            if interactions is not None:
+                queries = np.sort(interactions[self.query_column].unique())
+            else:
+                queries = self.fit_queries
+        else:
+            queries = np.sort(np.asarray(pd.Series(queries).unique()))
+        items = (
+            self.fit_items if items is None else np.asarray(pd.Series(items).unique())
+        )
+
+        scores = self._predict_scores(dataset, queries, items)
+        if filter_seen_items and interactions is not None:
+            seen = interactions[
+                interactions[self.query_column].isin(queries)
+                & interactions[self.item_column].isin(items)
+            ][[self.query_column, self.item_column]]
+            scores = scores.merge(
+                seen.assign(__seen=True),
+                on=[self.query_column, self.item_column],
+                how="left",
+            )
+            scores = scores[scores["__seen"].isna()].drop(columns="__seen")
+        return self._top_k(scores, k)
+
+    def _top_k(self, scores: pd.DataFrame, k: int) -> pd.DataFrame:
+        ranked = scores.sort_values(
+            [self.query_column, "rating"], ascending=[True, False], kind="stable"
+        )
+        top = ranked.groupby(self.query_column, sort=False).head(k)
+        return top.reset_index(drop=True)
+
+    def _predict_scores(
+        self, dataset: Optional[Dataset], queries: np.ndarray, items: np.ndarray
+    ) -> pd.DataFrame:
+        """(query, item, rating) candidate scores — model-specific."""
+        raise NotImplementedError
+
+    def fit_predict(
+        self, dataset: Dataset, k: int, queries=None, items=None, filter_seen_items: bool = True
+    ) -> pd.DataFrame:
+        return self.fit(dataset).predict(dataset, k, queries, items, filter_seen_items)
+
+    def predict_pairs(self, pairs: pd.DataFrame, dataset: Optional[Dataset] = None) -> pd.DataFrame:
+        """Score the given (query, item) pairs (ref base_rec.py:795)."""
+        self._check_fitted()
+        queries = np.sort(pairs[self.query_column].unique())
+        items = np.asarray(pairs[self.item_column].unique())
+        scores = self._predict_scores(dataset, queries, items)
+        return pairs.merge(scores, on=[self.query_column, self.item_column], how="left")
+
+    # -- non-personalized helper -------------------------------------------- #
+    def _broadcast_item_scores(
+        self,
+        item_scores: pd.DataFrame,  # [item, rating]
+        dataset: Optional[Dataset],
+        queries: np.ndarray,
+        items: np.ndarray,
+        k_hint: Optional[int] = None,
+    ) -> pd.DataFrame:
+        """Cross-join per-item scores to every query, pruning the candidate pool
+        to the top ``k + max_seen`` items first so the join stays small."""
+        pool = item_scores[item_scores[self.item_column].isin(items)]
+        missing = np.setdiff1d(items, pool[self.item_column].to_numpy())
+        if len(missing):  # cold items: NaN rating, each model picks its fill value
+            pool = pd.concat(
+                [pool, pd.DataFrame({self.item_column: missing, "rating": np.nan})],
+                ignore_index=True,
+            )
+        if k_hint is not None and dataset is not None:
+            max_seen = (
+                dataset.interactions.groupby(self.query_column)[self.item_column]
+                .nunique()
+                .max()
+            )
+            pool = pool.nlargest(k_hint + int(max_seen), "rating")
+        pool = pool.rename(columns={"rating": "rating"})
+        out = pd.MultiIndex.from_product(
+            [queries, pool[self.item_column]], names=[self.query_column, self.item_column]
+        ).to_frame(index=False)
+        return out.merge(pool, on=self.item_column, how="left")
+
+    # -- persistence --------------------------------------------------------- #
+    def save(self, path: str) -> None:
+        self._check_fitted()
+        target = Path(path).with_suffix(".replay")
+        target.mkdir(parents=True, exist_ok=True)
+        init_args = {name: getattr(self, name) for name in self._init_arg_names}
+        (target / "init_args.json").write_text(
+            json.dumps({"_class_name": type(self).__name__, **init_args}, default=_plain)
+        )
+        (target / "fit_info.json").write_text(
+            json.dumps(
+                {
+                    "query_column": self.query_column,
+                    "item_column": self.item_column,
+                    "rating_column": self.rating_column,
+                    "timestamp_column": self.timestamp_column,
+                    "fit_queries": self.fit_queries.tolist(),
+                    "fit_items": self.fit_items.tolist(),
+                },
+                default=_plain,
+            )
+        )
+        self._save_model(target)
+
+    def _save_model(self, target: Path) -> None:
+        """Model-specific payload (parquet/npz files inside the .replay dir)."""
+
+    def _load_model(self, source: Path) -> None:
+        """Model-specific payload restore."""
+
+    @classmethod
+    def load(cls, path: str) -> "BaseRecommender":
+        source = Path(path).with_suffix(".replay")
+        args = json.loads((source / "init_args.json").read_text())
+        class_name = args.pop("_class_name")
+        if class_name != cls.__name__ and cls is not BaseRecommender:
+            msg = f"Checkpoint is a {class_name}, not a {cls.__name__}."
+            raise ValueError(msg)
+        model = cls(**args)
+        info = json.loads((source / "fit_info.json").read_text())
+        model.query_column = info["query_column"]
+        model.item_column = info["item_column"]
+        model.rating_column = info["rating_column"]
+        model.timestamp_column = info["timestamp_column"]
+        model.fit_queries = np.asarray(info["fit_queries"])
+        model.fit_items = np.asarray(info["fit_items"])
+        model._load_model(source)
+        return model
+
+
+def _plain(value):
+    if isinstance(value, np.generic):
+        return value.item()
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    msg = f"Cannot serialize {type(value)}"
+    raise TypeError(msg)

@@ -1,0 +1,112 @@
+"""ClusterRec: user clustering → per-cluster popularity.
+
+Capability parity with replay/models/cluster.py:14 (KMeans over query features,
+recommendations = item popularity inside the query's cluster; cold queries are
+assigned to the nearest centroid from their features)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+
+from .base import BaseRecommender
+
+
+def _kmeans(points: np.ndarray, k: int, seed: Optional[int], num_iterations: int = 50):
+    rng = np.random.default_rng(seed)
+    k = min(k, len(points))
+    # farthest-point init: duplicate-valued random picks would collapse clusters
+    chosen = [int(rng.integers(len(points)))]
+    for _ in range(k - 1):
+        distances = np.min(
+            ((points[:, None, :] - points[chosen][None, :, :]) ** 2).sum(axis=2), axis=1
+        )
+        chosen.append(int(distances.argmax()))
+    centroids = points[chosen].astype(np.float64).copy()
+    assignment = np.zeros(len(points), np.int64)
+    for _ in range(num_iterations):
+        distances = ((points[:, None, :] - centroids[None, :, :]) ** 2).sum(axis=2)
+        new_assignment = distances.argmin(axis=1)
+        if (new_assignment == assignment).all():
+            break
+        assignment = new_assignment
+        for c in range(k):
+            members = points[assignment == c]
+            if len(members):
+                centroids[c] = members.mean(axis=0)
+    return centroids, assignment
+
+
+class ClusterRec(BaseRecommender):
+    _init_arg_names = ["num_clusters", "seed"]
+    can_predict_cold_queries = True
+
+    def __init__(self, num_clusters: int = 10, seed: Optional[int] = 0) -> None:
+        super().__init__()
+        self.num_clusters = num_clusters
+        self.seed = seed
+        self.centroids: Optional[np.ndarray] = None
+        self.cluster_popularity: Optional[pd.DataFrame] = None
+        self._feature_columns: Optional[list] = None
+
+    def _query_points(self, features: pd.DataFrame) -> np.ndarray:
+        return features[self._feature_columns].to_numpy(np.float64)
+
+    def _fit(self, dataset: Dataset) -> None:
+        if dataset.query_features is None:
+            msg = "ClusterRec needs numeric query_features."
+            raise ValueError(msg)
+        features = dataset.query_features
+        self._feature_columns = [
+            c for c in features.columns
+            if c != self.query_column and np.issubdtype(features[c].dtype, np.number)
+        ]
+        if not self._feature_columns:
+            msg = "ClusterRec found no numeric query feature columns."
+            raise ValueError(msg)
+        points = self._query_points(features)
+        self.centroids, assignment = _kmeans(points, self.num_clusters, self.seed)
+        clusters = pd.DataFrame(
+            {self.query_column: features[self.query_column], "__cluster": assignment}
+        )
+        merged = dataset.interactions.merge(clusters, on=self.query_column, how="inner")
+        counts = (
+            merged.groupby(["__cluster", self.item_column]).size().rename("__count").reset_index()
+        )
+        totals = counts.groupby("__cluster")["__count"].transform("sum")
+        counts["rating"] = counts["__count"] / totals
+        self.cluster_popularity = counts.drop(columns="__count")
+
+    def _assign_clusters(self, dataset: Dataset, queries: np.ndarray) -> pd.DataFrame:
+        features = dataset.query_features
+        sub = features[features[self.query_column].isin(queries)]
+        points = self._query_points(sub)
+        distances = ((points[:, None, :] - self.centroids[None, :, :]) ** 2).sum(axis=2)
+        return pd.DataFrame(
+            {self.query_column: sub[self.query_column], "__cluster": distances.argmin(axis=1)}
+        )
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        if dataset is None or dataset.query_features is None:
+            msg = "ClusterRec needs query_features at predict time."
+            raise ValueError(msg)
+        assignment = self._assign_clusters(dataset, np.asarray(queries))
+        scores = assignment.merge(self.cluster_popularity, on="__cluster", how="left")
+        scores = scores[scores[self.item_column].isin(np.asarray(items))]
+        return scores.drop(columns="__cluster")
+
+    def _save_model(self, target: Path) -> None:
+        np.savez_compressed(target / "centroids.npz", centroids=self.centroids)
+        self.cluster_popularity.to_parquet(target / "cluster_popularity.parquet")
+        (target / "feature_columns.txt").write_text("\n".join(self._feature_columns))
+
+    def _load_model(self, source: Path) -> None:
+        with np.load(source / "centroids.npz") as payload:
+            self.centroids = payload["centroids"]
+        self.cluster_popularity = pd.read_parquet(source / "cluster_popularity.parquet")
+        self._feature_columns = (source / "feature_columns.txt").read_text().splitlines()

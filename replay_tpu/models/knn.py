@@ -1,0 +1,195 @@
+"""Item-item k-nearest-neighbours.
+
+Capability parity with replay/models/knn.py:15 (ItemKNN: cosine item similarity
+with optional tf-idf / bm25 interaction reweighting, shrink regularization,
+top-``num_neighbours`` pruning) and replay/models/association_rules.py:17
+(AssociationRulesItemRec: pair-count confidence/lift rules used as an item
+similarity).
+
+Compute design: the similarity build is one [I, U] × [U, I] gram matrix and the
+predict pass one [Q, I] × [I, I] matmul — both dense numpy here, with the same
+layout a jnp/MXU path would use for large catalogs (the frame boundary stays in
+pandas, the hot loops are matrix algebra, never per-user python)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+
+from .base import BaseRecommender
+
+
+class ItemKNN(BaseRecommender):
+    _init_arg_names = ["num_neighbours", "use_rating", "shrink", "weighting"]
+
+    def __init__(
+        self,
+        num_neighbours: int = 10,
+        use_rating: bool = False,
+        shrink: float = 0.0,
+        weighting: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        if weighting not in (None, "tf_idf", "bm25"):
+            msg = "weighting must be None, 'tf_idf' or 'bm25'"
+            raise ValueError(msg)
+        self.num_neighbours = num_neighbours
+        self.use_rating = use_rating
+        self.shrink = shrink
+        self.weighting = weighting
+        self.similarity: Optional[np.ndarray] = None  # [I, I]
+
+    # -- similarity build --------------------------------------------------- #
+    def _interaction_matrix(self, dataset: Dataset) -> np.ndarray:
+        interactions = dataset.interactions
+        q_index = pd.Index(self.fit_queries)
+        i_index = pd.Index(self.fit_items)
+        rows = q_index.get_indexer(interactions[self.query_column])
+        cols = i_index.get_indexer(interactions[self.item_column])
+        values = (
+            interactions[self.rating_column].to_numpy(np.float32)
+            if self.use_rating and self.rating_column
+            else np.ones(len(interactions), np.float32)
+        )
+        matrix = np.zeros((len(q_index), len(i_index)), np.float32)
+        np.maximum.at(matrix, (rows, cols), values)  # dedupe repeats by max
+        return matrix
+
+    def _reweight(self, matrix: np.ndarray) -> np.ndarray:
+        if self.weighting is None:
+            return matrix
+        n_users = matrix.shape[0]
+        df = np.maximum((matrix > 0).sum(axis=0), 1.0)  # item document frequency
+        idf = np.log1p(n_users / df)
+        if self.weighting == "tf_idf":
+            return matrix * idf[None, :]
+        # bm25 over users-as-documents
+        k1, b = 1.2, 0.75
+        doc_len = matrix.sum(axis=1, keepdims=True)
+        avg_len = max(float(doc_len.mean()), 1e-9)
+        tf = matrix * (k1 + 1) / (matrix + k1 * (1 - b + b * doc_len / avg_len))
+        return tf * idf[None, :]
+
+    def _fit(self, dataset: Dataset) -> None:
+        matrix = self._reweight(self._interaction_matrix(dataset))
+        gram = matrix.T @ matrix  # [I, I]
+        norms = np.sqrt(np.diag(gram))
+        denom = norms[:, None] * norms[None, :] + self.shrink + 1e-12
+        sim = gram / denom
+        np.fill_diagonal(sim, 0.0)
+        if self.num_neighbours is not None and self.num_neighbours < sim.shape[0]:
+            # keep only the top-n neighbours per item (column-wise prune)
+            threshold = np.partition(sim, -self.num_neighbours, axis=0)[-self.num_neighbours]
+            sim = np.where(sim >= threshold[None, :], sim, 0.0)
+        self.similarity = sim.astype(np.float32)
+
+    # -- predict ------------------------------------------------------------ #
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        if dataset is None:
+            msg = "ItemKNN needs the interactions dataset to score queries."
+            raise ValueError(msg)
+        interactions = dataset.interactions
+        q_index = pd.Index(np.asarray(queries))
+        i_index = pd.Index(self.fit_items)
+        mask = interactions[self.query_column].isin(q_index) & interactions[
+            self.item_column
+        ].isin(i_index)
+        sub = interactions[mask]
+        rows = q_index.get_indexer(sub[self.query_column])
+        cols = i_index.get_indexer(sub[self.item_column])
+        seen = np.zeros((len(q_index), len(i_index)), np.float32)
+        values = (
+            sub[self.rating_column].to_numpy(np.float32)
+            if self.use_rating and self.rating_column
+            else np.ones(len(sub), np.float32)
+        )
+        np.maximum.at(seen, (rows, cols), values)
+        scores = seen @ self.similarity  # [Q, I] x [I, I]
+        item_positions = i_index.get_indexer(np.asarray(items))
+        known = item_positions >= 0
+        wanted = np.asarray(items)[known]
+        block = scores[:, item_positions[known]]
+        frame = pd.DataFrame(
+            {
+                self.query_column: np.repeat(q_index.to_numpy(), len(wanted)),
+                self.item_column: np.tile(wanted, len(q_index)),
+                "rating": block.reshape(-1),
+            }
+        )
+        return frame[frame["rating"] > 0]
+
+    def get_nearest_items(self, items, k: int) -> pd.DataFrame:
+        """Top-k similar items per given item (ref NeighbourRec API)."""
+        self._check_fitted()
+        i_index = pd.Index(self.fit_items)
+        out = []
+        for item in np.asarray(items):
+            pos = i_index.get_loc(item)
+            sims = self.similarity[pos]
+            top = np.argsort(-sims, kind="stable")[:k]
+            out.append(
+                pd.DataFrame(
+                    {
+                        "item_idx": item,
+                        "neighbour_item_idx": i_index.to_numpy()[top],
+                        "similarity": sims[top],
+                    }
+                )
+            )
+        return pd.concat(out, ignore_index=True)
+
+    def _save_model(self, target: Path) -> None:
+        np.savez_compressed(target / "similarity.npz", similarity=self.similarity)
+
+    def _load_model(self, source: Path) -> None:
+        with np.load(source / "similarity.npz") as payload:
+            self.similarity = payload["similarity"]
+
+
+class AssociationRulesItemRec(ItemKNN):
+    """Association-rule similarity: confidence or lift of the pair rule
+    (antecedent → consequent) computed from co-occurrence inside query sessions
+    (ref association_rules.py:17). Prediction reuses the KNN scoring path with
+    the rule matrix as similarity."""
+
+    _init_arg_names = ["min_item_count", "min_pair_count", "num_neighbours", "use_lift"]
+
+    def __init__(
+        self,
+        min_item_count: int = 1,
+        min_pair_count: int = 1,
+        num_neighbours: int = 30,
+        use_lift: bool = False,
+    ) -> None:
+        super().__init__(num_neighbours=num_neighbours)
+        self.min_item_count = min_item_count
+        self.min_pair_count = min_pair_count
+        self.use_lift = use_lift
+
+    def _fit(self, dataset: Dataset) -> None:
+        matrix = self._interaction_matrix(dataset) > 0  # [U, I] bool
+        item_counts = matrix.sum(axis=0).astype(np.float64)  # sessions per item
+        pair_counts = (matrix.astype(np.float32).T @ matrix.astype(np.float32)).astype(
+            np.float64
+        )
+        np.fill_diagonal(pair_counts, 0.0)
+        valid_items = item_counts >= self.min_item_count
+        pair_ok = pair_counts >= self.min_pair_count
+        confidence = np.where(
+            pair_ok & valid_items[:, None] & valid_items[None, :],
+            pair_counts / np.maximum(item_counts[:, None], 1.0),
+            0.0,
+        )
+        if self.use_lift:
+            n_sessions = max(matrix.shape[0], 1)
+            confidence = confidence * n_sessions / np.maximum(item_counts[None, :], 1.0)
+        sim = confidence
+        if self.num_neighbours is not None and self.num_neighbours < sim.shape[0]:
+            threshold = np.partition(sim, -self.num_neighbours, axis=0)[-self.num_neighbours]
+            sim = np.where(sim >= threshold[None, :], sim, 0.0)
+        self.similarity = sim.astype(np.float32)

@@ -1,0 +1,167 @@
+"""Popularity recommenders.
+
+Capability parity with replay/models/pop_rec.py:10 (PopRec), query_pop_rec.py:10
+(QueryPopRec) and cat_pop_rec.py:23 (CatPopRec). Scores are plain pandas/numpy
+aggregations — there is no accelerator hot loop in a popularity count.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+
+from .base import BaseRecommender
+
+
+class PopRec(BaseRecommender):
+    """Item popularity: the share of queries that interacted with the item.
+
+    ``use_rating=True`` weights interactions by the rating column instead of
+    counting distinct queries (ref pop_rec.py use_relevance).
+    """
+
+    _init_arg_names = ["use_rating", "add_cold_items", "cold_weight"]
+    can_predict_cold_queries = True
+
+    def __init__(
+        self, use_rating: bool = False, add_cold_items: bool = True, cold_weight: float = 0.5
+    ) -> None:
+        super().__init__()
+        if not 0 < cold_weight <= 1:
+            msg = "cold_weight must be in (0, 1]"
+            raise ValueError(msg)
+        self.use_rating = use_rating
+        self.add_cold_items = add_cold_items
+        self.cold_weight = cold_weight
+        self.item_popularity: Optional[pd.DataFrame] = None
+
+    def _fit(self, dataset: Dataset) -> None:
+        interactions = dataset.interactions
+        if self.use_rating and self.rating_column:
+            pop = interactions.groupby(self.item_column)[self.rating_column].sum()
+        else:
+            pop = interactions.groupby(self.item_column)[self.query_column].nunique()
+        total = interactions[self.query_column].nunique()
+        self.item_popularity = (
+            (pop / total).rename("rating").reset_index()
+        )
+
+    @property
+    def _fill_value(self) -> float:
+        if not self.add_cold_items or self.item_popularity is None:
+            return 0.0
+        return float(self.item_popularity["rating"].min()) * self.cold_weight
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        scores = self._broadcast_item_scores(self.item_popularity, dataset, queries, items)
+        return scores.fillna({"rating": self._fill_value})
+
+    def _save_model(self, target: Path) -> None:
+        self.item_popularity.to_parquet(target / "item_popularity.parquet")
+
+    def _load_model(self, source: Path) -> None:
+        self.item_popularity = pd.read_parquet(source / "item_popularity.parquet")
+
+
+class QueryPopRec(BaseRecommender):
+    """Per-query repeat-consumption popularity: recommends the items the query
+    itself interacts with most (ref query_pop_rec.py:10 — personal top items)."""
+
+    _init_arg_names = []
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.query_item_popularity: Optional[pd.DataFrame] = None
+
+    def _fit(self, dataset: Dataset) -> None:
+        interactions = dataset.interactions
+        counts = (
+            interactions.groupby([self.query_column, self.item_column])
+            .size()
+            .rename("__count")
+            .reset_index()
+        )
+        totals = counts.groupby(self.query_column)["__count"].transform("sum")
+        counts["rating"] = counts["__count"] / totals
+        self.query_item_popularity = counts.drop(columns="__count")
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        scores = self.query_item_popularity
+        return scores[
+            scores[self.query_column].isin(queries) & scores[self.item_column].isin(items)
+        ].copy()
+
+    def predict(self, dataset, k, queries=None, items=None, filter_seen_items: bool = False):
+        # repeat-consumption model: filtering seen items would empty every list
+        return super().predict(dataset, k, queries, items, filter_seen_items)
+
+    def _save_model(self, target: Path) -> None:
+        self.query_item_popularity.to_parquet(target / "query_item_popularity.parquet")
+
+    def _load_model(self, source: Path) -> None:
+        self.query_item_popularity = pd.read_parquet(source / "query_item_popularity.parquet")
+
+
+class CatPopRec(BaseRecommender):
+    """Category-conditional popularity (ref cat_pop_rec.py:23): item scores are
+    computed inside each category from an item→category mapping.
+
+    The primary API is :meth:`predict_for_categories` (the reference model is
+    category-addressed, not query-addressed); ``predict`` falls back to global
+    popularity so the model still honors the common contract.
+    """
+
+    _init_arg_names = ["category_column"]
+
+    def __init__(self, category_column: str = "category") -> None:
+        super().__init__()
+        self.category_column = category_column
+        self.category_popularity: Optional[pd.DataFrame] = None
+        self.item_popularity: Optional[pd.DataFrame] = None
+
+    def _fit(self, dataset: Dataset) -> None:
+        interactions = dataset.interactions
+        counts = (
+            interactions.groupby(self.item_column).size().rename("__count").reset_index()
+        )
+        if dataset.item_features is None or self.category_column not in dataset.item_features.columns:
+            msg = f"CatPopRec needs item_features with a '{self.category_column}' column."
+            raise ValueError(msg)
+        categories = dataset.item_features[[self.item_column, self.category_column]]
+        merged = counts.merge(categories, on=self.item_column, how="inner")
+        totals = merged.groupby(self.category_column)["__count"].transform("sum")
+        merged["rating"] = merged["__count"] / totals
+        self.category_popularity = merged.drop(columns="__count")
+        global_totals = counts["__count"].sum()
+        self.item_popularity = counts.assign(rating=counts["__count"] / global_totals).drop(
+            columns="__count"
+        )
+
+    def predict_for_categories(self, categories, k: int) -> pd.DataFrame:
+        """Top-k items per requested category."""
+        self._check_fitted()
+        pool = self.category_popularity[
+            self.category_popularity[self.category_column].isin(np.asarray(categories))
+        ]
+        ranked = pool.sort_values(
+            [self.category_column, "rating"], ascending=[True, False], kind="stable"
+        )
+        return ranked.groupby(self.category_column, sort=False).head(k).reset_index(drop=True)
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        return self._broadcast_item_scores(self.item_popularity, dataset, queries, items).fillna(
+            {"rating": 0.0}
+        )
+
+    def _save_model(self, target: Path) -> None:
+        self.category_popularity.to_parquet(target / "category_popularity.parquet")
+        self.item_popularity.to_parquet(target / "item_popularity.parquet")
+
+    def _load_model(self, source: Path) -> None:
+        self.category_popularity = pd.read_parquet(source / "category_popularity.parquet")
+        self.item_popularity = pd.read_parquet(source / "item_popularity.parquet")

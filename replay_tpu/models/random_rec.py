@@ -1,0 +1,71 @@
+"""Random recommender (uniform / popularity-weighted).
+
+Capability parity with replay/models/random_rec.py:10: seeded random scores per
+(query, item), with ``distribution="popular_based"`` biasing toward popular items
+(score ~ U^(1/(pop+alpha)) — a weighted-sampling-without-replacement key, so the
+top-k of the scores IS a weighted sample)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+
+from .base import BaseRecommender
+
+
+class RandomRec(BaseRecommender):
+    _init_arg_names = ["distribution", "alpha", "seed"]
+    can_predict_cold_queries = True
+
+    def __init__(
+        self,
+        distribution: str = "uniform",
+        alpha: float = 0.0,
+        seed: Optional[int] = None,
+    ) -> None:
+        super().__init__()
+        if distribution not in ("uniform", "popular_based"):
+            msg = "distribution must be 'uniform' or 'popular_based'"
+            raise ValueError(msg)
+        if distribution == "popular_based" and alpha <= -1.0:
+            msg = "alpha must be > -1 for popular_based distribution"
+            raise ValueError(msg)
+        self.distribution = distribution
+        self.alpha = alpha
+        self.seed = seed
+        self.item_weights: Optional[pd.DataFrame] = None
+
+    def _fit(self, dataset: Dataset) -> None:
+        interactions = dataset.interactions
+        counts = interactions.groupby(self.item_column)[self.query_column].nunique()
+        weights = (
+            (counts + self.alpha) if self.distribution == "popular_based" else counts * 0 + 1.0
+        )
+        self.item_weights = weights.rename("weight").reset_index()
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        rng = np.random.default_rng(self.seed)
+        weights = self.item_weights.set_index(self.item_column)["weight"]
+        w = weights.reindex(items).fillna(1.0).to_numpy(dtype=np.float64)
+        uniform = rng.random((len(queries), len(items)))
+        # weighted-sample key: top-k of U^(1/w) is a w-weighted draw (Efraimidis-
+        # Spirakis); uniform distribution reduces to plain U
+        scores = uniform ** (1.0 / np.maximum(w, 1e-12))[None, :]
+        return pd.DataFrame(
+            {
+                self.query_column: np.repeat(queries, len(items)),
+                self.item_column: np.tile(items, len(queries)),
+                "rating": scores.reshape(-1),
+            }
+        )
+
+    def _save_model(self, target: Path) -> None:
+        self.item_weights.to_parquet(target / "item_weights.parquet")
+
+    def _load_model(self, source: Path) -> None:
+        self.item_weights = pd.read_parquet(source / "item_weights.parquet")

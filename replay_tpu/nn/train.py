@@ -339,6 +339,7 @@ class Trainer:
         item_count: Optional[int] = None,
         postprocessors: Sequence[Callable] = (),
         log_every: int = 100,
+        checkpoint_manager=None,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`.
@@ -348,6 +349,10 @@ class Trainer:
         one-arg callable returning an iterable (the arg is the epoch), or a plain
         one-shot iterator (materialized once if several epochs are requested).
         """
+        if checkpoint_manager is not None and not self.history:
+            # resume: prior epoch records survive the restart (metric-history
+            # state_dict semantics of the reference validation callback)
+            self.history = list(checkpoint_manager.history())
         one_shot = None
         if not callable(train_batches) and iter(train_batches) is train_batches:
             # a generator: re-iteration is impossible, materialize once
@@ -391,6 +396,8 @@ class Trainer:
                 )
             self.history.append(record)
             logger.info("epoch %d: %s", epoch, record)
+            if checkpoint_manager is not None and state is not None:
+                checkpoint_manager.save(int(state.step), state, history=self.history)
         if state is None:
             msg = "fit() received no batches"
             raise ValueError(msg)
@@ -480,6 +487,31 @@ class Trainer:
         scores = np.concatenate(all_scores) if all_scores else np.zeros((0, k), np.float32)
         queries = np.concatenate(all_queries) if all_queries else np.arange(items.shape[0])
         return queries, items, scores
+
+    # -- checkpointing ------------------------------------------------------ #
+    def save_checkpoint(self, path: str, state: TrainState) -> None:
+        """Write the full TrainState (params + optimizer + PRNG) to ``path``."""
+        from replay_tpu.utils.checkpoint import save_pytree
+
+        save_pytree(path, state, {"step": int(state.step)})
+
+    def restore_checkpoint(self, path: str, example_batch: Batch) -> TrainState:
+        """Rebuild a TrainState from disk; the example batch supplies the template
+        structure and the mesh shardings are re-applied on load."""
+        from replay_tpu.utils.checkpoint import restore_pytree
+
+        template = self.init_state(example_batch)
+        restored = restore_pytree(path, template)
+
+        def place(target_leaf, value):
+            # inherit the template's MESH sharding (params AND optimizer moments
+            # keep their vocab sharding); other leaves replicate over the mesh
+            sharding = getattr(target_leaf, "sharding", None)
+            if not isinstance(sharding, NamedSharding):
+                sharding = NamedSharding(self.mesh, P())
+            return jax.device_put(jnp.asarray(value), sharding)
+
+        return jax.tree.map(place, template, restored)
 
     def predict_dataframe(self, state, batches, k, **kwargs):
         """predict_top_k as a tidy (query_id, item_id, rating) pandas frame —

@@ -1,0 +1,120 @@
+"""Pytree checkpointing: TrainState save/restore + step-managed directories.
+
+Capability parity with the reference's checkpoint story (SURVEY.md §5): Lightning
+ModelCheckpoint (step-numbered, keep-last-k, monitored metric history surviving
+resume — ref nn/lightning/callback/metrics_callback.py:86-101) and the `.replay`
+artifact convention (init_args.json + payloads, ref utils/model_handler.py:42).
+
+TPU design: a checkpoint is the flattened leaf list of an arbitrary JAX pytree
+(TrainState = params + optax state + PRNG key) stored as one ``.npz`` plus a JSON
+sidecar. Restoration unflattens into a TEMPLATE pytree (the orbax restore(item=...)
+pattern) so optax NamedTuple internals never need to be serialized structurally —
+the template supplies the treedef, the npz supplies the arrays, and shapes are
+validated leaf-by-leaf. Works for sharded arrays: leaves are gathered to host on
+save and re-placed by the trainer's shardings on the next device_put.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+def save_pytree(path: str, tree: Any, metadata: Optional[dict] = None) -> None:
+    """Write a pytree's leaves (+ optional JSON metadata) to ``<path>.npz/.json``."""
+    target = Path(path)
+    target.parent.mkdir(parents=True, exist_ok=True)
+    leaves = jax.tree.leaves(tree)
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    np.savez(str(target.with_suffix(".npz")), **arrays)
+    meta = {"num_leaves": len(leaves), **(metadata or {})}
+    target.with_suffix(".json").write_text(json.dumps(meta))
+
+
+def restore_pytree(path: str, template: Any) -> Any:
+    """Rebuild a pytree from ``save_pytree`` output using ``template``'s structure.
+
+    Leaf count and shapes are validated against the template (the ItemTower
+    cache-shape check of the reference, generalized).
+    """
+    target = Path(path)
+    with np.load(str(target.with_suffix(".npz"))) as payload:
+        leaves = [payload[f"leaf_{i}"] for i in range(len(payload.files))]
+    template_leaves, treedef = jax.tree.flatten(template)
+    if len(leaves) != len(template_leaves):
+        msg = (
+            f"Checkpoint has {len(leaves)} leaves, template expects "
+            f"{len(template_leaves)} — incompatible model/optimizer config."
+        )
+        raise ValueError(msg)
+    for i, (saved, expected) in enumerate(zip(leaves, template_leaves)):
+        if hasattr(expected, "shape") and tuple(saved.shape) != tuple(np.shape(expected)):
+            msg = (
+                f"Leaf {i} shape {tuple(saved.shape)} does not match template "
+                f"{tuple(np.shape(expected))}."
+            )
+            raise ValueError(msg)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def load_metadata(path: str) -> dict:
+    return json.loads(Path(path).with_suffix(".json").read_text())
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with keep-last-k retention and metric history.
+
+    Layout: ``<directory>/step_<n>.npz/.json`` + ``history.json`` (the per-epoch
+    metric records of Trainer.history, surviving restarts like the reference
+    callback's state_dict).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+
+    def _step_path(self, step: int) -> Path:
+        return self.directory / f"step_{step}"
+
+    def all_steps(self) -> List[int]:
+        return sorted(
+            int(p.stem.split("_")[1]) for p in self.directory.glob("step_*.npz")
+        )
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        history: Optional[List[Dict[str, float]]] = None,
+        metadata: Optional[dict] = None,
+    ) -> None:
+        save_pytree(str(self._step_path(step)), state, {"step": step, **(metadata or {})})
+        if history is not None:
+            (self.directory / "history.json").write_text(json.dumps(history))
+        for old in self.all_steps()[: -self.max_to_keep]:
+            self._step_path(old).with_suffix(".npz").unlink(missing_ok=True)
+            self._step_path(old).with_suffix(".json").unlink(missing_ok=True)
+
+    def restore(self, template: Any, step: Optional[int] = None) -> Any:
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            msg = f"No checkpoints found in {self.directory}"
+            raise FileNotFoundError(msg)
+        return restore_pytree(str(self._step_path(step)), template)
+
+    def history(self) -> List[Dict[str, float]]:
+        path = self.directory / "history.json"
+        return json.loads(path.read_text()) if path.exists() else []
+
+    def delete(self) -> None:
+        shutil.rmtree(self.directory, ignore_errors=True)

@@ -99,6 +99,8 @@ def pytest_collection_modifyitems(config, items):
 
     jax_paths = ("tests/nn", "tests/parallel", "tests/models/nn", "test_builder", "test_train")
     for item in items:
+        if item.get_closest_marker("jax") or item.get_closest_marker("core"):
+            continue  # explicitly marked
         path = str(item.fspath)
         if any(fragment in path for fragment in jax_paths):
             item.add_marker(_pytest.mark.jax)

@@ -1,0 +1,223 @@
+"""Behavioral contract tests for the classical model zoo (modeled on the
+reference's parameterized all-model tests — cold users, predict_pairs, save/load)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.models import (
+    AssociationRulesItemRec,
+    CatPopRec,
+    ItemKNN,
+    KLUCB,
+    PopRec,
+    QueryPopRec,
+    RandomRec,
+    ThompsonSampling,
+    UCB,
+    Wilson,
+)
+
+K = 3
+NUM_USERS = 12
+NUM_ITEMS = 8
+
+
+def binary_log(seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for user in range(NUM_USERS):
+        n = rng.integers(2, 6)
+        items = rng.choice(NUM_ITEMS, size=n, replace=False)
+        for t, item in enumerate(items):
+            # popular items succeed more often -> bandits have signal
+            rows.append((user, int(item), int(rng.random() < (0.3 + 0.08 * item)), t))
+    return pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+
+
+def make_dataset(log=None, item_features=None):
+    schema = [
+        FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+        FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+        FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+        FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+    ]
+    from replay_tpu.data.schema import FeatureSource
+
+    if item_features is not None:
+        schema.append(
+            FeatureInfo("category", FeatureType.CATEGORICAL, feature_source=FeatureSource.ITEM_FEATURES)
+        )
+    return Dataset(
+        feature_schema=FeatureSchema(schema),
+        interactions=log if log is not None else binary_log(),
+        item_features=item_features,
+    )
+
+
+MODELS = [
+    PopRec(),
+    PopRec(use_rating=True),
+    RandomRec(seed=7),
+    RandomRec(distribution="popular_based", alpha=1.0, seed=7),
+    Wilson(),
+    UCB(),
+    KLUCB(),
+    ThompsonSampling(seed=3),
+    ItemKNN(num_neighbours=4),
+    ItemKNN(num_neighbours=4, weighting="tf_idf"),
+    ItemKNN(num_neighbours=4, weighting="bm25", use_rating=True),
+    AssociationRulesItemRec(num_neighbours=6),
+    AssociationRulesItemRec(num_neighbours=6, use_lift=True),
+]
+
+
+@pytest.mark.parametrize("model", MODELS, ids=lambda m: f"{type(m).__name__}-{id(m) % 100}")
+def test_fit_predict_contract(model):
+    dataset = make_dataset()
+    recs = model.fit_predict(dataset, k=K)
+    assert set(recs.columns) >= {"query_id", "item_id", "rating"}
+    per_user = recs.groupby("query_id").size()
+    assert (per_user <= K).all()
+    # no seen items recommended
+    seen = set(map(tuple, dataset.interactions[["query_id", "item_id"]].to_numpy()))
+    assert not seen.intersection(map(tuple, recs[["query_id", "item_id"]].to_numpy()))
+    # scores are finite and sorted within each user
+    assert np.isfinite(recs["rating"]).all()
+    for _, group in recs.groupby("query_id"):
+        assert (np.diff(group["rating"].to_numpy()) <= 1e-9).all()
+
+
+@pytest.mark.parametrize("model", [PopRec(), Wilson(), ItemKNN(num_neighbours=4)],
+                         ids=lambda m: type(m).__name__)
+def test_save_load_same_predictions(model, tmp_path):
+    dataset = make_dataset()
+    recs_before = model.fit_predict(dataset, k=K)
+    model.save(str(tmp_path / "model"))
+    restored = type(model).load(str(tmp_path / "model"))
+    recs_after = restored.predict(dataset, k=K)
+    pd.testing.assert_frame_equal(
+        recs_before.reset_index(drop=True), recs_after.reset_index(drop=True)
+    )
+
+
+def test_predict_pairs():
+    dataset = make_dataset()
+    model = PopRec().fit(dataset)
+    pairs = pd.DataFrame({"query_id": [0, 0, 1], "item_id": [1, 2, 3]})
+    scored = model.predict_pairs(pairs, dataset)
+    assert len(scored) == 3
+    assert "rating" in scored.columns
+    # same item gets the same popularity for different users
+    same_item = model.predict_pairs(
+        pd.DataFrame({"query_id": [0, 5], "item_id": [2, 2]}), dataset
+    )
+    assert same_item["rating"].iloc[0] == same_item["rating"].iloc[1]
+
+
+def test_pop_rec_cold_items_and_users():
+    dataset = make_dataset()
+    model = PopRec().fit(dataset)
+    # cold user (not in training): still gets recommendations (non-personalized)
+    recs = model.predict(dataset, k=K, queries=[999], filter_seen_items=False)
+    assert set(recs["query_id"]) == {999}
+    assert len(recs) == K
+    # cold item in the pool: gets the cold fill value, not NaN
+    recs2 = model.predict(dataset, k=NUM_ITEMS + 1, queries=[999],
+                          items=np.arange(NUM_ITEMS + 1), filter_seen_items=False)
+    assert np.isfinite(recs2["rating"]).all()
+    cold_score = recs2[recs2["item_id"] == NUM_ITEMS]["rating"].iloc[0]
+    assert cold_score == pytest.approx(model._fill_value)
+
+
+def test_pop_rec_values():
+    log = pd.DataFrame(
+        {
+            "query_id": [0, 1, 2, 0, 1, 0],
+            "item_id": [0, 0, 0, 1, 1, 2],
+            "rating": [1.0] * 6,
+            "timestamp": range(6),
+        }
+    )
+    model = PopRec().fit(make_dataset(log))
+    pop = model.item_popularity.set_index("item_id")["rating"]
+    assert pop[0] == pytest.approx(1.0)  # all 3 users
+    assert pop[1] == pytest.approx(2 / 3)
+    assert pop[2] == pytest.approx(1 / 3)
+
+
+def test_query_pop_rec():
+    log = pd.DataFrame(
+        {
+            "query_id": [0, 0, 0, 1, 1],
+            "item_id": [5, 5, 6, 6, 7],
+            "rating": [1.0] * 5,
+            "timestamp": range(5),
+        }
+    )
+    model = QueryPopRec().fit(make_dataset(log))
+    recs = model.predict(make_dataset(log), k=1)
+    by_user = recs.set_index("query_id")["item_id"]
+    assert by_user[0] == 5  # user 0's most repeated item
+    assert by_user[1] in (6, 7)
+
+
+def test_cat_pop_rec():
+    log = binary_log()
+    item_features = pd.DataFrame(
+        {"item_id": np.arange(NUM_ITEMS), "category": ["a", "a", "a", "a", "b", "b", "b", "b"]}
+    )
+    model = CatPopRec().fit(make_dataset(log, item_features))
+    per_cat = model.predict_for_categories(["a", "b"], k=2)
+    assert set(per_cat["category"]) == {"a", "b"}
+    assert (per_cat.groupby("category").size() == 2).all()
+    # items recommended for a category belong to it
+    assert set(per_cat[per_cat["category"] == "a"]["item_id"]) <= {0, 1, 2, 3}
+
+
+def test_item_knn_neighbours_and_scores():
+    # users 0..3 all take items (0,1) together; item 2 is solo
+    log = pd.DataFrame(
+        {
+            "query_id": [0, 0, 1, 1, 2, 2, 3],
+            "item_id": [0, 1, 0, 1, 0, 1, 2],
+            "rating": [1.0] * 7,
+            "timestamp": range(7),
+        }
+    )
+    model = ItemKNN(num_neighbours=2).fit(make_dataset(log))
+    nearest = model.get_nearest_items([0], k=1)
+    assert nearest["neighbour_item_idx"].iloc[0] == 1
+    # a user who saw item 0 gets item 1 recommended above item 2
+    recs = model.predict(make_dataset(log), k=2, queries=[3], filter_seen_items=True)
+    assert recs.empty or 2 not in set(recs["item_id"])  # item 2 is what they saw
+
+
+def test_bandit_scores_ordering():
+    # strongly different success rates -> Wilson/UCB/KLUCB must rank accordingly
+    rows = []
+    for u in range(30):
+        rows.append((u, 0, 1, 0))  # item 0 always succeeds
+        rows.append((u, 1, int(u % 5 == 0), 1))  # item 1 rarely succeeds
+    log = pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+    for model in (Wilson(), UCB(), KLUCB()):
+        model.fit(make_dataset(log))
+        pop = model.item_popularity.set_index("item_id")["rating"]
+        assert pop[0] > pop[1], type(model).__name__
+    with pytest.raises(ValueError, match="binary"):
+        Wilson().fit(make_dataset(binary_log().assign(rating=2.5)))
+
+
+def test_random_rec_deterministic_with_seed():
+    dataset = make_dataset()
+    a = RandomRec(seed=5).fit_predict(dataset, k=K)
+    b = RandomRec(seed=5).fit_predict(dataset, k=K)
+    pd.testing.assert_frame_equal(a, b)
+    c = RandomRec(seed=6).fit_predict(dataset, k=K)
+    assert not a["item_id"].equals(c["item_id"])
+
+
+def test_unfitted_predict_raises():
+    with pytest.raises(RuntimeError, match="not fitted"):
+        PopRec().predict(make_dataset(), k=1)

@@ -1,0 +1,125 @@
+"""JAX-backed classical models: ALS, SLIM, Word2Vec, ClusterRec, LinUCB."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.data.schema import FeatureSource
+from replay_tpu.models import ALS, SLIM, ClusterRec, LinUCB, Word2VecRec
+
+pytestmark = pytest.mark.jax
+
+
+def block_log(num_users=16, group_size=10):
+    """Two disjoint taste groups: users 0..7 like items 0..group_size-1, users
+    8..15 like the other half; each user sees 4, leaving unseen in-group items."""
+    rows = []
+    rng = np.random.default_rng(0)
+    for user in range(num_users):
+        group = user // (num_users // 2)
+        liked = np.arange(group_size) + group * group_size
+        chosen = rng.choice(liked, size=4, replace=False)
+        for t, item in enumerate(chosen):
+            rows.append((user, int(item), 1.0, t))
+    return pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+
+
+def make_dataset(log, query_features=None):
+    schema = [
+        FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+        FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+        FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+        FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+    ]
+    if query_features is not None:
+        for column in query_features.columns:
+            if column != "query_id":
+                schema.append(
+                    FeatureInfo(column, FeatureType.NUMERICAL,
+                                feature_source=FeatureSource.QUERY_FEATURES)
+                )
+    return Dataset(
+        feature_schema=FeatureSchema(schema), interactions=log, query_features=query_features
+    )
+
+
+@pytest.mark.parametrize("implicit", [True, False], ids=["implicit", "explicit"])
+def test_als_learns_block_structure(implicit):
+    log = block_log()
+    model = ALS(rank=4, implicit_prefs=implicit, num_iterations=8, seed=0)
+    recs = model.fit_predict(make_dataset(log), k=3)
+    # recommendations stay within the user's taste group overwhelmingly
+    in_group = 0
+    for _, row in recs.iterrows():
+        group = row["query_id"] // 8
+        in_group += group * 10 <= row["item_id"] < (group + 1) * 10
+    assert in_group / len(recs) > 0.8
+    assert model.user_factors.shape == (16, 4) and model.item_factors.shape == (20, 4)
+
+
+def test_als_save_load(tmp_path):
+    model = ALS(rank=4, num_iterations=4, seed=0)
+    dataset = make_dataset(block_log())
+    before = model.fit_predict(dataset, k=2)
+    model.save(str(tmp_path / "als"))
+    after = ALS.load(str(tmp_path / "als")).predict(dataset, k=2)
+    pd.testing.assert_frame_equal(before.reset_index(drop=True), after.reset_index(drop=True))
+
+
+def test_slim_learns_cooccurrence():
+    model = SLIM(beta=0.01, lambda_=0.001, num_iterations=200)
+    recs = model.fit_predict(make_dataset(block_log()), k=2)
+    in_group = np.mean(
+        [(row["query_id"] // 8) * 10 <= row["item_id"] < (row["query_id"] // 8 + 1) * 10
+         for _, row in recs.iterrows()]
+    )
+    assert in_group > 0.8
+    # diagonal is zero and weights are non-negative (SLIM constraints)
+    assert (np.diag(model.similarity) == 0).all()
+    assert (model.similarity >= 0).all()
+
+
+def test_word2vec_group_similarity():
+    model = Word2VecRec(rank=16, num_iterations=80, window_size=3, seed=0)
+    model.fit(make_dataset(block_log(num_users=32)))
+    vectors = model.item_vectors / np.linalg.norm(model.item_vectors, axis=1, keepdims=True)
+    sims = vectors @ vectors.T
+    within = np.mean([sims[i, j] for i in range(10) for j in range(10) if i != j])
+    across = np.mean([sims[i, j] for i in range(10) for j in range(10, 20)])
+    assert within > across
+    recs = model.predict(make_dataset(block_log(num_users=32)), k=2)
+    assert (recs.groupby("query_id").size() <= 2).all()
+
+
+def test_cluster_rec():
+    log = block_log()
+    query_features = pd.DataFrame(
+        {"query_id": np.arange(16), "feat": np.where(np.arange(16) < 8, 0.0, 10.0)}
+    )
+    dataset = make_dataset(log, query_features)
+    model = ClusterRec(num_clusters=2, seed=0)
+    recs = model.fit_predict(dataset, k=2, filter_seen_items=False)
+    for _, row in recs.iterrows():
+        group = row["query_id"] // 8
+        assert group * 10 <= row["item_id"] < (group + 1) * 10
+    with pytest.raises(ValueError, match="query_features"):
+        ClusterRec().fit(make_dataset(log))
+
+
+def test_lin_ucb():
+    # context dimension separates the groups: reward = context matches item group
+    log = block_log()
+    query_features = pd.DataFrame(
+        {"query_id": np.arange(16), "bias": 1.0,
+         "taste": np.where(np.arange(16) < 8, -1.0, 1.0)}
+    )
+    dataset = make_dataset(log, query_features)
+    model = LinUCB(alpha=0.1).fit(dataset)
+    recs = model.predict(dataset, k=3, filter_seen_items=False)
+    in_group = np.mean(
+        [(row["query_id"] // 8) * 10 <= row["item_id"] < (row["query_id"] // 8 + 1) * 10
+         for _, row in recs.iterrows()]
+    )
+    assert in_group > 0.7
+    model.save(str(__import__("tempfile").mkdtemp() + "/linucb"))
