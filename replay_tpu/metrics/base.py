@@ -11,7 +11,7 @@ accepted when those engines are installed by converting to pandas at the boundar
 from __future__ import annotations
 
 import warnings
-from abc import ABC, abstractmethod
+from abc import ABC
 from typing import Dict, List, Union
 
 import numpy as np
@@ -129,6 +129,7 @@ class Metric(ABC):
         }
 
     @staticmethod
-    @abstractmethod
     def _user_metric(ks: List[int], *args) -> List[float]:
-        """Per-user metric values, one per k."""
+        """Per-user metric values, one per k (loop path; vectorized metrics
+        override :meth:`_evaluate` instead)."""
+        raise NotImplementedError

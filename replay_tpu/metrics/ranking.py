@@ -1,132 +1,149 @@
-"""Ranking accuracy metrics.
+"""Ranking accuracy metrics, vectorized over users.
 
 Capability parity with the reference set (replay/metrics/hitrate.py … rocauc.py):
-HitRate, Precision, Recall, MAP, MRR, NDCG, RocAuc — identical per-user math.
+HitRate, Precision, Recall, MAP, MRR, NDCG, RocAuc — same metric definitions,
+computed very differently: instead of a per-user python loop, every metric is
+derived from ONE [users, max_k] hit matrix built with vectorized pandas joins
+(explode + merge), so the dataframe battery scales to ML-20M-sized rec lists.
+(The device-side MetricsBuilder in replay_tpu.metrics.builder shares the same
+hit-matrix formulation.)
 """
 
 from __future__ import annotations
 
-import math
-from typing import List
+import numpy as np
+import pandas as pd
 
-from .base import Metric
+from .base import Metric, MetricsReturnType
 
 
-class HitRate(Metric):
+class RankingMetric(Metric):
+    """Shared vectorized evaluation: subclasses map the hit matrix to values."""
+
+    def _evaluate(self, ground_truth: dict, recs: dict, *extra) -> MetricsReturnType:
+        users = list(ground_truth.keys())
+        max_k = max(self.topk)
+        hits, gt_count, pred_len = _hit_matrix(users, ground_truth, recs, max_k)
+        per_k = {
+            k: self._from_hits(k, hits[:, :k], gt_count, np.minimum(pred_len, k))
+            for k in self.topk
+        }
+        if self._mode.__name__ == "PerUser":
+            return {
+                f"{self.__name__}@{k}": dict(zip(users, per_k[k])) for k in self.topk
+            }
+        return {
+            f"{self.__name__}@{k}": float(self._mode.cpu(per_k[k])) for k in self.topk
+        }
+
+    def _from_hits(
+        self, k: int, hits: np.ndarray, gt_count: np.ndarray, pred_len: np.ndarray
+    ) -> np.ndarray:
+        """[U] metric values from the boolean hit matrix restricted to top-k."""
+        raise NotImplementedError
+
+
+def _hit_matrix(users, ground_truth: dict, recs: dict, max_k: int):
+    """(hits [U, max_k] bool, gt_count [U], pred_len [U]) via exploded joins."""
+    n = len(users)
+    hits = np.zeros((n, max_k), dtype=bool)
+    gt_count = np.zeros(n, dtype=np.int64)
+    pred_len = np.zeros(n, dtype=np.int64)
+    if not n:
+        return hits, gt_count, pred_len
+    # ordered-set semantics: duplicate rec items keep their FIRST rank only and
+    # ground truth is a set — recall stays <= 1 even on duplicated inputs (the
+    # base class warns separately on duplicates)
+    rec_lists = pd.Series([list(dict.fromkeys(recs.get(u) or []))[:max_k] for u in users])
+    gt_lists = pd.Series([list(dict.fromkeys(ground_truth.get(u) or [])) for u in users])
+    gt_count[:] = gt_lists.map(len).to_numpy()
+    pred_len[:] = rec_lists.map(len).to_numpy()
+
+    long = rec_lists.explode().dropna().rename("item").reset_index()
+    if long.empty:
+        return hits, gt_count, pred_len
+    long["rank"] = long.groupby("index").cumcount()
+    gt_long = (
+        gt_lists.explode().dropna().rename("item").reset_index().drop_duplicates()
+    )
+    merged = long.merge(gt_long.assign(__hit=True), on=["index", "item"], how="left")
+    hit_rows = merged[merged["__hit"].notna()]
+    hits[hit_rows["index"].to_numpy(), hit_rows["rank"].to_numpy()] = True
+    return hits, gt_count, pred_len
+
+
+def _safe_div(numerator: np.ndarray, denominator: np.ndarray) -> np.ndarray:
+    return np.where(denominator > 0, numerator / np.maximum(denominator, 1), 0.0)
+
+
+class HitRate(RankingMetric):
     """1 if any of the top-k recommendations is relevant."""
 
-    @staticmethod
-    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
-        if not ground_truth or not pred:
-            return [0.0] * len(ks)
-        gt = set(ground_truth)
-        return [1.0 if any(item in gt for item in pred[:k]) else 0.0 for k in ks]
+    def _from_hits(self, k, hits, gt_count, pred_len):
+        return hits.any(axis=1).astype(np.float64)
 
 
-class Precision(Metric):
+class Precision(RankingMetric):
     """Fraction of the top-k recommendations that are relevant."""
 
-    @staticmethod
-    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
-        if not ground_truth or not pred:
-            return [0.0] * len(ks)
-        gt = set(ground_truth)
-        return [len(set(pred[:k]) & gt) / k for k in ks]
+    def _from_hits(self, k, hits, gt_count, pred_len):
+        present = (gt_count > 0) & (pred_len > 0)
+        return np.where(present, hits.sum(axis=1) / k, 0.0)
 
 
-class Recall(Metric):
+class Recall(RankingMetric):
     """Fraction of the relevant items captured in the top-k recommendations."""
 
-    @staticmethod
-    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
-        if not ground_truth or not pred:
-            return [0.0] * len(ks)
-        gt = set(ground_truth)
-        return [len(set(pred[:k]) & gt) / len(gt) for k in ks]
+    def _from_hits(self, k, hits, gt_count, pred_len):
+        return _safe_div(hits.sum(axis=1), gt_count)
 
 
-class MAP(Metric):
+class MAP(RankingMetric):
     """Mean average precision at k."""
 
-    @staticmethod
-    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
-        if not ground_truth or not pred:
-            return [0.0] * len(ks)
-        gt = set(ground_truth)
-        out = []
-        for k in ks:
-            length = min(k, len(pred))
-            max_good = min(k, len(ground_truth))
-            hits = 0
-            total = 0.0
-            for i in range(length):
-                if pred[i] in gt:
-                    hits += 1
-                    total += hits / (i + 1)
-            out.append(total / max_good)
-        return out
+    def _from_hits(self, k, hits, gt_count, pred_len):
+        h = hits.astype(np.float64)
+        precision_at_rank = np.cumsum(h, axis=1) / (np.arange(k) + 1.0)[None, :]
+        ap = (h * precision_at_rank).sum(axis=1)
+        return _safe_div(ap, np.minimum(gt_count, k))
 
 
-class MRR(Metric):
+class MRR(RankingMetric):
     """Reciprocal rank of the first relevant recommendation."""
 
-    @staticmethod
-    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
-        if not ground_truth or not pred:
-            return [0.0] * len(ks)
-        gt = set(ground_truth)
-        out = []
-        for k in ks:
-            value = 0.0
-            for rank, item in enumerate(pred[:k]):
-                if item in gt:
-                    value = 1.0 / (rank + 1)
-                    break
-            out.append(value)
-        return out
+    def _from_hits(self, k, hits, gt_count, pred_len):
+        first = hits.argmax(axis=1)
+        return np.where(hits.any(axis=1), 1.0 / (first + 1.0), 0.0)
 
 
-class NDCG(Metric):
+class NDCG(RankingMetric):
     """Normalized discounted cumulative gain at k."""
 
-    @staticmethod
-    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
-        if not ground_truth or not pred:
-            return [0.0] * len(ks)
-        gt = set(ground_truth)
-        out = []
-        for k in ks:
-            pred_len = min(k, len(pred))
-            gt_len = min(k, len(ground_truth))
-            discount = [1.0 / math.log2(i + 2) for i in range(k)]
-            dcg = sum(discount[i] for i in range(pred_len) if pred[i] in gt)
-            idcg = sum(discount[:gt_len])
-            out.append(dcg / idcg)
-        return out
+    def _from_hits(self, k, hits, gt_count, pred_len):
+        discounts = 1.0 / np.log2(np.arange(k) + 2.0)
+        dcg = (hits * discounts[None, :]).sum(axis=1)
+        ideal_table = np.concatenate([[0.0], np.cumsum(discounts)])
+        idcg = ideal_table[np.clip(gt_count, 0, k)]
+        return _safe_div(dcg, idcg)
 
 
-class RocAuc(Metric):
-    """AUC of relevant-vs-irrelevant ordering within the top-k list."""
+class RocAuc(RankingMetric):
+    """AUC of relevant-vs-irrelevant ordering within the top-k list.
 
-    @staticmethod
-    def _user_metric(ks: List[int], ground_truth, pred) -> List[float]:
-        if not ground_truth or not pred:
-            return [0.0] * len(ks)
-        gt = set(ground_truth)
-        out = []
-        for k in ks:
-            length = min(k, len(pred))
-            fp_cur = 0
-            fp_cum = 0
-            for item in pred[:length]:
-                if item in gt:
-                    fp_cum += fp_cur
-                else:
-                    fp_cur += 1
-            if fp_cur == length:
-                out.append(0.0)
-            elif fp_cum == 0:
-                out.append(1.0)
-            else:
-                out.append(1 - fp_cum / (fp_cur * (length - fp_cur)))
-        return out
+    Concordance formulation: every (relevant, irrelevant) pair where the relevant
+    item ranks higher counts as concordant; AUC = concordant / (pos × neg). A
+    list with no irrelevant items scores 1, with no relevant items 0 — the same
+    boundary convention as the reference.
+    """
+
+    def _from_hits(self, k, hits, gt_count, pred_len):
+        in_list = np.arange(k)[None, :] < pred_len[:, None]
+        negatives = in_list & ~hits
+        # negatives ranked strictly above each position
+        neg_above = np.cumsum(negatives, axis=1) - negatives
+        pos_total = hits.sum(axis=1).astype(np.float64)
+        neg_total = negatives.sum(axis=1).astype(np.float64)
+        concordant = (hits * (neg_total[:, None] - neg_above)).sum(axis=1)
+        auc = _safe_div(concordant, pos_total * neg_total)
+        auc = np.where((pos_total > 0) & (neg_total == 0), 1.0, auc)
+        return np.where(pred_len == 0, 0.0, auc)

@@ -52,6 +52,11 @@ class ALS(BaseRecommender):
     """Matrix factorization via alternating least squares (implicit or explicit)."""
 
     _init_arg_names = ["rank", "implicit_prefs", "alpha", "reg", "num_iterations", "seed"]
+    _search_space = {
+        "rank": {"type": "int", "args": [8, 128]},
+        "reg": {"type": "loguniform", "args": [1e-3, 1.0]},
+        "alpha": {"type": "uniform", "args": [10.0, 60.0]},
+    }
 
     def __init__(
         self,

@@ -23,8 +23,10 @@ import pandas as pd
 
 from replay_tpu.data.dataset import Dataset
 
+from .optimization import OptimizeMixin
 
-class BaseRecommender:
+
+class BaseRecommender(OptimizeMixin):
     """fit/predict contract shared by every classical model."""
 
     _init_arg_names: Sequence[str] = []
@@ -37,6 +39,7 @@ class BaseRecommender:
         self.timestamp_column: Optional[str] = "timestamp"
         self.fit_queries: Optional[np.ndarray] = None
         self.fit_items: Optional[np.ndarray] = None
+        self._predict_k: Optional[int] = None
 
     # -- fit ---------------------------------------------------------------- #
     def fit(self, dataset: Dataset) -> "BaseRecommender":
@@ -90,6 +93,7 @@ class BaseRecommender:
             self.fit_items if items is None else np.asarray(pd.Series(items).unique())
         )
 
+        self._predict_k = k  # read by _broadcast_item_scores' candidate pruning
         scores = self._predict_scores(dataset, queries, items)
         if filter_seen_items and interactions is not None:
             seen = interactions[
@@ -125,6 +129,7 @@ class BaseRecommender:
     def predict_pairs(self, pairs: pd.DataFrame, dataset: Optional[Dataset] = None) -> pd.DataFrame:
         """Score the given (query, item) pairs (ref base_rec.py:795)."""
         self._check_fitted()
+        self._predict_k = None  # no candidate pruning: every pair must be scored
         queries = np.sort(pairs[self.query_column].unique())
         items = np.asarray(pairs[self.item_column].unique())
         scores = self._predict_scores(dataset, queries, items)
@@ -148,14 +153,19 @@ class BaseRecommender:
                 [pool, pd.DataFrame({self.item_column: missing, "rating": np.nan})],
                 ignore_index=True,
             )
-        if k_hint is not None and dataset is not None:
+        if k_hint is None:
+            k_hint = getattr(self, "_predict_k", None)
+        if k_hint is not None and dataset is not None and len(pool) > k_hint:
             max_seen = (
                 dataset.interactions.groupby(self.query_column)[self.item_column]
                 .nunique()
                 .max()
             )
-            pool = pool.nlargest(k_hint + int(max_seen), "rating")
-        pool = pool.rename(columns={"rating": "rating"})
+            # NaN (cold) rows survive the prune so their fill value applies
+            cold = pool[pool["rating"].isna()]
+            pool = pd.concat(
+                [pool.nlargest(k_hint + int(max_seen), "rating"), cold]
+            ).drop_duplicates(subset=self.item_column)
         out = pd.MultiIndex.from_product(
             [queries, pool[self.item_column]], names=[self.query_column, self.item_column]
         ).to_frame(index=False)

@@ -26,6 +26,11 @@ from .base import BaseRecommender
 
 class ItemKNN(BaseRecommender):
     _init_arg_names = ["num_neighbours", "use_rating", "shrink", "weighting"]
+    _search_space = {
+        "num_neighbours": {"type": "int", "args": [5, 100]},
+        "shrink": {"type": "uniform", "args": [0.0, 50.0]},
+        "weighting": {"type": "categorical", "args": [None, "tf_idf", "bm25"]},
+    }
 
     def __init__(
         self,

@@ -1,0 +1,123 @@
+"""Hyperparameter optimization for classical models.
+
+Capability parity with replay/models/optimization/optuna_mixin.py:17,168 (the
+``optimize`` entry point: per-model declarative search spaces, an objective that
+fits + predicts + scores a metric per trial, user-overridable ``param_borders``).
+
+Backend: optuna's TPE when installed (``OPTUNA_AVAILABLE``); otherwise a seeded
+random-search sampler with the same trial loop — the API and results schema are
+identical, so code written against ``optimize`` runs in this image (optuna is not
+baked in) and speeds up transparently where optuna exists.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from replay_tpu.utils.types import OPTUNA_AVAILABLE
+
+logger = logging.getLogger("replay_tpu")
+
+# search-space entry: {"type": "int"|"uniform"|"loguniform"|"categorical", "args": [...]}
+SearchSpace = Dict[str, Dict[str, Any]]
+
+
+def _sample(rng: np.random.Generator, spec: Dict[str, Any]):
+    kind, args = spec["type"], spec["args"]
+    if kind == "int":
+        return int(rng.integers(args[0], args[1] + 1))
+    if kind == "uniform":
+        return float(rng.uniform(args[0], args[1]))
+    if kind == "loguniform":
+        return float(np.exp(rng.uniform(np.log(args[0]), np.log(args[1]))))
+    if kind == "categorical":
+        return args[int(rng.integers(len(args)))]
+    msg = f"Unknown search-space type: {kind}"
+    raise ValueError(msg)
+
+
+def _suggest_optuna(trial, name: str, spec: Dict[str, Any]):  # pragma: no cover - optuna absent
+    kind, args = spec["type"], spec["args"]
+    if kind == "int":
+        return trial.suggest_int(name, args[0], args[1])
+    if kind == "uniform":
+        return trial.suggest_float(name, args[0], args[1])
+    if kind == "loguniform":
+        return trial.suggest_float(name, args[0], args[1], log=True)
+    if kind == "categorical":
+        return trial.suggest_categorical(name, args)
+    msg = f"Unknown search-space type: {kind}"
+    raise ValueError(msg)
+
+
+class OptimizeMixin:
+    """Adds ``optimize`` to a recommender with a ``_search_space`` declaration."""
+
+    _search_space: SearchSpace = {}
+
+    def optimize(
+        self,
+        train_dataset,
+        test_dataset,
+        param_borders: Optional[SearchSpace] = None,
+        criterion=None,
+        k: int = 10,
+        budget: int = 10,
+        seed: int = 0,
+    ) -> Dict[str, Any]:
+        """Search ``budget`` configurations; returns the best params (also set on
+        ``self``, refit on the winning configuration)."""
+        space = {**self._search_space, **(param_borders or {})}
+        if not space:
+            msg = f"{type(self).__name__} declares no search space."
+            raise ValueError(msg)
+        if criterion is None:
+            from replay_tpu.metrics import NDCG
+
+            criterion = NDCG(k)
+        test_interactions = test_dataset.interactions
+
+        base_args = {
+            name: getattr(self, name)
+            for name in getattr(self, "_init_arg_names", [])
+            if hasattr(self, name)
+        }
+
+        def run_trial(params: Dict[str, Any]) -> float:
+            # non-searched constructor args keep the tuned model's values
+            candidate = type(self)(**{**base_args, **params})
+            recs = candidate.fit_predict(train_dataset, k=k)
+            values = criterion(recs, test_interactions)
+            return float(next(iter(values.values())))
+
+        results = []
+        if OPTUNA_AVAILABLE:  # pragma: no cover - optuna absent in this image
+            import optuna
+
+            optuna.logging.set_verbosity(optuna.logging.WARNING)
+            study = optuna.create_study(
+                direction="maximize", sampler=optuna.samplers.TPESampler(seed=seed)
+            )
+
+            def objective(trial):
+                params = {n: _suggest_optuna(trial, n, s) for n, s in space.items()}
+                return run_trial(params)
+
+            study.optimize(objective, n_trials=budget)
+            best_params = study.best_params
+        else:
+            rng = np.random.default_rng(seed)
+            for _ in range(budget):
+                params = {name: _sample(rng, spec) for name, spec in space.items()}
+                value = run_trial(params)
+                results.append((value, params))
+                logger.info("trial %s -> %.5f", params, value)
+            best_params = max(results, key=lambda r: r[0])[1]
+
+        for name, value in best_params.items():
+            setattr(self, name, value)
+        self.fit(train_dataset)
+        return best_params

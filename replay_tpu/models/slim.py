@@ -23,6 +23,10 @@ from .knn import ItemKNN
 
 class SLIM(ItemKNN):
     _init_arg_names = ["beta", "lambda_", "num_iterations", "seed"]
+    _search_space = {
+        "beta": {"type": "loguniform", "args": [1e-4, 1.0]},
+        "lambda_": {"type": "loguniform", "args": [1e-5, 0.1]},
+    }
 
     def __init__(
         self,

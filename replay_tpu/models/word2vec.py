@@ -28,6 +28,11 @@ class Word2VecRec(BaseRecommender):
         "rank", "window_size", "num_negatives", "num_iterations", "learning_rate",
         "use_idf", "seed",
     ]
+    _search_space = {
+        "rank": {"type": "int", "args": [16, 128]},
+        "window_size": {"type": "int", "args": [1, 5]},
+        "use_idf": {"type": "categorical", "args": [True, False]},
+    }
 
     def __init__(
         self,

@@ -1,3 +1,9 @@
+from .converter import CSRConverter
+from .discretizer import (
+    Discretizer,
+    QuantileDiscretizingRule,
+    UniformDiscretizingRule,
+)
 from .filters import (
     ConsecutiveDuplicatesFilter,
     EntityDaysFilter,
@@ -9,6 +15,7 @@ from .filters import (
     QuantileItemsFilter,
     TimePeriodFilter,
 )
+from .history_based_fp import EmptyFeatureProcessor, HistoryBasedFeaturesProcessor
 from .label_encoder import (
     LabelEncoder,
     LabelEncoderPartialFitWarning,
@@ -16,11 +23,16 @@ from .label_encoder import (
     LabelEncodingRule,
     SequenceEncodingRule,
 )
+from .sessionizer import Sessionizer
 
 __all__ = [
+    "CSRConverter",
     "ConsecutiveDuplicatesFilter",
+    "Discretizer",
+    "EmptyFeatureProcessor",
     "EntityDaysFilter",
     "GlobalDaysFilter",
+    "HistoryBasedFeaturesProcessor",
     "InteractionEntriesFilter",
     "LabelEncoder",
     "LabelEncoderPartialFitWarning",
@@ -29,7 +41,10 @@ __all__ = [
     "LowRatingFilter",
     "MinCountFilter",
     "NumInteractionsFilter",
+    "QuantileDiscretizingRule",
     "QuantileItemsFilter",
     "SequenceEncodingRule",
+    "Sessionizer",
     "TimePeriodFilter",
+    "UniformDiscretizingRule",
 ]

@@ -1,0 +1,54 @@
+"""Interactions → sparse CSR matrix.
+
+Capability parity with replay/preprocessing/converter.py:10 (CSRConverter:
+data/row/column source columns, optional explicit matrix extent, duplicate
+aggregation). Output is ``scipy.sparse.csr_matrix`` — the standard host-side
+sparse interchange format (e.g. for SLIM/ItemKNN-style solvers or export)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+
+class CSRConverter:
+    def __init__(
+        self,
+        first_dim_column: str = "query_id",
+        second_dim_column: str = "item_id",
+        data_column: Optional[str] = None,
+        row_count: Optional[int] = None,
+        column_count: Optional[int] = None,
+        allow_collect_to_master: bool = True,  # accepted for API parity; pandas is host-side
+    ) -> None:
+        self.first_dim_column = first_dim_column
+        self.second_dim_column = second_dim_column
+        self.data_column = data_column
+        self.row_count = row_count
+        self.column_count = column_count
+
+    def transform(self, interactions: pd.DataFrame):
+        from scipy.sparse import csr_matrix
+
+        rows = interactions[self.first_dim_column].to_numpy()
+        cols = interactions[self.second_dim_column].to_numpy()
+        if not np.issubdtype(rows.dtype, np.integer) or not np.issubdtype(cols.dtype, np.integer):
+            msg = "CSRConverter requires integer-encoded id columns (run LabelEncoder first)."
+            raise ValueError(msg)
+        data = (
+            interactions[self.data_column].to_numpy(np.float64)
+            if self.data_column
+            else np.ones(len(interactions))
+        )
+        shape = (
+            self.row_count if self.row_count is not None else int(rows.max()) + 1,
+            self.column_count if self.column_count is not None else int(cols.max()) + 1,
+        )
+        if (rows >= shape[0]).any() or (cols >= shape[1]).any():
+            msg = "Ids exceed the requested matrix extent."
+            raise ValueError(msg)
+        matrix = csr_matrix((data, (rows, cols)), shape=shape)
+        matrix.sum_duplicates()
+        return matrix

@@ -1,0 +1,142 @@
+"""Numerical-column discretization.
+
+Capability parity with replay/preprocessing/discretizer.py:603 (Discretizer with
+per-column rules): quantile and uniform binning rules with fit / partial-config /
+transform / save-load, NaN passthrough or dedicated bucket, and a bin count that
+collapses gracefully when a column has fewer distinct values than requested bins.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+HANDLE_INVALID = ("error", "skip", "keep")
+
+
+class BaseDiscretizingRule:
+    """One column's binning: fit edges, transform values to bucket ids."""
+
+    def __init__(self, column: str, n_bins: int = 10, handle_invalid: str = "error") -> None:
+        if n_bins < 2:
+            msg = "n_bins must be >= 2"
+            raise ValueError(msg)
+        if handle_invalid not in HANDLE_INVALID:
+            msg = f"handle_invalid must be one of {HANDLE_INVALID}"
+            raise ValueError(msg)
+        self.column = column
+        self.n_bins = n_bins
+        self.handle_invalid = handle_invalid
+        self.bin_edges: Optional[np.ndarray] = None
+
+    def _compute_edges(self, values: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def fit(self, df: pd.DataFrame) -> "BaseDiscretizingRule":
+        values = df[self.column].dropna().to_numpy(np.float64)
+        if len(values) == 0:
+            msg = f"Column '{self.column}' has no non-NaN values to fit on."
+            raise ValueError(msg)
+        edges = np.unique(self._compute_edges(values))
+        if len(edges) < 2:
+            edges = np.array([values.min(), values.max() + 1e-9])
+        self.bin_edges = edges
+        return self
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        if self.bin_edges is None:
+            msg = f"Rule for '{self.column}' is not fitted."
+            raise RuntimeError(msg)
+        values = df[self.column].to_numpy(np.float64)
+        invalid = np.isnan(values)
+        if invalid.any() and self.handle_invalid == "error":
+            msg = f"Column '{self.column}' contains NaN and handle_invalid='error'."
+            raise ValueError(msg)
+        buckets = np.clip(
+            np.searchsorted(self.bin_edges, values, side="right") - 1,
+            0,
+            len(self.bin_edges) - 2,
+        )
+        out = df.copy()
+        if self.handle_invalid == "keep":
+            # NaNs get their own trailing bucket
+            buckets = np.where(invalid, len(self.bin_edges) - 1, buckets)
+            out[self.column] = buckets.astype(np.int64)
+        else:  # skip: leave NaN as NaN
+            result = buckets.astype(np.float64)
+            result[invalid] = np.nan
+            out[self.column] = result if invalid.any() else buckets.astype(np.int64)
+        return out
+
+    def fit_transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        return self.fit(df).transform(df)
+
+    def _as_dict(self) -> dict:
+        return {
+            "_rule": type(self).__name__,
+            "column": self.column,
+            "n_bins": self.n_bins,
+            "handle_invalid": self.handle_invalid,
+            "bin_edges": self.bin_edges.tolist() if self.bin_edges is not None else None,
+        }
+
+
+class QuantileDiscretizingRule(BaseDiscretizingRule):
+    """Equal-frequency bins (quantile edges)."""
+
+    def _compute_edges(self, values: np.ndarray) -> np.ndarray:
+        return np.quantile(values, np.linspace(0, 1, self.n_bins + 1))
+
+
+class UniformDiscretizingRule(BaseDiscretizingRule):
+    """Equal-width bins over [min, max]."""
+
+    def _compute_edges(self, values: np.ndarray) -> np.ndarray:
+        return np.linspace(values.min(), values.max(), self.n_bins + 1)
+
+
+_RULES = {cls.__name__: cls for cls in (QuantileDiscretizingRule, UniformDiscretizingRule)}
+
+
+class Discretizer:
+    """Apply a set of discretizing rules column-wise (ref Discretizer API)."""
+
+    def __init__(self, rules: Sequence[BaseDiscretizingRule]) -> None:
+        self.rules: List[BaseDiscretizingRule] = list(rules)
+
+    def fit(self, df: pd.DataFrame) -> "Discretizer":
+        for rule in self.rules:
+            rule.fit(df)
+        return self
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        for rule in self.rules:
+            df = rule.transform(df)
+        return df
+
+    def fit_transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        return self.fit(df).transform(df)
+
+    def save(self, path: str) -> None:
+        target = Path(path).with_suffix(".replay")
+        target.mkdir(parents=True, exist_ok=True)
+        payload = {"_class_name": "Discretizer", "rules": [r._as_dict() for r in self.rules]}
+        (target / "init_args.json").write_text(json.dumps(payload))
+
+    @classmethod
+    def load(cls, path: str) -> "Discretizer":
+        source = Path(path).with_suffix(".replay")
+        payload = json.loads((source / "init_args.json").read_text())
+        rules = []
+        for spec in payload["rules"]:
+            rule = _RULES[spec["_rule"]](
+                spec["column"], n_bins=spec["n_bins"], handle_invalid=spec["handle_invalid"]
+            )
+            if spec["bin_edges"] is not None:
+                rule.bin_edges = np.asarray(spec["bin_edges"])
+            rules.append(rule)
+        return cls(rules)

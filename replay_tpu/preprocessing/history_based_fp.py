@@ -1,0 +1,165 @@
+"""History-based feature generation for two-stage rerankers.
+
+Capability parity with replay/preprocessing/history_based_fp.py:381
+(HistoryBasedFeaturesProcessor: log-derived query/item statistics + conditional
+popularity features over chosen categorical columns). All aggregations are
+vectorized pandas groupbys; fit stores the feature frames, transform joins them
+onto (query, item) candidate pairs — the second-stage feature-enrichment step of
+the reference's TwoStages scenario.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+class EmptyFeatureProcessor:
+    """No-op stand-in (the reference uses it when a side has no features)."""
+
+    def fit(self, *_args, **_kwargs) -> "EmptyFeatureProcessor":
+        return self
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        return df
+
+
+class HistoryBasedFeaturesProcessor:
+    """Log-derived query/item statistic features.
+
+    Query side: interaction count, distinct items, mean/std rating, history span
+    and recency. Item side: interaction count, distinct queries, mean/std rating,
+    popularity share. Conditional popularity: for each column in
+    ``query_cat_features_list`` / ``item_cat_features_list``, the share of the
+    query's (item's) history falling into each category value.
+    """
+
+    def __init__(
+        self,
+        use_log_features: bool = True,
+        use_conditional_popularity: bool = True,
+        query_cat_features_list: Optional[Sequence[str]] = None,
+        item_cat_features_list: Optional[Sequence[str]] = None,
+        query_column: str = "query_id",
+        item_column: str = "item_id",
+        rating_column: str = "rating",
+        timestamp_column: str = "timestamp",
+    ) -> None:
+        self.use_log_features = use_log_features
+        self.use_conditional_popularity = use_conditional_popularity
+        self.query_cat_features_list = list(query_cat_features_list or [])
+        self.item_cat_features_list = list(item_cat_features_list or [])
+        self.query_column = query_column
+        self.item_column = item_column
+        self.rating_column = rating_column
+        self.timestamp_column = timestamp_column
+        self.query_features: Optional[pd.DataFrame] = None
+        self.item_features: Optional[pd.DataFrame] = None
+        self.conditional_features: List[pd.DataFrame] = []
+        self.fitted = False
+
+    def _log_features(self, log: pd.DataFrame) -> None:
+        has_rating = self.rating_column in log.columns
+        has_ts = self.timestamp_column in log.columns
+        q = log.groupby(self.query_column).agg(
+            q_log_count=(self.item_column, "size"),
+            q_distinct_items=(self.item_column, "nunique"),
+        )
+        i = log.groupby(self.item_column).agg(
+            i_log_count=(self.query_column, "size"),
+            i_distinct_queries=(self.query_column, "nunique"),
+        )
+        if has_rating:
+            q[["q_mean_rating", "q_std_rating"]] = log.groupby(self.query_column)[
+                self.rating_column
+            ].agg(["mean", "std"])
+            i[["i_mean_rating", "i_std_rating"]] = log.groupby(self.item_column)[
+                self.rating_column
+            ].agg(["mean", "std"])
+        if has_ts:
+            ts = log[self.timestamp_column]
+            latest = ts.max()
+            spans = log.groupby(self.query_column)[self.timestamp_column].agg(["min", "max"])
+            q["q_history_span"] = _seconds(spans["max"] - spans["min"])
+            q["q_recency"] = _seconds(latest - spans["max"])
+        i["i_popularity_share"] = i["i_log_count"] / len(log)
+        self.query_features = q.fillna(0.0).reset_index()
+        self.item_features = i.fillna(0.0).reset_index()
+
+    def _conditional(self, log: pd.DataFrame, query_features, item_features) -> None:
+        self.conditional_features = []
+        if item_features is not None:
+            for column in self.item_cat_features_list:
+                joined = log.merge(
+                    item_features[[self.item_column, column]], on=self.item_column, how="left"
+                )
+                share = (
+                    joined.groupby([self.query_column, column])
+                    .size()
+                    .rename("share")
+                    .reset_index()
+                )
+                totals = share.groupby(self.query_column)["share"].transform("sum")
+                share["share"] /= totals
+                wide = share.pivot_table(
+                    index=self.query_column, columns=column, values="share", fill_value=0.0
+                )
+                wide.columns = [f"q_share_{column}_{value}" for value in wide.columns]
+                self.conditional_features.append(
+                    ("query", wide.reset_index())
+                )
+        if query_features is not None:
+            for column in self.query_cat_features_list:
+                joined = log.merge(
+                    query_features[[self.query_column, column]], on=self.query_column, how="left"
+                )
+                share = (
+                    joined.groupby([self.item_column, column])
+                    .size()
+                    .rename("share")
+                    .reset_index()
+                )
+                totals = share.groupby(self.item_column)["share"].transform("sum")
+                share["share"] /= totals
+                wide = share.pivot_table(
+                    index=self.item_column, columns=column, values="share", fill_value=0.0
+                )
+                wide.columns = [f"i_share_{column}_{value}" for value in wide.columns]
+                self.conditional_features.append(("item", wide.reset_index()))
+
+    def fit(
+        self,
+        log: pd.DataFrame,
+        query_features: Optional[pd.DataFrame] = None,
+        item_features: Optional[pd.DataFrame] = None,
+    ) -> "HistoryBasedFeaturesProcessor":
+        if self.use_log_features:
+            self._log_features(log)
+        if self.use_conditional_popularity:
+            self._conditional(log, query_features, item_features)
+        self.fitted = True
+        return self
+
+    def transform(self, pairs: pd.DataFrame) -> pd.DataFrame:
+        """Join the fitted features onto (query, item) candidate pairs."""
+        if not self.fitted:
+            msg = "HistoryBasedFeaturesProcessor is not fitted."
+            raise RuntimeError(msg)
+        out = pairs
+        if self.query_features is not None:
+            out = out.merge(self.query_features, on=self.query_column, how="left")
+        if self.item_features is not None:
+            out = out.merge(self.item_features, on=self.item_column, how="left")
+        for side, frame in self.conditional_features:
+            key = self.query_column if side == "query" else self.item_column
+            out = out.merge(frame, on=key, how="left")
+        feature_columns = [c for c in out.columns if c not in pairs.columns]
+        return out.fillna({c: 0.0 for c in feature_columns})
+
+
+def _seconds(delta):
+    if hasattr(delta, "dt"):
+        return delta.dt.total_seconds()
+    return delta.astype(np.float64)

@@ -1,0 +1,58 @@
+"""Session assignment from timestamp gaps.
+
+Capability parity with replay/preprocessing/sessionizer.py:11: a new session
+starts whenever the gap to the previous event of the same query exceeds
+``session_gap``; sessions shorter than ``min_session_length`` or longer than
+``max_session_length`` can be dropped. Vectorized pandas (sort + diff + cumsum),
+no per-user loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+
+class Sessionizer:
+    def __init__(
+        self,
+        session_gap: float = 86400.0,
+        query_column: str = "query_id",
+        timestamp_column: str = "timestamp",
+        session_column: str = "session_id",
+        min_session_length: Optional[int] = None,
+        max_session_length: Optional[int] = None,
+    ) -> None:
+        if session_gap <= 0:
+            msg = "session_gap must be positive"
+            raise ValueError(msg)
+        self.session_gap = session_gap
+        self.query_column = query_column
+        self.timestamp_column = timestamp_column
+        self.session_column = session_column
+        self.min_session_length = min_session_length
+        self.max_session_length = max_session_length
+
+    def transform(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        ordered = interactions.assign(__pos=np.arange(len(interactions))).sort_values(
+            [self.query_column, self.timestamp_column], kind="stable"
+        )
+        ts = ordered[self.timestamp_column]
+        if np.issubdtype(ts.dtype, np.datetime64):
+            gaps = ts.diff().dt.total_seconds()
+        else:
+            gaps = ts.diff()
+        new_query = ordered[self.query_column] != ordered[self.query_column].shift()
+        boundary = new_query | (gaps > self.session_gap)
+        ordered = ordered.assign(**{self.session_column: boundary.cumsum() - 1})
+        if self.min_session_length is not None or self.max_session_length is not None:
+            sizes = ordered.groupby(self.session_column)[self.session_column].transform("size")
+            keep = pd.Series(True, index=ordered.index)
+            if self.min_session_length is not None:
+                keep &= sizes >= self.min_session_length
+            if self.max_session_length is not None:
+                keep &= sizes <= self.max_session_length
+            ordered = ordered[keep]
+        return ordered.sort_values("__pos", kind="stable").drop(columns="__pos")

@@ -1,0 +1,3 @@
+from .fallback import Fallback
+
+__all__ = ["Fallback"]

@@ -1,0 +1,106 @@
+"""Fallback scenario: a main model backed by a cold-capable fallback.
+
+Capability parity with replay/scenarios/fallback.py:13: both models fit on the
+same dataset; at predict time every query gets the main model's recommendations,
+topped up from the fallback (popularity by default) whenever the main model
+returns fewer than ``k`` items — cold queries the main model cannot score at all
+are served entirely by the fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.models.base import BaseRecommender
+from replay_tpu.models.pop_rec import PopRec
+
+
+class Fallback(BaseRecommender):
+    def __init__(self, main: BaseRecommender, fallback: Optional[BaseRecommender] = None) -> None:
+        super().__init__()
+        self.main = main
+        self.fallback = fallback if fallback is not None else PopRec()
+
+    def _fit(self, dataset: Dataset) -> None:
+        self.main.fit(dataset)
+        self.fallback.fit(dataset)
+
+    def predict(
+        self, dataset, k: int, queries=None, items=None, filter_seen_items: bool = True
+    ) -> pd.DataFrame:
+        self._check_fitted()
+        main_recs = self.main.predict(dataset, k, queries, items, filter_seen_items)
+        fallback_recs = self.fallback.predict(dataset, k, queries, items, filter_seen_items)
+        if queries is None:
+            queries = (
+                np.sort(dataset.interactions[self.query_column].unique())
+                if dataset is not None
+                else self.fit_queries
+            )
+        # shift fallback scores strictly below the main model's minimum so the
+        # top-k never prefers a fallback item over a main one
+        if len(main_recs) and len(fallback_recs):
+            offset = float(main_recs["rating"].min()) - float(fallback_recs["rating"].max()) - 1.0
+            fallback_recs = fallback_recs.assign(rating=fallback_recs["rating"] + offset)
+        combined = pd.concat([main_recs, fallback_recs], ignore_index=True)
+        combined = combined.drop_duplicates(subset=[self.query_column, self.item_column], keep="first")
+        combined = combined[combined[self.query_column].isin(np.asarray(queries))]
+        return self._top_k(combined, k)
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:  # pragma: no cover
+        raise NotImplementedError("Fallback combines child predictions directly.")
+
+    def save(self, path: str) -> None:
+        import json
+        from pathlib import Path
+
+        self._check_fitted()
+        target = Path(path).with_suffix(".replay")
+        target.mkdir(parents=True, exist_ok=True)
+        (target / "init_args.json").write_text(
+            json.dumps(
+                {
+                    "_class_name": "Fallback",
+                    "main": type(self.main).__name__,
+                    "fallback": type(self.fallback).__name__,
+                }
+            )
+        )
+        (target / "fit_info.json").write_text(
+            json.dumps(
+                {
+                    "query_column": self.query_column,
+                    "item_column": self.item_column,
+                    "fit_queries": self.fit_queries.tolist(),
+                    "fit_items": self.fit_items.tolist(),
+                }
+            )
+        )
+        self.main.save(str(target / "main"))
+        self.fallback.save(str(target / "fallback"))
+
+    @classmethod
+    def load(cls, path: str) -> "Fallback":
+        import json
+        from pathlib import Path
+
+        import replay_tpu.models as model_registry
+
+        source = Path(path).with_suffix(".replay")
+        args = json.loads((source / "init_args.json").read_text())
+        main_cls = getattr(model_registry, args["main"])
+        fallback_cls = getattr(model_registry, args["fallback"])
+        scenario = cls(
+            main=main_cls.load(str(source / "main")),
+            fallback=fallback_cls.load(str(source / "fallback")),
+        )
+        info = json.loads((source / "fit_info.json").read_text())
+        scenario.query_column = info["query_column"]
+        scenario.item_column = info["item_column"]
+        scenario.fit_queries = np.asarray(info["fit_queries"])
+        scenario.fit_items = np.asarray(info["fit_items"])
+        return scenario

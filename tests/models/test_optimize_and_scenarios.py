@@ -1,0 +1,74 @@
+"""HPO (random-search backend) and the Fallback scenario."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.models import ItemKNN, PopRec
+from replay_tpu.scenarios import Fallback
+from replay_tpu.splitters import RatioSplitter
+
+
+def make_dataset(log):
+    return Dataset(
+        feature_schema=FeatureSchema(
+            [
+                FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+                FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+                FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+                FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+            ]
+        ),
+        interactions=log,
+    )
+
+
+def grouped_log(num_users=20, group_size=8):
+    rng = np.random.default_rng(0)
+    rows = []
+    for u in range(num_users):
+        liked = np.arange(group_size) + (u % 2) * group_size
+        for t, i in enumerate(rng.choice(liked, 5, replace=False)):
+            rows.append((u, int(i), 1.0, t))
+    return pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+
+
+def test_optimize_random_search():
+    log = grouped_log()
+    train, test = RatioSplitter(test_size=0.4, divide_column="query_id").split(log)
+    model = ItemKNN()
+    best = model.optimize(
+        make_dataset(train), make_dataset(test), budget=4, k=3, seed=0
+    )
+    assert set(best) == {"num_neighbours", "shrink", "weighting"}
+    # the winning params are applied and the model is refit
+    assert model.num_neighbours == best["num_neighbours"]
+    assert model.similarity is not None
+
+
+def test_optimize_no_space_raises():
+    with pytest.raises(ValueError, match="search space"):
+        PopRec().optimize(make_dataset(grouped_log()), make_dataset(grouped_log()))
+
+
+def test_fallback_tops_up_sparse_main():
+    log = grouped_log()
+    dataset = make_dataset(log)
+    # ItemKNN with tiny neighbourhood can return < k items for some users
+    scenario = Fallback(main=ItemKNN(num_neighbours=1), fallback=PopRec())
+    scenario.fit(dataset)
+    recs = scenario.predict(dataset, k=5)
+    per_user = recs.groupby("query_id").size()
+    assert (per_user == 5).all()  # every user topped up to exactly k
+    # seen items still filtered
+    seen = set(map(tuple, log[["query_id", "item_id"]].to_numpy()))
+    assert not seen.intersection(map(tuple, recs[["query_id", "item_id"]].to_numpy()))
+
+
+def test_fallback_cold_query_served():
+    dataset = make_dataset(grouped_log())
+    scenario = Fallback(main=ItemKNN(num_neighbours=2)).fit(dataset)
+    recs = scenario.predict(dataset, k=3, queries=[777], filter_seen_items=False)
+    assert set(recs["query_id"]) == {777}
+    assert len(recs) == 3  # fully served by the popularity fallback

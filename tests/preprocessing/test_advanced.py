@@ -1,0 +1,148 @@
+"""Discretizer, Sessionizer, CSRConverter, HistoryBasedFeaturesProcessor."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.preprocessing import (
+    CSRConverter,
+    Discretizer,
+    HistoryBasedFeaturesProcessor,
+    QuantileDiscretizingRule,
+    Sessionizer,
+    UniformDiscretizingRule,
+)
+
+
+class TestDiscretizer:
+    def test_quantile_bins_balanced(self):
+        df = pd.DataFrame({"x": np.arange(100, dtype=float)})
+        out = Discretizer([QuantileDiscretizingRule("x", n_bins=4)]).fit_transform(df)
+        counts = out["x"].value_counts()
+        assert sorted(out["x"].unique()) == [0, 1, 2, 3]
+        assert counts.max() - counts.min() <= 2  # equal-frequency to within edges
+
+    def test_uniform_bins_edges(self):
+        df = pd.DataFrame({"x": [0.0, 2.5, 4.9, 5.0, 9.9, 10.0]})
+        out = Discretizer([UniformDiscretizingRule("x", n_bins=2)]).fit_transform(df)
+        assert out["x"].tolist() == [0, 0, 0, 1, 1, 1]
+
+    def test_nan_handling(self):
+        df = pd.DataFrame({"x": [1.0, np.nan, 3.0]})
+        with pytest.raises(ValueError, match="NaN"):
+            Discretizer([QuantileDiscretizingRule("x", n_bins=2)]).fit_transform(df)
+        keep = Discretizer([QuantileDiscretizingRule("x", n_bins=2, handle_invalid="keep")])
+        out = keep.fit_transform(df)
+        assert out["x"].iloc[1] == out["x"].max()  # NaN bucket is the extra last one
+        skip = Discretizer([QuantileDiscretizingRule("x", n_bins=2, handle_invalid="skip")])
+        out2 = skip.fit_transform(df)
+        assert np.isnan(out2["x"].iloc[1])
+
+    def test_few_distinct_values(self):
+        df = pd.DataFrame({"x": [1.0, 1.0, 1.0, 2.0]})
+        out = Discretizer([QuantileDiscretizingRule("x", n_bins=10)]).fit_transform(df)
+        assert out["x"].nunique() <= 2
+
+    def test_save_load(self, tmp_path):
+        df = pd.DataFrame({"x": np.arange(50, dtype=float), "y": np.arange(50, dtype=float)})
+        disc = Discretizer(
+            [QuantileDiscretizingRule("x", 4), UniformDiscretizingRule("y", 3)]
+        ).fit(df)
+        disc.save(str(tmp_path / "disc"))
+        restored = Discretizer.load(str(tmp_path / "disc"))
+        pd.testing.assert_frame_equal(disc.transform(df), restored.transform(df))
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            QuantileDiscretizingRule("x", n_bins=1)
+        with pytest.raises(ValueError):
+            QuantileDiscretizingRule("x", handle_invalid="zzz")
+
+
+class TestSessionizer:
+    def test_gap_splits_sessions(self):
+        df = pd.DataFrame(
+            {
+                "query_id": [1, 1, 1, 1, 2],
+                "item_id": [0, 1, 2, 3, 4],
+                "timestamp": [0, 10, 2000, 2010, 5],
+            }
+        )
+        out = Sessionizer(session_gap=100).transform(df)
+        sessions = out["session_id"].tolist()
+        assert sessions[0] == sessions[1]  # gap 10 <= 100
+        assert sessions[2] == sessions[3] != sessions[0]  # gap 1990 > 100
+        assert sessions[4] not in sessions[:4]  # new user -> new session
+        assert out.index.tolist() == df.index.tolist()  # original order kept
+
+    def test_length_filters(self):
+        df = pd.DataFrame(
+            {
+                "query_id": [1] * 3 + [2],
+                "item_id": range(4),
+                "timestamp": [0, 1, 2, 0],
+            }
+        )
+        out = Sessionizer(session_gap=10, min_session_length=2).transform(df)
+        assert set(out["query_id"]) == {1}
+        out2 = Sessionizer(session_gap=10, max_session_length=1).transform(df)
+        assert set(out2["query_id"]) == {2}
+
+
+class TestCSRConverter:
+    def test_basic_and_duplicates(self):
+        df = pd.DataFrame(
+            {"query_id": [0, 0, 1, 1], "item_id": [0, 0, 1, 2], "rating": [1.0, 2.0, 3.0, 4.0]}
+        )
+        matrix = CSRConverter(data_column="rating").transform(df)
+        assert matrix.shape == (2, 3)
+        assert matrix[0, 0] == 3.0  # duplicates summed
+        assert matrix[1, 2] == 4.0
+        ones = CSRConverter().transform(df)
+        assert ones[0, 0] == 2.0
+
+    def test_extent_and_validation(self):
+        df = pd.DataFrame({"query_id": [0], "item_id": [1]})
+        matrix = CSRConverter(row_count=5, column_count=7).transform(df)
+        assert matrix.shape == (5, 7)
+        with pytest.raises(ValueError, match="extent"):
+            CSRConverter(column_count=1).transform(df)
+        with pytest.raises(ValueError, match="integer-encoded"):
+            CSRConverter().transform(pd.DataFrame({"query_id": ["a"], "item_id": [0]}))
+
+
+class TestHistoryBasedFeaturesProcessor:
+    def make_log(self):
+        return pd.DataFrame(
+            {
+                "query_id": [0, 0, 0, 1, 1, 2],
+                "item_id": [0, 1, 2, 0, 1, 2],
+                "rating": [5.0, 3.0, 4.0, 1.0, 2.0, 3.0],
+                "timestamp": [0, 10, 20, 5, 15, 30],
+            }
+        )
+
+    def test_log_features(self):
+        fp = HistoryBasedFeaturesProcessor(use_conditional_popularity=False)
+        fp.fit(self.make_log())
+        pairs = pd.DataFrame({"query_id": [0, 1], "item_id": [2, 2]})
+        out = fp.transform(pairs)
+        assert out.loc[0, "q_log_count"] == 3
+        assert out.loc[1, "q_distinct_items"] == 2
+        assert out.loc[0, "q_mean_rating"] == pytest.approx(4.0)
+        assert out.loc[0, "i_log_count"] == 2  # item 2 appears twice
+        assert "i_popularity_share" in out.columns
+
+    def test_conditional_popularity(self):
+        item_features = pd.DataFrame({"item_id": [0, 1, 2], "genre": ["a", "a", "b"]})
+        fp = HistoryBasedFeaturesProcessor(
+            use_log_features=False, item_cat_features_list=["genre"]
+        )
+        fp.fit(self.make_log(), item_features=item_features)
+        out = fp.transform(pd.DataFrame({"query_id": [0], "item_id": [0]}))
+        assert out.loc[0, "q_share_genre_a"] == pytest.approx(2 / 3)
+        assert out.loc[0, "q_share_genre_b"] == pytest.approx(1 / 3)
+
+    def test_unfitted_raises(self):
+        with pytest.raises(RuntimeError, match="not fitted"):
+            HistoryBasedFeaturesProcessor().transform(pd.DataFrame())
