@@ -1,10 +1,12 @@
 from .iterator import SequenceBatcher, validation_batches
+from .parquet import ParquetBatcher, write_sequence_parquet
 from .partitioning import Partitioning, ReplicasInfo
 from .schema import TensorFeatureInfo, TensorFeatureSource, TensorMap, TensorSchema
 from .sequence_tokenizer import SequenceTokenizer
 from .sequential_dataset import SequentialDataset
 
 __all__ = [
+    "ParquetBatcher",
     "Partitioning",
     "ReplicasInfo",
     "SequenceBatcher",
@@ -15,4 +17,5 @@ __all__ = [
     "TensorMap",
     "TensorSchema",
     "validation_batches",
+    "write_sequence_parquet",
 ]

@@ -1,4 +1,5 @@
 from .als import ALS
+from .ann import ANNMixin, MIPSIndex
 from .base import BaseRecommender
 from .bandits import KLUCB, UCB, ThompsonSampling, Wilson
 from .cluster import ClusterRec
@@ -11,6 +12,8 @@ from .word2vec import Word2VecRec
 
 __all__ = [
     "ALS",
+    "ANNMixin",
+    "MIPSIndex",
     "AssociationRulesItemRec",
     "BaseRecommender",
     "CatPopRec",

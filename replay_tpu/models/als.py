@@ -29,6 +29,7 @@ import pandas as pd
 
 from replay_tpu.data.dataset import Dataset
 
+from .ann import ANNMixin
 from .base import BaseRecommender
 
 
@@ -48,7 +49,7 @@ def _padded_groups(group_idx: np.ndarray, other_idx: np.ndarray, ratings: np.nda
     return indices, values, mask
 
 
-class ALS(BaseRecommender):
+class ALS(ANNMixin, BaseRecommender):
     """Matrix factorization via alternating least squares (implicit or explicit)."""
 
     _init_arg_names = ["rank", "implicit_prefs", "alpha", "reg", "num_iterations", "seed"]

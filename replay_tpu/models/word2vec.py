@@ -20,10 +20,12 @@ import pandas as pd
 
 from replay_tpu.data.dataset import Dataset
 
+from .ann import ANNMixin
 from .base import BaseRecommender
 
 
-class Word2VecRec(BaseRecommender):
+class Word2VecRec(ANNMixin, BaseRecommender):
+    _ann_metric = "cosine"  # predict ranks by cosine; the index must match
     _init_arg_names = [
         "rank", "window_size", "num_negatives", "num_iterations", "learning_rate",
         "use_idf", "seed",

@@ -1,0 +1,109 @@
+"""Native (C++) kernels for the host-side input pipeline.
+
+The compute path is JAX/XLA; this package holds the runtime pieces the reference
+implements natively (its ragged-column dataloader kernels ride torch's C++ —
+SURVEY.md §2.8). The extension builds on first use with the in-image g++ via a
+direct compiler invocation (no pip); ``gather_pad`` transparently falls back to
+a numpy implementation when the build is unavailable.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import sysconfig
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("replay_tpu")
+
+_HERE = Path(__file__).parent
+_SO_PATH = _HERE / "_ragged.so"
+_native = None
+_build_attempted = False
+
+
+def _build() -> Optional[object]:
+    """Compile ragged.cpp into an importable extension (idempotent)."""
+    global _build_attempted
+    if _build_attempted:
+        return None
+    _build_attempted = True
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}",
+        str(_HERE / "ragged.cpp"),
+        "-o", str(_SO_PATH),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError) as error:
+        logger.info("native ragged kernel build failed (%s); using numpy fallback", error)
+        return None
+    return _load()
+
+
+def _load() -> Optional[object]:
+    import importlib.util
+
+    if not _SO_PATH.exists():
+        return None
+    spec = importlib.util.spec_from_file_location("replay_tpu.native._ragged", _SO_PATH)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def native_available() -> bool:
+    global _native
+    if _native is None:
+        _native = _load() or _build()
+    return _native is not None
+
+
+def gather_pad(
+    values: np.ndarray,
+    offsets: np.ndarray,
+    indices: np.ndarray,
+    max_len: int,
+    pad_value,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Gather ragged rows into a LEFT-padded [batch, max_len] array + mask.
+
+    Integer list columns take the native int64 kernel; floating columns use the
+    float64-reinterpret trick (same byte width, same kernel) so values round-trip
+    exactly. Rows longer than ``max_len`` keep their last ``max_len`` values
+    (recency window — the same truncation the windowless SequenceBatcher applies).
+    """
+    values = np.asarray(values)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    indices = np.ascontiguousarray(indices, np.int64)
+    batch = len(indices)
+    floating = np.issubdtype(values.dtype, np.floating)
+    mask = np.empty((batch, max_len), np.uint8)
+    if native_available():
+        if floating:
+            # reinterpret float64 bit patterns as int64: memcpy semantics only
+            payload = np.ascontiguousarray(values, np.float64).view(np.int64)
+            pad_bits = np.float64(pad_value).view(np.int64)
+            out = np.empty((batch, max_len), np.int64)
+            _native.gather_pad_i64(payload, offsets, indices, out, mask, max_len, int(pad_bits))
+            return out.view(np.float64), mask.astype(bool)
+        payload = np.ascontiguousarray(values, np.int64)
+        out = np.empty((batch, max_len), np.int64)
+        _native.gather_pad_i64(payload, offsets, indices, out, mask, max_len, int(pad_value))
+        return out, mask.astype(bool)
+    # numpy fallback: same semantics, one python loop over the batch
+    out = np.full((batch, max_len), pad_value, np.float64 if floating else np.int64)
+    mask[:] = 0
+    for b, row in enumerate(indices):
+        start, stop = offsets[row], offsets[row + 1]
+        if stop - start > max_len:
+            start = stop - max_len
+        row_values = values[start:stop]
+        out[b, max_len - len(row_values):] = row_values
+        mask[b, max_len - len(row_values):] = 1
+    return out, mask.astype(bool)

@@ -1,0 +1,86 @@
+// Ragged gather+pad kernel — the data-loader hot loop, native.
+//
+// Equivalent of the reference's per-batch ragged-column materialization
+// (replay/data/nn/parquet/impl/array_1d_column.py:22-120: gather rows of a
+// flat+offsets list column, left-truncate/pad to a fixed window, emit value and
+// mask tensors). That python/torch loop dominates input-pipeline CPU time; this
+// is the same operation as one C loop over the output buffer, exposed through
+// the CPython API (no pybind11 in the image).
+//
+// Layout contract (row-major, C-contiguous):
+//   values  : int64[total]            flattened list column
+//   offsets : int64[n_rows + 1]       row i spans values[offsets[i]:offsets[i+1]]
+//   indices : int64[batch]            which rows to gather
+//   out     : int64[batch, max_len]   LEFT-padded with pad_value
+//   mask    : uint8[batch, max_len]   1 at real positions
+// Rows longer than max_len keep their LAST max_len values (recency window).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <cstdint>
+#include <cstring>
+
+static PyObject* gather_pad_i64(PyObject* /*self*/, PyObject* args) {
+    Py_buffer values, offsets, indices, out, mask;
+    long long max_len_ll, pad_value_ll;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*LL",
+                          &values, &offsets, &indices, &out, &mask,
+                          &max_len_ll, &pad_value_ll)) {
+        return nullptr;
+    }
+    const int64_t max_len = (int64_t)max_len_ll;
+    const int64_t pad_value = (int64_t)pad_value_ll;
+    const int64_t* vals = (const int64_t*)values.buf;
+    const int64_t* offs = (const int64_t*)offsets.buf;
+    const int64_t* idx = (const int64_t*)indices.buf;
+    int64_t* out_buf = (int64_t*)out.buf;
+    uint8_t* mask_buf = (uint8_t*)mask.buf;
+    const int64_t batch = (int64_t)(indices.len / (Py_ssize_t)sizeof(int64_t));
+    const int64_t n_rows = (int64_t)(offsets.len / (Py_ssize_t)sizeof(int64_t)) - 1;
+    const int64_t total = (int64_t)(values.len / (Py_ssize_t)sizeof(int64_t));
+
+    int bad = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (int64_t b = 0; b < batch; ++b) {
+        const int64_t row = idx[b];
+        if (row < 0 || row >= n_rows) { bad = 1; break; }
+        int64_t start = offs[row];
+        int64_t stop = offs[row + 1];
+        if (start < 0 || stop < start || stop > total) { bad = 1; break; }
+        int64_t len = stop - start;
+        if (len > max_len) {           // recency window: keep the LAST max_len
+            start = stop - max_len;
+            len = max_len;
+        }
+        const int64_t pad = max_len - len;
+        int64_t* out_row = out_buf + b * max_len;
+        uint8_t* mask_row = mask_buf + b * max_len;
+        for (int64_t j = 0; j < pad; ++j) { out_row[j] = pad_value; mask_row[j] = 0; }
+        std::memcpy(out_row + pad, vals + start, (size_t)len * sizeof(int64_t));
+        std::memset(mask_row + pad, 1, (size_t)len);
+    }
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&values);
+    PyBuffer_Release(&offsets);
+    PyBuffer_Release(&indices);
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&mask);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError, "gather_pad_i64: index or offsets out of range");
+        return nullptr;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef Methods[] = {
+    {"gather_pad_i64", gather_pad_i64, METH_VARARGS,
+     "Gather ragged int64 rows and left-pad into a fixed [batch, max_len] buffer."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_ragged", "Native ragged gather+pad kernels.", -1, Methods,
+};
+
+PyMODINIT_FUNC PyInit__ragged(void) { return PyModule_Create(&moduledef); }

@@ -1,0 +1,152 @@
+"""Ahead-of-time compiled inference.
+
+Capability parity with the reference's OpenVINO export path
+(replay/models/nn/sequential/compiled/base_compiled_model.py:19-55: torch → ONNX →
+ov.CompiledModel with ``batch`` / ``one_query`` / ``dynamic_batch_size`` modes).
+
+TPU design: "compilation" is ``jax.jit(...).lower(...).compile()`` — an XLA
+executable specialized to fixed shapes (no tracing, no python dispatch overhead
+at serving time). ``dynamic_batch_size`` keeps a small set of power-of-two
+bucket executables and pads requests up to the nearest bucket — the XLA answer
+to dynamic shapes. ``serialize``/``deserialize`` use ``jax.export`` (StableHLO
+bytes) so a serving process can load the executable without the model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MODES = ("batch", "one_query", "dynamic_batch_size")
+
+
+class CompiledInference:
+    """An AOT-compiled ``forward_inference`` for fixed serving shapes."""
+
+    def __init__(self, compiled_by_batch: Dict[int, Any], max_sequence_length: int, mode: str):
+        self._compiled = compiled_by_batch
+        self.max_sequence_length = max_sequence_length
+        self.mode = mode
+
+    @classmethod
+    def compile(
+        cls,
+        model,
+        params,
+        max_sequence_length: int,
+        batch_size: int = 512,
+        mode: str = "batch",
+        candidates_count: Optional[int] = None,
+        feature_name: str = "item_id",
+        dynamic_buckets: Sequence[int] = (1, 8, 64, 512),
+    ) -> "CompiledInference":
+        """Lower + compile the model's ``forward_inference`` for the mode's shapes.
+
+        ``batch``: one executable at ``batch_size``; ``one_query``: batch 1;
+        ``dynamic_batch_size``: one executable per power-of-two bucket.
+        """
+        if mode not in MODES:
+            msg = f"mode must be one of {MODES}"
+            raise ValueError(msg)
+        sizes = {
+            "batch": [batch_size],
+            "one_query": [1],
+            "dynamic_batch_size": sorted(dynamic_buckets),
+        }[mode]
+
+        def forward(params, item_ids, padding_mask, candidates):
+            return model.apply(
+                {"params": params},
+                {feature_name: item_ids},
+                padding_mask,
+                candidates_to_score=candidates,
+                method=type(model).forward_inference,
+            )
+
+        compiled = {}
+        for size in sizes:
+            ids_spec = jax.ShapeDtypeStruct((size, max_sequence_length), jnp.int32)
+            mask_spec = jax.ShapeDtypeStruct((size, max_sequence_length), jnp.bool_)
+            cand_spec = (
+                jax.ShapeDtypeStruct((candidates_count,), jnp.int32)
+                if candidates_count
+                else None
+            )
+            compiled[size] = (
+                jax.jit(forward)
+                .lower(params, ids_spec, mask_spec, cand_spec)
+                .compile()
+            )
+        out = cls(compiled, max_sequence_length, mode)
+        out._params = params
+        out._candidates_count = candidates_count
+        return out
+
+    def _bucket_for(self, batch: int) -> int:
+        for size in sorted(self._compiled):
+            if size >= batch:
+                return size
+        msg = f"Batch {batch} exceeds the largest compiled bucket {max(self._compiled)}"
+        raise ValueError(msg)
+
+    def __call__(self, item_ids, padding_mask, candidates=None) -> jnp.ndarray:
+        """Score [B, L] sequences; pads the batch up to the compiled bucket."""
+        item_ids = np.asarray(item_ids, np.int32)
+        padding_mask = np.asarray(padding_mask, bool)
+        batch = item_ids.shape[0]
+        if item_ids.shape[1] != self.max_sequence_length:
+            msg = (
+                f"Sequence length {item_ids.shape[1]} != compiled "
+                f"{self.max_sequence_length}"
+            )
+            raise ValueError(msg)
+        bucket = self._bucket_for(batch)
+        if batch < bucket:
+            pad = bucket - batch
+            item_ids = np.concatenate([item_ids, np.repeat(item_ids[:1], pad, 0)])
+            padding_mask = np.concatenate([padding_mask, np.repeat(padding_mask[:1], pad, 0)])
+        if candidates is not None and not self._candidates_count:
+            msg = (
+                "Model was compiled without candidates_count; candidate scoring "
+                "needs compile(..., candidates_count=K)."
+            )
+            raise ValueError(msg)
+        if self._candidates_count and candidates is None:
+            msg = f"Compiled for {self._candidates_count} candidates; none given."
+            raise ValueError(msg)
+        args = [self._params, item_ids, padding_mask]
+        if self._candidates_count:
+            args.append(np.asarray(candidates, np.int32))
+        else:
+            args.append(None)
+        logits = self._compiled[bucket](*args)
+        return logits[:batch]
+
+def export_inference(model, params, max_sequence_length: int, batch_size: int,
+                     feature_name: str = "item_id") -> bytes:
+    """Serialize forward_inference to portable StableHLO bytes (jax.export)."""
+    from jax import export as jax_export
+
+    def forward(item_ids, padding_mask):
+        return model.apply(
+            {"params": params},
+            {feature_name: item_ids},
+            padding_mask,
+            method=type(model).forward_inference,
+        )
+
+    ids_spec = jax.ShapeDtypeStruct((batch_size, max_sequence_length), jnp.int32)
+    mask_spec = jax.ShapeDtypeStruct((batch_size, max_sequence_length), jnp.bool_)
+    exported = jax_export.export(jax.jit(forward))(ids_spec, mask_spec)
+    return exported.serialize()
+
+
+def import_inference(payload: bytes):
+    """Load serialized inference back into a callable (server side)."""
+    from jax import export as jax_export
+
+    exported = jax_export.deserialize(payload)
+    return lambda item_ids, padding_mask: exported.call(item_ids, padding_mask)

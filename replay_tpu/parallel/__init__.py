@@ -1,0 +1,3 @@
+from .ring import full_attention_reference, ring_attention
+
+__all__ = ["full_attention_reference", "ring_attention"]

@@ -1,0 +1,129 @@
+"""Exact-MIPS index (incl. mesh-sharded search) and AOT compiled inference."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+from replay_tpu.models import ALS, MIPSIndex
+from replay_tpu.nn import make_mesh
+from replay_tpu.nn.compiled import CompiledInference, export_inference, import_inference
+from replay_tpu.nn.sequential.sasrec import SasRec
+
+pytestmark = pytest.mark.jax
+
+
+class TestMIPSIndex:
+    def test_exact_topk_single_device(self):
+        rng = np.random.default_rng(0)
+        items = rng.normal(size=(40, 8)).astype(np.float32)
+        queries = rng.normal(size=(5, 8)).astype(np.float32)
+        scores, idx = MIPSIndex(items).search(queries, k=7)
+        brute = queries @ items.T
+        want_idx = np.argsort(-brute, axis=1)[:, :7]
+        np.testing.assert_array_equal(np.sort(idx, axis=1), np.sort(want_idx, axis=1))
+        np.testing.assert_allclose(scores, np.take_along_axis(brute, idx, 1), rtol=1e-5)
+
+    def test_sharded_equals_unsharded(self):
+        rng = np.random.default_rng(1)
+        items = rng.normal(size=(64, 8)).astype(np.float32)  # 64 % 8 devices == 0
+        queries = rng.normal(size=(3, 8)).astype(np.float32)
+        mesh = make_mesh()
+        s_scores, s_idx = MIPSIndex(items, mesh=mesh).search(queries, k=5)
+        u_scores, u_idx = MIPSIndex(items).search(queries, k=5)
+        np.testing.assert_allclose(np.sort(s_scores, 1), np.sort(u_scores, 1), rtol=1e-5)
+        np.testing.assert_array_equal(np.sort(s_idx, 1), np.sort(u_idx, 1))
+
+    def test_k_too_large(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            MIPSIndex(np.ones((4, 2), np.float32)).search(np.ones((1, 2), np.float32), k=9)
+
+
+def test_als_ann_predict_matches_exact():
+    rng = np.random.default_rng(0)
+    rows = [(u, int(i), 1.0, t) for u in range(8) for t, i in
+            enumerate(rng.choice(16, 5, replace=False))]
+    log = pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+    ds = Dataset(feature_schema=FeatureSchema([
+        FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+        FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+        FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+        FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP)]),
+        interactions=log)
+    model = ALS(rank=4, num_iterations=4, seed=0).fit(ds)
+    recs = model.predict_ann(ds, k=3)
+    # index scores equal factor dot products
+    brute = model.user_factors @ model.item_factors.T
+    for _, row in recs.iterrows():
+        q = list(model.fit_queries).index(row["query_id"])
+        i = list(model.fit_items).index(row["item_id"])
+        assert abs(brute[q, i] - row["rating"]) < 1e-5
+    nn_frame = model.get_nearest_items_ann([model.fit_items[0]], k=3)
+    assert len(nn_frame) == 3
+    assert (nn_frame["neighbour_item_idx"] != model.fit_items[0]).all()
+
+
+NUM_ITEMS, SEQ_LEN = 20, 6
+
+
+@pytest.fixture(scope="module")
+def sasrec_with_params():
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=NUM_ITEMS,
+                          embedding_dim=8)
+    )
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    ids = np.zeros((2, SEQ_LEN), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"item_id": ids},
+                        np.ones((2, SEQ_LEN), bool))["params"]
+    return model, params
+
+
+class TestCompiledInference:
+    def test_batch_mode_and_padding(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(model, params, SEQ_LEN, batch_size=4, mode="batch")
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, NUM_ITEMS, (3, SEQ_LEN)).astype(np.int32)  # < bucket
+        mask = np.ones((3, SEQ_LEN), bool)
+        logits = compiled(ids, mask)
+        assert logits.shape == (3, NUM_ITEMS)
+        # equals the uncompiled forward
+        want = model.apply({"params": params}, {"item_id": ids}, mask,
+                           method=SasRec.forward_inference)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(want), rtol=1e-4, atol=1e-6)
+
+    def test_dynamic_buckets(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(
+            model, params, SEQ_LEN, mode="dynamic_batch_size", dynamic_buckets=(1, 4)
+        )
+        for batch in (1, 2, 4):
+            ids = np.zeros((batch, SEQ_LEN), np.int32)
+            out = compiled(ids, np.ones((batch, SEQ_LEN), bool))
+            assert out.shape == (batch, NUM_ITEMS)
+        with pytest.raises(ValueError, match="largest compiled bucket"):
+            compiled(np.zeros((5, SEQ_LEN), np.int32), np.ones((5, SEQ_LEN), bool))
+
+    def test_wrong_length_rejected(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(model, params, SEQ_LEN, batch_size=2)
+        with pytest.raises(ValueError, match="Sequence length"):
+            compiled(np.zeros((2, SEQ_LEN + 1), np.int32), np.ones((2, SEQ_LEN + 1), bool))
+
+    def test_export_roundtrip(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        payload = export_inference(model, params, SEQ_LEN, batch_size=2)
+        assert isinstance(payload, (bytes, bytearray))
+        served = import_inference(bytes(payload))
+        ids = np.zeros((2, SEQ_LEN), np.int32)
+        mask = np.ones((2, SEQ_LEN), bool)
+        got = served(ids, mask)
+        want = model.apply({"params": params}, {"item_id": ids}, mask,
+                           method=SasRec.forward_inference)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
