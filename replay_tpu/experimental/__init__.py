@@ -1,0 +1,16 @@
+"""Experimental tier (ref replay/experimental/): research models on the same
+fit/predict contract — MultVAE, NeuroMF, NeuralTS, DT4Rec (offline RL).
+
+External-library wrappers from the reference tier (LightFM, implicit, OBP,
+LightAutoML) are intentionally absent: none of those libraries ship in this
+image, and a wrapper that cannot execute is dead weight — the availability-flag
+pattern in replay_tpu.utils.types is the extension seam to add them where the
+libraries exist.
+"""
+
+from .dt4rec import DT4Rec
+from .mult_vae import MultVAE
+from .neural_ts import NeuralTS
+from .neuro_mf import NeuroMF
+
+__all__ = ["DT4Rec", "MultVAE", "NeuralTS", "NeuroMF"]

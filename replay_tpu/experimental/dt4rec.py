@@ -1,0 +1,140 @@
+"""DT4Rec: decision-transformer recommendation (offline RL).
+
+Capability parity with the reference experimental DT4Rec
+(replay/experimental/models/dt4rec/: a GPT backbone over interleaved
+(return-to-go, state, action) tokens trained on logged interactions, with
+``examples/train_dt4rec.py`` as the driver). Sequence recommendation as
+return-conditioned behavior cloning: at inference a HIGH target return is fed so
+the policy imitates its best-outcome trajectories.
+
+TPU design: one flax causal transformer over the interleaved token grid
+[B, 3L, E] (rtg/state/action triplets), reusing the SASRec encoder blocks; the
+action head ties to the item embedding table. All static shapes, trained with
+the shared Trainer via the standard loss protocol (action positions carry the
+targets).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from replay_tpu.data.nn.schema import TensorMap, TensorSchema
+from replay_tpu.nn.embedding import SequenceEmbedding
+from replay_tpu.nn.head import EmbeddingTyingHead
+from replay_tpu.nn.mask import causal_attention_mask
+
+from ..nn.sequential.sasrec.transformer import SasRecTransformerLayer
+
+
+class DT4Rec(nn.Module):
+    """Return-conditioned causal transformer over (rtg, item) token pairs."""
+
+    schema: TensorSchema
+    embedding_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 1
+    max_sequence_length: int = 50
+    hidden_dim: Optional[int] = None
+    dropout_rate: float = 0.0
+    returns_name: str = "returns_to_go"
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        self.embedder = SequenceEmbedding(
+            schema=self.schema, dtype=self.dtype, name="embedder"
+        )
+        self.return_proj = nn.Dense(self.embedding_dim, dtype=self.dtype, name="return_proj")
+        self.positional_embedding = self.param(
+            "positional_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (self.max_sequence_length, self.embedding_dim),
+        )
+        self.encoder = SasRecTransformerLayer(
+            num_blocks=self.num_blocks,
+            num_heads=self.num_heads,
+            hidden_dim=self.hidden_dim or self.embedding_dim * 4,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="encoder",
+        )
+        self.final_norm = nn.LayerNorm(dtype=self.dtype, name="final_norm")
+        self.head = EmbeddingTyingHead()
+
+    def _token_grid(self, feature_tensors: TensorMap, returns_to_go: jnp.ndarray):
+        """Interleave [rtg_1, item_1, rtg_2, item_2, ...] → [B, 2L, E]."""
+        embeddings = self.embedder(feature_tensors)
+        items = sum(embeddings[name] for name in sorted(embeddings))  # [B, L, E]
+        rtg = self.return_proj(returns_to_go[..., None].astype(self.dtype))  # [B, L, E]
+        batch, length, dim = items.shape
+        grid = jnp.stack([rtg, items], axis=2).reshape(batch, 2 * length, dim)
+        positions = jnp.repeat(
+            self.positional_embedding[self.max_sequence_length - length :], 2, axis=0
+        )
+        return grid + positions.astype(grid.dtype)
+
+    def __call__(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        returns_to_go: Optional[jnp.ndarray] = None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """Hidden states at ITEM positions [B, L, E]: position t predicts the
+        item chosen at t given rtg_t and the past."""
+        if returns_to_go is None:
+            returns_to_go = jnp.ones(padding_mask.shape, self.dtype)
+        x = self._token_grid(feature_tensors, returns_to_go)
+        token_padding = jnp.repeat(padding_mask, 2, axis=1)
+        attention_mask = causal_attention_mask(
+            token_padding, deterministic=deterministic, dtype=self.dtype
+        )
+        x = self.encoder(x, attention_mask, token_padding, deterministic=deterministic)
+        x = self.final_norm(x)
+        # the token BEFORE each item token (its rtg token) predicts that item
+        return x[:, 0::2, :]
+
+    def get_logits(
+        self, hidden: jnp.ndarray, candidates_to_score: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        if candidates_to_score is None:
+            return self.head(hidden, self.embedder.get_item_weights())
+        embedded = self.embedder.get_item_weights(candidates_to_score)
+        if candidates_to_score.ndim == 1:
+            return self.head(hidden, embedded)
+        return jnp.einsum("...e,...ke->...k", hidden, embedded)
+
+    def forward_inference(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        returns_to_go: Optional[jnp.ndarray] = None,
+        target_return: float = 1.0,
+        candidates_to_score: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Scores of the next action conditioned on a target return: shift the
+        window left and append a fresh rtg slot carrying ``target_return``."""
+        shifted = {
+            name: jnp.concatenate([value[:, 1:], value[:, -1:]], axis=1)
+            if value.ndim >= 2
+            else value
+            for name, value in feature_tensors.items()
+        }
+        shifted_padding = jnp.concatenate(
+            [padding_mask[:, 1:], jnp.ones_like(padding_mask[:, -1:])], axis=1
+        )
+        if returns_to_go is None:
+            returns_to_go = jnp.ones(padding_mask.shape, self.dtype)
+        shifted_rtg = jnp.concatenate(
+            [
+                returns_to_go[:, 1:],
+                jnp.full_like(returns_to_go[:, -1:], target_return),
+            ],
+            axis=1,
+        )
+        hidden = self(
+            shifted, shifted_padding, returns_to_go=shifted_rtg, deterministic=True
+        )
+        return self.get_logits(hidden[:, -1, :], candidates_to_score)

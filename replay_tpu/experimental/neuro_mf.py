@@ -1,0 +1,156 @@
+"""NeuroMF / NeuMF: fused GMF + MLP matrix factorization.
+
+Capability parity with the reference experimental NeuroMF
+(replay/experimental/models/neuromf.py: generalized-MF elementwise tower plus an
+MLP tower over concatenated user/item embeddings, merged into one sigmoid score,
+trained with sampled negatives on implicit feedback).
+
+TPU design: a flax module over (user_idx, item_idx) id pairs; each epoch draws
+fresh uniform negatives with jax.random and runs jitted BCE steps — the whole
+epoch's positives live on device, no python-side example generation.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.models.base import BaseRecommender
+
+
+class NeuroMF(BaseRecommender):
+    _init_arg_names = [
+        "embedding_gmf_dim", "embedding_mlp_dim", "hidden_mlp_dims", "num_negatives",
+        "epochs", "learning_rate", "seed",
+    ]
+
+    def __init__(
+        self,
+        embedding_gmf_dim: int = 16,
+        embedding_mlp_dim: int = 16,
+        hidden_mlp_dims: Sequence[int] = (32, 16),
+        num_negatives: int = 4,
+        epochs: int = 20,
+        learning_rate: float = 1e-3,
+        seed: Optional[int] = 0,
+    ) -> None:
+        super().__init__()
+        self.embedding_gmf_dim = embedding_gmf_dim
+        self.embedding_mlp_dim = embedding_mlp_dim
+        self.hidden_mlp_dims = tuple(hidden_mlp_dims)
+        self.num_negatives = num_negatives
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self._params = None
+
+    def _build(self, n_users: int, n_items: int):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        gmf_dim, mlp_dim, hidden = self.embedding_gmf_dim, self.embedding_mlp_dim, self.hidden_mlp_dims
+
+        class NeuMF(nn.Module):
+            @nn.compact
+            def __call__(self, users, items):
+                gmf_u = nn.Embed(n_users, gmf_dim, name="gmf_user")(users)
+                gmf_i = nn.Embed(n_items, gmf_dim, name="gmf_item")(items)
+                mlp_u = nn.Embed(n_users, mlp_dim, name="mlp_user")(users)
+                mlp_i = nn.Embed(n_items, mlp_dim, name="mlp_item")(items)
+                gmf = gmf_u * gmf_i
+                h = jnp.concatenate([mlp_u, mlp_i], axis=-1)
+                for width in hidden:
+                    h = nn.relu(nn.Dense(width)(h))
+                fused = jnp.concatenate([gmf, h], axis=-1)
+                return nn.Dense(1, name="score")(fused)[..., 0]
+
+        return NeuMF()
+
+    def _fit(self, dataset: Dataset) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        q_index = pd.Index(self.fit_queries)
+        i_index = pd.Index(self.fit_items)
+        interactions = dataset.interactions
+        users = jnp.asarray(q_index.get_indexer(interactions[self.query_column]))
+        items = jnp.asarray(i_index.get_indexer(interactions[self.item_column]))
+        n_users, n_items = len(q_index), len(i_index)
+        model = self._build(n_users, n_items)
+        key = jax.random.PRNGKey(self.seed or 0)
+        key, init_key = jax.random.split(key)
+        params = model.init(init_key, users[:1], items[:1])["params"]
+        tx = optax.adam(self.learning_rate)
+        opt_state = tx.init(params)
+        num_neg = self.num_negatives
+
+        @jax.jit
+        def step(params, opt_state, rng):
+            neg_items = jax.random.randint(rng, (users.shape[0], num_neg), 0, n_items)
+
+            def loss_fn(p):
+                pos_logits = model.apply({"params": p}, users, items)
+                neg_logits = model.apply(
+                    {"params": p},
+                    jnp.repeat(users[:, None], num_neg, 1).reshape(-1),
+                    neg_items.reshape(-1),
+                )
+                pos_loss = -jax.nn.log_sigmoid(pos_logits).mean()
+                neg_loss = -jax.nn.log_sigmoid(-neg_logits).mean()
+                return pos_loss + neg_loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        for _ in range(self.epochs):
+            key, sub = jax.random.split(key)
+            params, opt_state, _ = step(params, opt_state, sub)
+        self._params = jax.tree.map(np.asarray, params)
+        self._model = model
+        self._dims = (n_users, n_items)
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        import jax.numpy as jnp
+
+        q_index = pd.Index(self.fit_queries)
+        i_index = pd.Index(self.fit_items)
+        q_pos = q_index.get_indexer(np.asarray(queries))
+        i_pos = i_index.get_indexer(np.asarray(items))
+        warm_q = np.asarray(queries)[q_pos >= 0]
+        warm_i = np.asarray(items)[i_pos >= 0]
+        qp, ip = q_pos[q_pos >= 0], i_pos[i_pos >= 0]
+        grid_u = jnp.asarray(np.repeat(qp, len(ip)))
+        grid_i = jnp.asarray(np.tile(ip, len(qp)))
+        scores = np.asarray(self._model.apply({"params": self._params}, grid_u, grid_i))
+        return pd.DataFrame(
+            {
+                self.query_column: np.repeat(warm_q, len(warm_i)),
+                self.item_column: np.tile(warm_i, len(warm_q)),
+                "rating": scores,
+            }
+        )
+
+    def _save_model(self, target: Path) -> None:
+        import jax
+
+        leaves, _ = jax.tree_util.tree_flatten(self._params)
+        np.savez_compressed(target / "neumf.npz", *(np.asarray(l) for l in leaves))
+
+    def _load_model(self, source: Path) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        model = self._build(len(self.fit_queries), len(self.fit_items))
+        template = model.init(jax.random.PRNGKey(0), jnp.zeros(1, jnp.int32),
+                              jnp.zeros(1, jnp.int32))["params"]
+        with np.load(source / "neumf.npz") as payload:
+            leaves = [payload[f"arr_{i}"] for i in range(len(payload.files))]
+        _, treedef = jax.tree_util.tree_flatten(template)
+        self._params = jax.tree_util.tree_unflatten(treedef, leaves)
+        self._model = model

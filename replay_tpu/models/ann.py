@@ -26,8 +26,8 @@ class MIPSIndex:
         import jax
         import jax.numpy as jnp
 
-        self._np = np
         self.num_items, self.dim = item_vectors.shape
+        self.host_vectors = np.asarray(item_vectors)  # unpadded host copy
         self.mesh = mesh
         self.axis_name = axis_name
         if mesh is not None:
@@ -188,7 +188,8 @@ class ANNMixin:
             unknown = np.asarray(items)[positions < 0]
             msg = f"Items not seen at fit time: {unknown[:5].tolist()}"
             raise ValueError(msg)
-        vectors = self._ann_item_vectors()[positions]
+        # the index already holds the (normalized) catalog — just slice it
+        vectors = self._mips_index.host_vectors[positions]
         scores, indices = self._mips_index.search(vectors, k + 1)
         out = []
         for row, item in enumerate(np.asarray(items)):

@@ -53,7 +53,13 @@ def _load() -> Optional[object]:
         return None
     spec = importlib.util.spec_from_file_location("replay_tpu.native._ragged", _SO_PATH)
     module = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(module)
+    try:
+        spec.loader.exec_module(module)
+    except ImportError as error:
+        # stale/ABI-incompatible artifact: rebuild (or fall back to numpy)
+        logger.info("stale native kernel (%s); rebuilding", error)
+        _SO_PATH.unlink(missing_ok=True)
+        return None
     return module
 
 

@@ -1,0 +1,158 @@
+"""TiSASRec: time-interval-aware SASRec.
+
+Capability parity with the reference's TiSASRec modification
+(replay/models/nn/sequential/sasrec/model.py:532-700: TiSasRecEmbeddings with
+clipped pairwise time intervals and TiSasRecLayers consuming interval
+embeddings; ``time_span`` bounds the relative interval).
+
+TPU design: instead of per-pair key/value interval embedding matrices (the
+reference's [B, L, L, E] tensors), intervals index a learned [time_span+1, H]
+relative-attention-bias table added to the attention logits — the T5-style
+formulation of the same signal: O(L²·H) instead of O(L²·E) memory, one gather +
+one add, fully fused by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from replay_tpu.data.nn.schema import TensorMap, TensorSchema
+from replay_tpu.nn.agg import PositionAwareAggregator
+from replay_tpu.nn.attention import MultiHeadAttention
+from replay_tpu.nn.embedding import SequenceEmbedding
+from replay_tpu.nn.ffn import PointWiseFeedForward
+from replay_tpu.nn.head import EmbeddingTyingHead
+from replay_tpu.nn.mask import causal_attention_mask
+
+
+class TiSasRec(nn.Module):
+    """SASRec whose attention sees clipped pairwise time intervals.
+
+    The forward takes an extra ``timestamps`` tensor [B, L] (seconds or any
+    monotone unit); pairwise intervals are scaled by each query's minimum
+    non-zero gap (the reference's personalized time scaling) and clipped to
+    ``time_span``.
+    """
+
+    schema: TensorSchema
+    embedding_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 1
+    max_sequence_length: int = 50
+    time_span: int = 256
+    hidden_dim: Optional[int] = None
+    dropout_rate: float = 0.0
+    excluded_features: tuple = ()
+    timestamps_name: str = "timestamp"
+    dtype: Any = jnp.float32
+
+    def setup(self) -> None:
+        self.embedder = SequenceEmbedding(
+            schema=self.schema,
+            excluded_features=tuple(self.excluded_features) + (self.timestamps_name,),
+            dtype=self.dtype,
+            name="embedder",
+        )
+        self.aggregator = PositionAwareAggregator(
+            embedding_dim=self.embedding_dim,
+            max_sequence_length=self.max_sequence_length,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="aggregator",
+        )
+        self.interval_bias = nn.Embed(
+            num_embeddings=self.time_span + 1,
+            features=self.num_heads,
+            dtype=self.dtype,
+            name="interval_bias",
+        )
+        self.attentions = [
+            MultiHeadAttention(
+                num_heads=self.num_heads,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name=f"attention_{i}",
+            )
+            for i in range(self.num_blocks)
+        ]
+        self.attn_norms = [
+            nn.LayerNorm(dtype=self.dtype, name=f"attn_norm_{i}") for i in range(self.num_blocks)
+        ]
+        self.ffn_norms = [
+            nn.LayerNorm(dtype=self.dtype, name=f"ffn_norm_{i}") for i in range(self.num_blocks)
+        ]
+        self.ffns = [
+            PointWiseFeedForward(
+                hidden_dim=self.hidden_dim or self.embedding_dim * 4,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name=f"ffn_{i}",
+            )
+            for i in range(self.num_blocks)
+        ]
+        self.final_norm = nn.LayerNorm(dtype=self.dtype, name="final_norm")
+        self.head = EmbeddingTyingHead()
+
+    def _intervals(self, timestamps: jnp.ndarray, padding_mask: jnp.ndarray) -> jnp.ndarray:
+        """Clipped personalized intervals [B, L, L] (int ids into the bias table)."""
+        diffs = jnp.abs(timestamps[:, :, None] - timestamps[:, None, :]).astype(jnp.float32)
+        pair_valid = padding_mask[:, :, None] & padding_mask[:, None, :]
+        # personalized scale: each query's smallest positive gap
+        masked = jnp.where(pair_valid & (diffs > 0), diffs, jnp.inf)
+        min_gap = jnp.min(masked.reshape(diffs.shape[0], -1), axis=1)
+        min_gap = jnp.where(jnp.isfinite(min_gap), jnp.maximum(min_gap, 1e-9), 1.0)
+        scaled = diffs / min_gap[:, None, None]
+        return jnp.clip(scaled, 0, self.time_span).astype(jnp.int32)
+
+    def __call__(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        embeddings = self.embedder(
+            {k: v for k, v in feature_tensors.items() if k != self.timestamps_name}
+        )
+        x = self.aggregator(embeddings, deterministic=deterministic)
+        base_mask = causal_attention_mask(
+            padding_mask, deterministic=deterministic, dtype=self.dtype
+        )
+        timestamps = feature_tensors.get(self.timestamps_name)
+        if timestamps is not None:
+            intervals = self._intervals(jnp.asarray(timestamps), padding_mask)
+            bias = self.interval_bias(intervals)  # [B, L, L, H]
+            attention_mask = base_mask + bias.transpose(0, 3, 1, 2)  # [B, H, L, L]
+        else:
+            attention_mask = base_mask
+        keep = padding_mask[..., None].astype(x.dtype)
+        for attn, attn_norm, ffn_norm, ffn in zip(
+            self.attentions, self.attn_norms, self.ffn_norms, self.ffns
+        ):
+            h = attn_norm(x)
+            h = attn(h, attention_mask, deterministic=deterministic)
+            x = x + h
+            h = ffn_norm(x)
+            x = ffn(h, deterministic=deterministic) * keep
+        return self.final_norm(x)
+
+    def get_logits(
+        self, hidden: jnp.ndarray, candidates_to_score: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        if candidates_to_score is None:
+            return self.head(hidden, self.embedder.get_item_weights())
+        embedded = self.embedder.get_item_weights(candidates_to_score)
+        if candidates_to_score.ndim == 1:
+            return self.head(hidden, embedded)
+        return jnp.einsum("...e,...ke->...k", hidden, embedded)
+
+    def forward_inference(
+        self,
+        feature_tensors: TensorMap,
+        padding_mask: jnp.ndarray,
+        candidates_to_score: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        hidden = self(feature_tensors, padding_mask, deterministic=True)
+        return self.get_logits(hidden[:, -1, :], candidates_to_score)

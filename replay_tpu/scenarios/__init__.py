@@ -1,3 +1,4 @@
 from .fallback import Fallback
+from .two_stages import LogisticReranker, TwoStages
 
-__all__ = ["Fallback"]
+__all__ = ["Fallback", "LogisticReranker", "TwoStages"]

@@ -1,0 +1,179 @@
+"""Two-stage scenario: candidate generation → feature enrichment → reranking.
+
+Capability parity with the reference experimental TwoStagesScenario
+(replay/experimental/scenarios/two_stages/: first-level models generate
+candidates, HistoryBasedFeaturesProcessor builds log features, a second-level
+learner reranks; the reference plugs LightAutoML in as the reranker).
+
+Reranker here: L2-regularized logistic regression trained with jitted
+full-batch newton/gradient steps in JAX — honest, dependency-free, and easily
+swapped (any object with fit(X, y)/predict_proba(X) works).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.models.base import BaseRecommender
+from replay_tpu.preprocessing.history_based_fp import HistoryBasedFeaturesProcessor
+from replay_tpu.splitters.strategies import RatioSplitter
+
+
+class LogisticReranker:
+    """Tiny L2 logistic regression (jitted adam), the default second stage."""
+
+    def __init__(self, reg: float = 1e-3, steps: int = 300, learning_rate: float = 0.1) -> None:
+        self.reg = reg
+        self.steps = steps
+        self.learning_rate = learning_rate
+        self.weights: Optional[np.ndarray] = None
+
+    def fit(self, features: np.ndarray, labels: np.ndarray) -> "LogisticReranker":
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        x = jnp.asarray(np.column_stack([features, np.ones(len(features))]), jnp.float32)
+        y = jnp.asarray(labels, jnp.float32)
+        w = jnp.zeros(x.shape[1], jnp.float32)
+        tx = optax.adam(self.learning_rate)
+        opt_state = tx.init(w)
+
+        @jax.jit
+        def step(w, opt_state):
+            def loss_fn(w):
+                logits = x @ w
+                nll = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, y))
+                return nll + self.reg * jnp.sum(w**2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(w)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(w, updates), opt_state
+
+        for _ in range(self.steps):
+            w, opt_state = step(w, opt_state)
+        self.weights = np.asarray(w)
+        return self
+
+    def predict_proba(self, features: np.ndarray) -> np.ndarray:
+        x = np.column_stack([features, np.ones(len(features))])
+        return 1.0 / (1.0 + np.exp(-(x @ self.weights)))
+
+
+class TwoStages(BaseRecommender):
+    """Candidate generators + history features + a trained reranker."""
+
+    def __init__(
+        self,
+        first_level_models: Sequence[BaseRecommender],
+        reranker=None,
+        num_candidates: int = 50,
+        features_processor: Optional[HistoryBasedFeaturesProcessor] = None,
+        holdout_fraction: float = 0.3,
+        seed: int = 0,
+    ) -> None:
+        super().__init__()
+        self.first_level_models = list(first_level_models)
+        self.reranker = reranker if reranker is not None else LogisticReranker()
+        self.num_candidates = num_candidates
+        self.features_processor = features_processor or HistoryBasedFeaturesProcessor()
+        self.holdout_fraction = holdout_fraction
+        self.seed = seed
+        self._model_names: List[str] = []
+        self._feature_column_order: Optional[List[str]] = None
+
+    def _candidate_frame(self, dataset: Dataset, k: int, queries=None) -> pd.DataFrame:
+        """Union of every generator's top-k with per-model score columns."""
+        frames = []
+        for idx, model in enumerate(self.first_level_models):
+            recs = model.predict(dataset, k, queries=queries, filter_seen_items=True)
+            recs = recs.rename(columns={"rating": f"score_{idx}"})
+            frames.append(recs)
+        out = frames[0]
+        for frame in frames[1:]:
+            out = out.merge(frame, on=[self.query_column, self.item_column], how="outer")
+        score_columns = [c for c in out.columns if c.startswith("score_")]
+        return out.fillna({c: 0.0 for c in score_columns})
+
+    def _feature_matrix(self, pairs: pd.DataFrame) -> np.ndarray:
+        enriched = self.features_processor.transform(
+            pairs[[self.query_column, self.item_column]]
+        )
+        score_columns = [c for c in pairs.columns if c.startswith("score_")]
+        feature_columns = [
+            c for c in enriched.columns if c not in (self.query_column, self.item_column)
+        ]
+        if self._feature_column_order is None:
+            self._feature_column_order = feature_columns
+        else:
+            # serving features must align with the trained weights: refitting the
+            # processor on the full log can add/drop pivot columns, so reindex to
+            # the training-time column order (missing -> 0, extras dropped)
+            enriched = enriched.reindex(columns=self._feature_column_order, fill_value=0.0)
+            feature_columns = self._feature_column_order
+        return np.column_stack(
+            [pairs[score_columns].to_numpy(np.float64), enriched[feature_columns].to_numpy(np.float64)]
+        )
+
+    def _fit(self, dataset: Dataset) -> None:
+        # split history: generators fit on the base part, the reranker learns to
+        # predict the held-out positives among generated candidates
+        base, holdout = RatioSplitter(
+            test_size=self.holdout_fraction,
+            divide_column=self.query_column,
+            query_column=self.query_column,
+            item_column=self.item_column,
+        ).split(dataset.interactions)
+        base_dataset = Dataset(
+            feature_schema=dataset.feature_schema.copy(),
+            interactions=base,
+            query_features=dataset.query_features,
+            item_features=dataset.item_features,
+            check_consistency=False,
+        )
+        for model in self.first_level_models:
+            model.fit(base_dataset)
+        self.features_processor.fit(base, dataset.query_features, dataset.item_features)
+        self._feature_column_order = None  # rebound to this fit's training features
+
+        candidates = self._candidate_frame(base_dataset, self.num_candidates)
+        positives = holdout[[self.query_column, self.item_column]].assign(__label=1.0)
+        training = candidates.merge(
+            positives, on=[self.query_column, self.item_column], how="left"
+        )
+        labels = training["__label"].fillna(0.0).to_numpy()
+        features = self._feature_matrix(training)
+        self.reranker.fit(features, labels)
+
+        # refit generators + features on the FULL history for serving
+        for model in self.first_level_models:
+            model.fit(dataset)
+        self.features_processor.fit(
+            dataset.interactions, dataset.query_features, dataset.item_features
+        )
+
+    def predict(
+        self, dataset, k: int, queries=None, items=None, filter_seen_items: bool = True
+    ) -> pd.DataFrame:
+        self._check_fitted()
+        candidates = self._candidate_frame(dataset, self.num_candidates, queries=queries)
+        if items is not None:
+            candidates = candidates[candidates[self.item_column].isin(np.asarray(items))]
+        features = self._feature_matrix(candidates)
+        scored = candidates[[self.query_column, self.item_column]].assign(
+            rating=self.reranker.predict_proba(features)
+        )
+        if filter_seen_items and dataset is not None:
+            seen = dataset.interactions[[self.query_column, self.item_column]]
+            scored = scored.merge(
+                seen.assign(__seen=True), on=[self.query_column, self.item_column], how="left"
+            )
+            scored = scored[scored["__seen"].isna()].drop(columns="__seen")
+        return self._top_k(scored, k)
+
+    def _predict_scores(self, dataset, queries, items):  # pragma: no cover
+        raise NotImplementedError("TwoStages reranks candidate frames directly.")

@@ -1,3 +1,6 @@
+from .checkpoint import CheckpointManager, load_metadata, restore_pytree, save_pytree
+from .profiling import StepTimer, trace
+from .session import State, get_default_mesh, setup_logging
 from .types import (
     OPTUNA_AVAILABLE,
     PANDAS_AVAILABLE,
@@ -17,9 +20,18 @@ __all__ = [
     "POLARS_AVAILABLE",
     "PYSPARK_AVAILABLE",
     "TORCH_AVAILABLE",
+    "CheckpointManager",
     "DataFrameLike",
     "PandasDataFrame",
     "PolarsDataFrame",
     "SparkDataFrame",
+    "State",
+    "StepTimer",
     "df_backend",
+    "get_default_mesh",
+    "load_metadata",
+    "restore_pytree",
+    "save_pytree",
+    "setup_logging",
+    "trace",
 ]

@@ -1,0 +1,57 @@
+"""Profiling hooks (beyond-parity: the reference has none — SURVEY.md §5).
+
+``trace(dir)`` wraps a region in a jax.profiler trace viewable in TensorBoard /
+xprof; ``StepTimer`` measures steady-state steps/sec + samples/sec the way
+bench.py does (block_until_ready fencing, warmup exclusion).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Capture a device trace of the enclosed region."""
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Steady-state throughput: call ``tick(result)`` once per step."""
+
+    def __init__(self, warmup_steps: int = 3, samples_per_step: Optional[int] = None) -> None:
+        self.warmup_steps = warmup_steps
+        self.samples_per_step = samples_per_step
+        self._count = 0
+        self._start: Optional[float] = None
+
+    def tick(self, result=None) -> None:
+        self._count += 1
+        if self._count == self.warmup_steps:
+            if result is not None:
+                import jax
+
+                jax.block_until_ready(result)
+            self._start = time.perf_counter()
+
+    def finish(self, result=None) -> dict:
+        if result is not None:
+            import jax
+
+            jax.block_until_ready(result)
+        measured = self._count - self.warmup_steps
+        if self._start is None or measured <= 0:
+            return {"steps": self._count, "steps_per_sec": float("nan")}
+        elapsed = time.perf_counter() - self._start
+        out = {"steps": measured, "steps_per_sec": measured / elapsed}
+        if self.samples_per_step:
+            out["samples_per_sec"] = measured * self.samples_per_step / elapsed
+        return out

@@ -1,0 +1,69 @@
+"""Runtime/session glue: logging, device state, env-var configuration.
+
+Capability parity with replay/utils/session_handler.py:22-129 (State singleton +
+logger configuration + env-driven knobs). The Spark session becomes JAX device
+state: the singleton resolves the default device/mesh once, honoring
+``REPLAY_TPU_PLATFORM`` (e.g. force cpu) and ``REPLAY_TPU_LOG_LEVEL``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+
+def setup_logging(level: Optional[str] = None) -> logging.Logger:
+    """Configure the framework logger once (idempotent)."""
+    logger = logging.getLogger("replay_tpu")
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s: %(message)s")
+        )
+        logger.addHandler(handler)
+    logger.setLevel((level or os.environ.get("REPLAY_TPU_LOG_LEVEL", "INFO")).upper())
+    return logger
+
+
+class State:
+    """Process-wide device state (the reference's Spark-session singleton,
+    re-purposed: one resolved device list + default mesh per process)."""
+
+    _instance: Optional["State"] = None
+
+    def __new__(cls) -> "State":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._devices = None
+            cls._instance._mesh = None
+        return cls._instance
+
+    @property
+    def devices(self):
+        if self._devices is None:
+            import jax
+
+            platform = os.environ.get("REPLAY_TPU_PLATFORM")
+            self._devices = jax.devices(platform) if platform else jax.devices()
+        return self._devices
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from replay_tpu.nn.train import make_mesh
+
+            self._mesh = make_mesh(self.devices)
+        return self._mesh
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh = mesh
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+
+def get_default_mesh():
+    """The process-wide default mesh (all devices, data-parallel)."""
+    return State().mesh

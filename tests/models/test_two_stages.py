@@ -1,0 +1,50 @@
+"""TwoStages scenario: generators -> features -> learned reranker."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.models import ALS, ItemKNN, PopRec
+from replay_tpu.scenarios import TwoStages
+
+pytestmark = pytest.mark.jax
+
+
+def make_dataset():
+    rng = np.random.default_rng(0)
+    rows = []
+    for u in range(24):
+        liked = np.arange(10) + (u % 2) * 10
+        for t, i in enumerate(rng.choice(liked, 6, replace=False)):
+            rows.append((u, int(i), 1.0, t))
+    log = pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+    return Dataset(feature_schema=FeatureSchema([
+        FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+        FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+        FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+        FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP)]),
+        interactions=log)
+
+
+def test_two_stages_end_to_end():
+    dataset = make_dataset()
+    scenario = TwoStages(
+        first_level_models=[PopRec(), ItemKNN(num_neighbours=5),
+                            ALS(rank=4, num_iterations=4, seed=0)],
+        num_candidates=8,
+        seed=1,
+    )
+    recs = scenario.fit(dataset).predict(dataset, k=3)
+    assert set(recs.columns) >= {"query_id", "item_id", "rating"}
+    assert (recs.groupby("query_id").size() <= 3).all()
+    # probabilities in [0, 1] and no seen items
+    assert recs["rating"].between(0, 1).all()
+    seen = set(map(tuple, dataset.interactions[["query_id", "item_id"]].to_numpy()))
+    assert not seen.intersection(map(tuple, recs[["query_id", "item_id"]].to_numpy()))
+    # the trained reranker should keep in-group recommendations dominant
+    in_group = np.mean(
+        [(row["query_id"] % 2) * 10 <= row["item_id"] < (row["query_id"] % 2 + 1) * 10
+         for _, row in recs.iterrows()]
+    )
+    assert in_group > 0.7
