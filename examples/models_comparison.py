@@ -1,0 +1,81 @@
+"""Classical-model comparison — the res_1m.csv table flow on synthetic data.
+
+Fits the classical zoo on a shared train split and compares NDCG/Recall/Coverage
+through the Experiment battery (SURVEY.md §3.5).
+
+Run: JAX_PLATFORMS=cpu python examples/models_comparison.py
+"""
+
+import time
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.metrics import NDCG, Coverage, Recall
+from replay_tpu.metrics.offline_metrics import Experiment
+from replay_tpu.models import (
+    ALS,
+    SLIM,
+    AssociationRulesItemRec,
+    ItemKNN,
+    PopRec,
+    RandomRec,
+    UCB,
+    Wilson,
+    Word2VecRec,
+)
+from replay_tpu.splitters import RatioSplitter
+
+K = 10
+
+
+def synthetic_log(num_users=300, num_items=120, seed=0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    rows = []
+    for user in range(num_users):
+        taste = user % 4
+        pool = np.arange(num_items // 4) + taste * (num_items // 4)
+        for t, item in enumerate(rng.choice(pool, rng.integers(8, 20), replace=False)):
+            rows.append((user, int(item), float(rng.random() < 0.7), t))
+    return pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+
+
+def main() -> None:
+    log = synthetic_log()
+    train, test = RatioSplitter(test_size=0.25, divide_column="query_id").split(log)
+    dataset = Dataset(
+        feature_schema=FeatureSchema(
+            [
+                FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+                FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+                FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+                FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+            ]
+        ),
+        interactions=train,
+    )
+    experiment = Experiment([NDCG([K]), Recall([K]), Coverage([K])], test, train=train)
+    models = {
+        "PopRec": PopRec(),
+        "RandomRec": RandomRec(seed=0),
+        "Wilson": Wilson(),
+        "UCB": UCB(),
+        "ItemKNN": ItemKNN(num_neighbours=20),
+        "AssocRules": AssociationRulesItemRec(num_neighbours=20, use_lift=True),
+        "SLIM": SLIM(num_iterations=150),
+        "ALS": ALS(rank=16, num_iterations=8, seed=0),
+        "Word2Vec": Word2VecRec(rank=32, num_iterations=60, seed=0),
+    }
+    timings = {}
+    for name, model in models.items():
+        started = time.perf_counter()
+        recs = model.fit_predict(dataset, k=K)
+        timings[name] = round(time.perf_counter() - started, 2)
+        experiment.add_result(name, recs)
+    table = experiment.results.assign(fit_pred_sec=pd.Series(timings))
+    print(table.sort_values(f"NDCG@{K}", ascending=False).round(4))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,7 @@ from replay_tpu.data.nn.schema import TensorFeatureInfo, TensorSchema
 from replay_tpu.data.nn.sequential_dataset import SequentialDataset
 from replay_tpu.data.schema import FeatureSource
 from replay_tpu.preprocessing.label_encoder import HandleUnknownStrategies
+from replay_tpu.utils.serde import to_plain
 
 
 class SequenceTokenizer:
@@ -193,7 +194,7 @@ class SequenceTokenizer:
         )
         (target / "schema.json").write_text(self._schema.to_json())
         mappings = {
-            column: [[_to_plain(label), int(code)] for label, code in rule.get_mapping().items()]
+            column: [[to_plain(label), int(code)] for label, code in rule.get_mapping().items()]
             for column, rule in self._encoder._encoding_rules.items()
         }
         (target / "encoder_mappings.json").write_text(json.dumps(mappings))
@@ -229,7 +230,3 @@ class SequenceTokenizer:
         tokenizer._fitted = args["fitted"]
         return tokenizer
 
-
-def _to_plain(value):
-    """numpy scalars → python scalars for JSON round-trips."""
-    return value.item() if isinstance(value, np.generic) else value

@@ -22,6 +22,7 @@ import numpy as np
 import pandas as pd
 
 from replay_tpu.data.dataset import Dataset
+from replay_tpu.utils.serde import to_plain
 
 from .optimization import OptimizeMixin
 
@@ -178,7 +179,7 @@ class BaseRecommender(OptimizeMixin):
         target.mkdir(parents=True, exist_ok=True)
         init_args = {name: getattr(self, name) for name in self._init_arg_names}
         (target / "init_args.json").write_text(
-            json.dumps({"_class_name": type(self).__name__, **init_args}, default=_plain)
+            json.dumps({"_class_name": type(self).__name__, **init_args}, default=to_plain)
         )
         (target / "fit_info.json").write_text(
             json.dumps(
@@ -190,7 +191,7 @@ class BaseRecommender(OptimizeMixin):
                     "fit_queries": self.fit_queries.tolist(),
                     "fit_items": self.fit_items.tolist(),
                 },
-                default=_plain,
+                default=to_plain,
             )
         )
         self._save_model(target)
@@ -220,11 +221,3 @@ class BaseRecommender(OptimizeMixin):
         model._load_model(source)
         return model
 
-
-def _plain(value):
-    if isinstance(value, np.generic):
-        return value.item()
-    if isinstance(value, np.ndarray):
-        return value.tolist()
-    msg = f"Cannot serialize {type(value)}"
-    raise TypeError(msg)

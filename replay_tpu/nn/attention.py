@@ -24,7 +24,15 @@ def dot_product_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     mask: jnp.ndarray,  # additive [B, 1, L, L]
+    use_flash: bool = False,
 ) -> jnp.ndarray:
+    if use_flash:
+        # pallas fused kernel: no [B, H, L, L] HBM materialization
+        from replay_tpu.ops.flash_attention import flash_attention, fused_attention_available
+
+        return flash_attention(
+            q, k, v, mask, interpret=not fused_attention_available()
+        ).astype(q.dtype)
     scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], dtype=q.dtype))
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + mask.astype(q.dtype)
     weights = nn.softmax(scores, axis=-1)
@@ -32,10 +40,14 @@ def dot_product_attention(
 
 
 class MultiHeadAttention(nn.Module):
-    """Standard multi-head self-attention with an additive mask."""
+    """Standard multi-head self-attention with an additive mask.
+
+    ``use_flash=True`` routes through the pallas fused kernel
+    (replay_tpu.ops.flash_attention) — pick it on TPU for long sequences."""
 
     num_heads: int
     dropout_rate: float = 0.0
+    use_flash: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -53,7 +65,7 @@ class MultiHeadAttention(nn.Module):
             return proj.reshape(*x.shape[:-1], self.num_heads, head_dim).swapaxes(-3, -2)
 
         q, k, v = split("query"), split("key"), split("value")
-        out = dot_product_attention(q, k, v, mask)
+        out = dot_product_attention(q, k, v, mask, use_flash=self.use_flash)
         out = out.swapaxes(-3, -2).reshape(*x.shape[:-1], dim)
         out = nn.Dense(dim, dtype=self.dtype, name="out")(out)
         return nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)

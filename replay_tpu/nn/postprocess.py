@@ -41,7 +41,14 @@ class SeenItemsFilter:
         self.candidates_field = candidates_field
 
     def __call__(self, logits: jnp.ndarray, batch: dict) -> jnp.ndarray:
-        seen = batch[self.seen_field]
+        if self.seen_field in batch:
+            seen = batch[self.seen_field]
+        elif self.seen_field in batch.get("feature_tensors", {}):
+            # grouped batches keep the model inputs under feature_tensors
+            seen = batch["feature_tensors"][self.seen_field]
+        else:
+            msg = f"Seen-items field '{self.seen_field}' not found in the batch."
+            raise KeyError(msg)
         if seen.ndim == 1:
             seen = seen[:, None]
         neg_inf = jnp.finfo(logits.dtype).min

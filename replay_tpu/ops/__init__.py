@@ -1,0 +1,3 @@
+from .flash_attention import flash_attention, fused_attention_available
+
+__all__ = ["flash_attention", "fused_attention_available"]

@@ -1,0 +1,115 @@
+"""Fused attention kernel (pallas, TPU).
+
+The hot op of every sequential recommender here is the [B, H, L, L] attention.
+XLA already fuses most of it; this kernel removes the HBM materialization of the
+score matrix entirely on the FORWARD pass: each (batch, head) program computes
+softmax(QKᵀ/√d + mask) · V inside VMEM with a numerically-stable single pass —
+recsys sequence lengths (50-512) fit one VMEM block, so no KV loop is needed
+(the ring-attention module handles the sharded long-context regime).
+
+Training works through a ``jax.custom_vjp``: the backward pass recomputes the
+attention weights in plain jnp (rematerialization — the standard flash-attention
+trade: no stored score matrix on forward, one recompute on backward) and applies
+the analytic softmax-attention gradients.
+
+The additive mask stays [B, 1, L, L]; the grid reads the same mask block for
+every head via its index map instead of broadcasting to [B, H, L, L] in HBM.
+
+On non-TPU backends the kernel runs in interpreter mode (tests) — call sites
+should prefer it only when ``jax.default_backend() == "tpu"``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref):
+    """One (batch, head) program: fused masked softmax attention in VMEM."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [L, D]
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    bias = bias_ref[0, 0]  # [L, L] additive mask (causal+padding), float32
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32)) + bias
+    row_max = jnp.max(scores, axis=-1, keepdims=True)
+    probs = jnp.exp(scores - row_max)
+    denom = jnp.maximum(jnp.sum(probs, axis=-1, keepdims=True), 1e-30)
+    out = jnp.dot(probs / denom, v, preferred_element_type=jnp.float32)
+    out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+def _forward(q, k, v, bias, interpret):
+    from jax.experimental import pallas as pl
+
+    batch, heads, length, dim = q.shape
+    bias = bias.astype(jnp.float32)
+    bias_heads = bias.shape[1]
+
+    block = lambda: pl.BlockSpec((1, 1, length, dim), lambda b, h: (b, h, 0, 0))
+    # head-invariant masks ([B, 1, L, L]) are re-read per head, never broadcast
+    bias_block = pl.BlockSpec(
+        (1, 1, length, length),
+        (lambda b, h: (b, h, 0, 0)) if bias_heads > 1 else (lambda b, h: (b, 0, 0, 0)),
+    )
+    return pl.pallas_call(
+        _attention_kernel,
+        grid=(batch, heads),
+        in_specs=[block(), block(), block(), bias_block],
+        out_specs=block(),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, bias)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def flash_attention(
+    q: jnp.ndarray,  # [B, H, L, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray,  # [B, 1 or H, L, L] additive mask
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused softmax attention; drop-in for the unfused jnp path, trainable."""
+    return _forward(q, k, v, bias, interpret)
+
+
+def _flash_fwd(q, k, v, bias, interpret):
+    return _forward(q, k, v, bias, interpret), (q, k, v, bias)
+
+
+def _flash_bwd(interpret, residuals, grad_out):
+    q, k, v, bias = residuals
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    qf, kf, vf, g = (t.astype(jnp.float32) for t in (q, k, v, grad_out))
+    # rematerialize the attention weights (XLA fuses this backward chain)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    grad_v = jnp.einsum("bhqk,bhqd->bhkd", probs, g)
+    grad_probs = jnp.einsum("bhqd,bhkd->bhqk", g, vf)
+    # softmax backward: dS = P * (dP - sum_k dP * P)
+    grad_scores = probs * (grad_probs - jnp.sum(grad_probs * probs, axis=-1, keepdims=True))
+    grad_q = jnp.einsum("bhqk,bhkd->bhqd", grad_scores, kf) * scale
+    grad_k = jnp.einsum("bhqk,bhqd->bhkd", grad_scores, qf) * scale
+    grad_bias = grad_scores
+    if bias.shape[1] == 1:  # head-invariant mask: sum the broadcast axis
+        grad_bias = jnp.sum(grad_bias, axis=1, keepdims=True)
+    return (
+        grad_q.astype(q.dtype),
+        grad_k.astype(k.dtype),
+        grad_v.astype(v.dtype),
+        grad_bias.astype(bias.dtype),
+    )
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def fused_attention_available() -> bool:
+    """True when the real (compiled) kernel can run on the current backend."""
+    return jax.default_backend() == "tpu"

@@ -17,6 +17,8 @@ from typing import Literal, Optional, Union
 import numpy as np
 import pandas as pd
 
+from replay_tpu.utils.serde import to_plain
+
 HandleUnknownStrategies = Literal["error", "use_default_value", "drop"]
 _STRATEGIES = ("error", "use_default_value", "drop")
 
@@ -175,6 +177,34 @@ class LabelEncodingRule:
         self._handle_unknown = handle_unknown
 
 
+    # -- persistence -------------------------------------------------------
+    def _as_dict(self) -> dict:
+        return {
+            "_rule": type(self).__name__,
+            "column": self._column,
+            "handle_unknown": self._handle_unknown,
+            "default_value": self._default_value,
+            "mapping": [
+                [to_plain(label), int(code)] for label, code in self._mapping.items()
+            ]
+            if self._mapping is not None
+            else None,
+        }
+
+    @classmethod
+    def _from_dict(cls, data: dict) -> "LabelEncodingRule":
+        rule_cls = _RULE_CLASSES[data["_rule"]]
+        return rule_cls(
+            data["column"],
+            mapping={label: code for label, code in data["mapping"]}
+            if data["mapping"] is not None
+            else None,
+            handle_unknown=data["handle_unknown"],
+            default_value=data["default_value"],
+        )
+
+
+
 class SequenceEncodingRule(LabelEncodingRule):
     """Encode a list-typed column element-wise with one shared mapping."""
 
@@ -281,3 +311,30 @@ class LabelEncoder:
                 msg = f"No encoding rule for column '{column}'."
                 raise ValueError(msg)
             by_column[column].set_handle_unknown(value)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Write rules + fitted mappings to ``<path>.replay`` (ref tokenizer
+        save, replay/data/nn/sequence_tokenizer.py:463-509)."""
+        import json
+        from pathlib import Path
+
+        target = Path(path).with_suffix(".replay")
+        target.mkdir(parents=True, exist_ok=True)
+        payload = {"_class_name": "LabelEncoder", "rules": [r._as_dict() for r in self.rules]}
+        (target / "init_args.json").write_text(json.dumps(payload))
+
+    @classmethod
+    def load(cls, path: str) -> "LabelEncoder":
+        import json
+        from pathlib import Path
+
+        source = Path(path).with_suffix(".replay")
+        payload = json.loads((source / "init_args.json").read_text())
+        return cls([LabelEncodingRule._from_dict(spec) for spec in payload["rules"]])
+
+
+_RULE_CLASSES = {
+    "LabelEncodingRule": LabelEncodingRule,
+    "SequenceEncodingRule": SequenceEncodingRule,
+}

@@ -1,0 +1,14 @@
+"""Shared JSON-serialization helpers for `.replay` artifacts."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def to_plain(value):
+    """numpy scalars/arrays → plain Python for json.dumps."""
+    if isinstance(value, np.generic):
+        return value.item()
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    return value

@@ -116,3 +116,20 @@ def test_set_strategies(df):
     assert out["item_id"].tolist() == [-1]
     with pytest.raises(ValueError):
         encoder.set_default_values({"nope": 1})
+
+
+def test_encoder_save_load_roundtrip(tmp_path):
+    import numpy as np
+
+    df = pd.DataFrame({"item_id": ["a", "b", "c", "a"], "tags": [["x"], ["y", "x"], ["x"], []]})
+    encoder = LabelEncoder(
+        [LabelEncodingRule("item_id"), SequenceEncodingRule("tags", handle_unknown="drop")]
+    ).fit(df)
+    encoder.save(str(tmp_path / "enc"))
+    restored = LabelEncoder.load(str(tmp_path / "enc"))
+    assert restored.mapping == encoder.mapping
+    out_a = encoder.transform(df)
+    out_b = restored.transform(df)
+    pd.testing.assert_frame_equal(out_a, out_b)
+    # strategies survive the roundtrip
+    assert restored.rules[1]._handle_unknown == "drop"
