@@ -1,9 +1,11 @@
+from .batching import UniformBatching, uniform_batch_count
 from .dataset import Dataset, nunique, select
 from .dataset_label_encoder import DatasetLabelEncoder
 from .schema import FeatureHint, FeatureInfo, FeatureSchema, FeatureSource, FeatureType
 
 __all__ = [
     "Dataset",
+    "UniformBatching",
     "DatasetLabelEncoder",
     "FeatureHint",
     "FeatureInfo",
@@ -12,4 +14,5 @@ __all__ = [
     "FeatureType",
     "nunique",
     "select",
+    "uniform_batch_count",
 ]

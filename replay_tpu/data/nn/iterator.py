@@ -74,6 +74,26 @@ class SequenceBatcher:
                 else [(max(0, length - self.max_sequence_length), length)]
             )
             self._index.extend((row, start, stop) for start, stop in spans)
+        self._entries = np.asarray(self._index, dtype=np.int64).reshape(-1, 3)
+        # flat+offsets layout per sequence feature feeds the native gather kernel
+        self._flat: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for name in self._seq_names:
+            sequences = [
+                np.asarray(self.dataset.get_sequence(row, name)).reshape(-1)
+                for row in range(len(self.dataset))
+            ]
+            lengths = np.array([len(s) for s in sequences], np.int64)
+            offsets = np.concatenate([[0], np.cumsum(lengths)])
+            flat = (
+                np.concatenate(sequences) if sequences else np.zeros(0, np.int64)
+            )
+            if np.issubdtype(flat.dtype, np.integer):
+                flat = np.ascontiguousarray(flat, np.int64)  # kernel dtype, once
+            elif np.issubdtype(flat.dtype, np.floating):
+                flat = np.ascontiguousarray(flat, np.float64)
+            else:
+                continue  # exotic dtype: the per-row python path handles it
+            self._flat[name] = (flat, offsets)
 
     def __len__(self) -> int:
         """Number of fixed-size batches for THIS replica (ceil semantics)."""
@@ -111,17 +131,27 @@ class SequenceBatcher:
                     [chunk, np.full(self.batch_size - n_real, chunk[0], dtype=chunk.dtype)]
                 )
             batch: Batch = {}
+            spans = self._entries[chunk]  # [B, 3] (row, start, stop)
             for name in self._seq_names:
                 pad = self._padding_value(name)
-                arr = np.full((self.batch_size, L), pad, dtype=dtypes[name])
-                mask = np.zeros((self.batch_size, L), dtype=bool)
-                for b, entry in enumerate(chunk):
-                    row, start, stop = self._index[entry]
-                    seq = self.dataset.get_sequence(row, name)[start:stop]
-                    arr[b, L - len(seq) :] = seq
-                    mask[b, L - len(seq) :] = True
-                batch[name] = arr
-                batch[f"{name}_mask"] = mask
+                if name in self._flat:
+                    from replay_tpu.native import gather_pad_spans
+
+                    flat, offsets = self._flat[name]
+                    arr, mask = gather_pad_spans(
+                        flat, offsets, spans[:, 0], spans[:, 1], spans[:, 2], L, pad
+                    )
+                    batch[name] = arr.astype(dtypes[name], copy=False)
+                else:
+                    arr = np.full((self.batch_size, L), pad, dtype=dtypes[name])
+                    mask = np.zeros((self.batch_size, L), dtype=bool)
+                    for b, entry in enumerate(chunk):
+                        row, start, stop = self._index[entry]
+                        seq = self.dataset.get_sequence(row, name)[start:stop]
+                        arr[b, L - len(seq) :] = seq
+                        mask[b, L - len(seq) :] = True
+                    batch[name] = arr
+                batch[f"{name}_mask"] = np.asarray(mask, bool)
             for name in self._scalar_names:
                 batch[name] = np.asarray(
                     [

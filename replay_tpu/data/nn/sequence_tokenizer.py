@@ -35,7 +35,6 @@ from replay_tpu.data.nn.schema import TensorFeatureInfo, TensorSchema
 from replay_tpu.data.nn.sequential_dataset import SequentialDataset
 from replay_tpu.data.schema import FeatureSource
 from replay_tpu.preprocessing.label_encoder import HandleUnknownStrategies
-from replay_tpu.utils.serde import to_plain
 
 
 class SequenceTokenizer:
@@ -193,8 +192,10 @@ class SequenceTokenizer:
             )
         )
         (target / "schema.json").write_text(self._schema.to_json())
+        # one serialization format for encoding rules everywhere: the rule's own
+        # _as_dict/_from_dict (shared with LabelEncoder.save/load)
         mappings = {
-            column: [[to_plain(label), int(code)] for label, code in rule.get_mapping().items()]
+            column: rule._as_dict()
             for column, rule in self._encoder._encoding_rules.items()
         }
         (target / "encoder_mappings.json").write_text(json.dumps(mappings))
@@ -217,13 +218,8 @@ class SequenceTokenizer:
             default_value_rule=args["default_value_rule"],
         )
         mappings = json.loads((source / "encoder_mappings.json").read_text())
-        for column, pairs in mappings.items():
-            tokenizer._encoder._encoding_rules[column] = LabelEncodingRule(
-                column,
-                mapping={label: code for label, code in pairs},
-                handle_unknown=args["handle_unknown_rule"],
-                default_value=args["default_value_rule"],
-            )
+        for column, spec in mappings.items():
+            tokenizer._encoder._encoding_rules[column] = LabelEncodingRule._from_dict(spec)
         columns = json.loads((source / "encoder_columns.json").read_text())
         tokenizer._encoder._query_column_name = columns["query"]
         tokenizer._encoder._item_column_name = columns["item"]

@@ -22,7 +22,7 @@ import numpy as np
 import pandas as pd
 
 from replay_tpu.data.dataset import Dataset
-from replay_tpu.utils.serde import to_plain
+from replay_tpu.utils.serde import json_default
 
 from .optimization import OptimizeMixin
 
@@ -179,7 +179,7 @@ class BaseRecommender(OptimizeMixin):
         target.mkdir(parents=True, exist_ok=True)
         init_args = {name: getattr(self, name) for name in self._init_arg_names}
         (target / "init_args.json").write_text(
-            json.dumps({"_class_name": type(self).__name__, **init_args}, default=to_plain)
+            json.dumps({"_class_name": type(self).__name__, **init_args}, default=json_default)
         )
         (target / "fit_info.json").write_text(
             json.dumps(
@@ -191,7 +191,7 @@ class BaseRecommender(OptimizeMixin):
                     "fit_queries": self.fit_queries.tolist(),
                     "fit_items": self.fit_items.tolist(),
                 },
-                default=to_plain,
+                default=json_default,
             )
         )
         self._save_model(target)

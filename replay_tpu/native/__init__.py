@@ -26,22 +26,28 @@ _build_attempted = False
 
 
 def _build() -> Optional[object]:
-    """Compile ragged.cpp into an importable extension (idempotent)."""
+    """Compile ragged.cpp into an importable extension (idempotent).
+
+    Builds into a temp file and replaces atomically so a failed rebuild never
+    destroys a previously working artifact."""
     global _build_attempted
     if _build_attempted:
         return None
     _build_attempted = True
     include = sysconfig.get_paths()["include"]
+    staging = _SO_PATH.with_suffix(".building.so")
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
         f"-I{include}",
         str(_HERE / "ragged.cpp"),
-        "-o", str(_SO_PATH),
+        "-o", str(staging),
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (subprocess.SubprocessError, FileNotFoundError) as error:
+        staging.replace(_SO_PATH)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as error:
         logger.info("native ragged kernel build failed (%s); using numpy fallback", error)
+        staging.unlink(missing_ok=True)
         return None
     return _load()
 
@@ -67,7 +73,20 @@ def native_available() -> bool:
     global _native
     if _native is None:
         _native = _load() or _build()
+    if _native is not None and not hasattr(_native, "gather_pad_spans_i64"):
+        # artifact from an older kernel source: try a rebuild, but KEEP the old
+        # module if the toolchain is unavailable — its gather_pad still works
+        # (span calls route through the per-function guards below)
+        global _build_attempted
+        _build_attempted = False
+        rebuilt = _build()
+        if rebuilt is not None:
+            _native = rebuilt
     return _native is not None
+
+
+def _native_has(function_name: str) -> bool:
+    return native_available() and hasattr(_native, function_name)
 
 
 def gather_pad(
@@ -102,7 +121,11 @@ def gather_pad(
         out = np.empty((batch, max_len), np.int64)
         _native.gather_pad_i64(payload, offsets, indices, out, mask, max_len, int(pad_value))
         return out, mask.astype(bool)
-    # numpy fallback: same semantics, one python loop over the batch
+    # numpy fallback: same semantics + validation as the C kernel
+    n_rows = len(offsets) - 1
+    if ((indices < 0) | (indices >= n_rows)).any():
+        msg = "gather_pad: row index out of range"
+        raise ValueError(msg)
     out = np.full((batch, max_len), pad_value, np.float64 if floating else np.int64)
     mask[:] = 0
     for b, row in enumerate(indices):
@@ -112,4 +135,64 @@ def gather_pad(
         row_values = values[start:stop]
         out[b, max_len - len(row_values):] = row_values
         mask[b, max_len - len(row_values):] = 1
+    return out, mask.astype(bool)
+
+
+def gather_pad_spans(
+    values: np.ndarray,
+    offsets: np.ndarray,
+    rows: np.ndarray,
+    starts: np.ndarray,
+    stops: np.ndarray,
+    max_len: int,
+    pad_value,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Gather (row, start, stop) SPANS of a ragged column, LEFT-padded.
+
+    The windowed-training gather: entry ``b`` takes row ``rows[b]``'s values
+    ``[starts[b]:stops[b]]`` (row-relative). Spans longer than ``max_len`` keep
+    their last ``max_len`` values. Same dtype rules as :func:`gather_pad`.
+    """
+    values = np.asarray(values)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    rows = np.ascontiguousarray(rows, np.int64)
+    starts = np.ascontiguousarray(starts, np.int64)
+    stops = np.ascontiguousarray(stops, np.int64)
+    batch = len(rows)
+    floating = np.issubdtype(values.dtype, np.floating)
+    mask = np.empty((batch, max_len), np.uint8)
+    if _native_has("gather_pad_spans_i64"):
+        if floating:
+            payload = np.ascontiguousarray(values, np.float64).view(np.int64)
+            pad_bits = np.float64(pad_value).view(np.int64)
+            out = np.empty((batch, max_len), np.int64)
+            _native.gather_pad_spans_i64(
+                payload, offsets, rows, starts, stops, out, mask, max_len, int(pad_bits)
+            )
+            return out.view(np.float64), mask.astype(bool)
+        payload = np.ascontiguousarray(values, np.int64)
+        out = np.empty((batch, max_len), np.int64)
+        _native.gather_pad_spans_i64(
+            payload, offsets, rows, starts, stops, out, mask, max_len, int(pad_value)
+        )
+        return out, mask.astype(bool)
+    # numpy fallback with the SAME validation + error type as the C kernel
+    n_rows = len(offsets) - 1
+    row_lengths = offsets[rows.clip(0, n_rows - 1) + 1] - offsets[rows.clip(0, n_rows - 1)]
+    bad = (
+        (rows < 0) | (rows >= n_rows) | (starts < 0) | (stops < starts) | (stops > row_lengths)
+    )
+    if bad.any():
+        msg = "gather_pad_spans: index or span out of range"
+        raise ValueError(msg)
+    out = np.full((batch, max_len), pad_value, np.float64 if floating else np.int64)
+    mask[:] = 0
+    for b in range(batch):
+        base = offsets[rows[b]]
+        start, stop = int(starts[b]), int(stops[b])
+        if stop - start > max_len:
+            start = stop - max_len
+        span = values[base + start : base + stop]
+        out[b, max_len - len(span):] = span
+        mask[b, max_len - len(span):] = 1
     return out, mask.astype(bool)

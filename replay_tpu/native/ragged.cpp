@@ -73,9 +73,74 @@ static PyObject* gather_pad_i64(PyObject* /*self*/, PyObject* args) {
     Py_RETURN_NONE;
 }
 
+// Span variant: entry b copies values[offsets[rows[b]]+starts[b] :
+// offsets[rows[b]]+stops[b]] — the windowed-training gather
+// (SequenceBatcher's (row, start, stop) index entries) in one C loop.
+static PyObject* gather_pad_spans_i64(PyObject* /*self*/, PyObject* args) {
+    Py_buffer values, offsets, rows, starts, stops, out, mask;
+    long long max_len_ll, pad_value_ll;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*y*y*LL",
+                          &values, &offsets, &rows, &starts, &stops, &out, &mask,
+                          &max_len_ll, &pad_value_ll)) {
+        return nullptr;
+    }
+    const int64_t max_len = (int64_t)max_len_ll;
+    const int64_t pad_value = (int64_t)pad_value_ll;
+    const int64_t* vals = (const int64_t*)values.buf;
+    const int64_t* offs = (const int64_t*)offsets.buf;
+    const int64_t* row_idx = (const int64_t*)rows.buf;
+    const int64_t* start_idx = (const int64_t*)starts.buf;
+    const int64_t* stop_idx = (const int64_t*)stops.buf;
+    int64_t* out_buf = (int64_t*)out.buf;
+    uint8_t* mask_buf = (uint8_t*)mask.buf;
+    const int64_t batch = (int64_t)(rows.len / (Py_ssize_t)sizeof(int64_t));
+    const int64_t n_rows = (int64_t)(offsets.len / (Py_ssize_t)sizeof(int64_t)) - 1;
+    const int64_t total = (int64_t)(values.len / (Py_ssize_t)sizeof(int64_t));
+
+    int bad = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (int64_t b = 0; b < batch; ++b) {
+        const int64_t row = row_idx[b];
+        if (row < 0 || row >= n_rows) { bad = 1; break; }
+        const int64_t base = offs[row];
+        const int64_t row_len = offs[row + 1] - base;
+        int64_t start = start_idx[b];
+        int64_t stop = stop_idx[b];
+        if (start < 0 || stop < start || stop > row_len) { bad = 1; break; }
+        if (base + stop > total) { bad = 1; break; }
+        int64_t len = stop - start;
+        if (len > max_len) {           // recency window inside the span
+            start = stop - max_len;
+            len = max_len;
+        }
+        const int64_t pad = max_len - len;
+        int64_t* out_row = out_buf + b * max_len;
+        uint8_t* mask_row = mask_buf + b * max_len;
+        for (int64_t j = 0; j < pad; ++j) { out_row[j] = pad_value; mask_row[j] = 0; }
+        std::memcpy(out_row + pad, vals + base + start, (size_t)len * sizeof(int64_t));
+        std::memset(mask_row + pad, 1, (size_t)len);
+    }
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&values);
+    PyBuffer_Release(&offsets);
+    PyBuffer_Release(&rows);
+    PyBuffer_Release(&starts);
+    PyBuffer_Release(&stops);
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&mask);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError, "gather_pad_spans_i64: index or span out of range");
+        return nullptr;
+    }
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef Methods[] = {
     {"gather_pad_i64", gather_pad_i64, METH_VARARGS,
      "Gather ragged int64 rows and left-pad into a fixed [batch, max_len] buffer."},
+    {"gather_pad_spans_i64", gather_pad_spans_i64, METH_VARARGS,
+     "Gather (row, start, stop) spans of a ragged int64 column, left-padded."},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -26,8 +26,6 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-NEG_INF = -1e30
-
 
 def _attention_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref):
     """One (batch, head) program: fused masked softmax attention in VMEM."""

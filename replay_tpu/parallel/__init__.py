@@ -1,3 +1,9 @@
+from .distributed import initialize_distributed, replicas_info
 from .ring import full_attention_reference, ring_attention
 
-__all__ = ["full_attention_reference", "ring_attention"]
+__all__ = [
+    "full_attention_reference",
+    "initialize_distributed",
+    "replicas_info",
+    "ring_attention",
+]

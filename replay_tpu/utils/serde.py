@@ -6,9 +6,19 @@ import numpy as np
 
 
 def to_plain(value):
-    """numpy scalars/arrays → plain Python for json.dumps."""
+    """numpy scalars/arrays → plain Python (permissive: other values pass through)."""
     if isinstance(value, np.generic):
         return value.item()
     if isinstance(value, np.ndarray):
         return value.tolist()
     return value
+
+
+def json_default(value):
+    """``json.dumps(default=...)`` hook: convert numpy, REJECT anything else with
+    a clear diagnostic (the hook is only invoked for non-serializable objects, so
+    returning the value unchanged would yield a confusing circular-ref error)."""
+    if isinstance(value, (np.generic, np.ndarray)):
+        return to_plain(value)
+    msg = f"Cannot serialize {type(value).__name__} value in a .replay artifact"
+    raise TypeError(msg)

@@ -133,3 +133,23 @@ class TestParquetBatcher:
     def test_missing_metadata_raises(self, sequence_parquet):
         with pytest.raises(ValueError, match="metadata"):
             list(ParquetBatcher(sequence_parquet, batch_size=4))
+
+def test_gather_pad_spans_native_and_fallback():
+    values = np.arange(12, dtype=np.int64)
+    offsets = np.array([0, 5, 12], np.int64)
+    rows = np.array([0, 1, 1], np.int64)
+    starts = np.array([1, 0, 3], np.int64)
+    stops = np.array([4, 7, 7], np.int64)
+    from replay_tpu.native import gather_pad_spans
+
+    out, mask = gather_pad_spans(values, offsets, rows, starts, stops, 4, -9)
+    np.testing.assert_array_equal(out[0], [-9, 1, 2, 3])       # row 0 span [1:4]
+    np.testing.assert_array_equal(out[1], [8, 9, 10, 11])      # [0:7] keeps LAST 4
+    np.testing.assert_array_equal(out[2], [8, 9, 10, 11])      # row 1 span [3:7]
+    assert mask[0].tolist() == [False, True, True, True]
+    # float path round-trips exactly
+    out_f, _ = gather_pad_spans(values.astype(np.float64) + 0.5, offsets, rows,
+                                starts, stops, 4, -1.0)
+    np.testing.assert_array_equal(out_f[0], [-1.0, 1.5, 2.5, 3.5])
+    with pytest.raises(ValueError):
+        gather_pad_spans(values, offsets, np.array([9]), np.array([0]), np.array([1]), 4, 0)

@@ -1,0 +1,17 @@
+"""Uniform batch arithmetic."""
+
+import pytest
+
+from replay_tpu.data import UniformBatching, uniform_batch_count
+
+
+def test_counts_and_limits():
+    batching = UniformBatching(total=10, batch_size=4)
+    assert len(batching) == 3 == uniform_batch_count(10, 4)
+    assert [batching.start(i) for i in range(3)] == [0, 4, 8]
+    assert [batching.limit(i) for i in range(3)] == [4, 4, 2]
+    with pytest.raises(IndexError):
+        batching.limit(3)
+    with pytest.raises(ValueError):
+        UniformBatching(total=1, batch_size=0)
+    assert uniform_batch_count(0, 4) == 0

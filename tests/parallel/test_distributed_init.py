@@ -1,0 +1,17 @@
+"""Distributed init wrapper: single-process no-op path + layout report."""
+
+import pytest
+
+from replay_tpu.parallel import initialize_distributed, replicas_info
+
+
+@pytest.mark.jax
+def test_single_process_noop():
+    layout = initialize_distributed()
+    assert layout["process_id"] == 0
+    assert layout["num_processes"] == 1
+    assert layout["global_devices"] >= 1
+    # idempotent
+    assert initialize_distributed() == layout
+    info = replicas_info(num_workers=2)
+    assert info.num_replicas == 2 and info.replica_id == 0
