@@ -1,4 +1,5 @@
 from .iterator import SequenceBatcher, validation_batches
+from .module import DataModule
 from .parquet import ParquetBatcher, write_sequence_parquet
 from .partitioning import Partitioning, ReplicasInfo
 from .schema import TensorFeatureInfo, TensorFeatureSource, TensorMap, TensorSchema
@@ -6,6 +7,7 @@ from .sequence_tokenizer import SequenceTokenizer
 from .sequential_dataset import SequentialDataset
 
 __all__ = [
+    "DataModule",
     "ParquetBatcher",
     "Partitioning",
     "ReplicasInfo",

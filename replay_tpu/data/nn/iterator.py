@@ -97,9 +97,11 @@ class SequenceBatcher:
 
     def __len__(self) -> int:
         """Number of fixed-size batches for THIS replica (ceil semantics)."""
+        from replay_tpu.data.batching import uniform_batch_count
+
         part = self.partitioning or Partitioning()
         per_replica = len(part.generate(len(self._index), self.epoch))
-        return -(-per_replica // self.batch_size)
+        return uniform_batch_count(per_replica, self.batch_size)
 
     def set_epoch(self, epoch: int) -> None:
         """Advance the shuffle epoch (folds into the partitioning seed)."""
@@ -138,8 +140,14 @@ class SequenceBatcher:
                     from replay_tpu.native import gather_pad_spans
 
                     flat, offsets = self._flat[name]
+                    # a secondary feature may be shorter than the item sequence
+                    # that defined the window: clamp to ITS row length (the same
+                    # silent-truncation semantics as python slicing)
+                    row_len = offsets[spans[:, 0] + 1] - offsets[spans[:, 0]]
+                    stops = np.minimum(spans[:, 2], row_len)
+                    starts = np.minimum(spans[:, 1], stops)
                     arr, mask = gather_pad_spans(
-                        flat, offsets, spans[:, 0], spans[:, 1], spans[:, 2], L, pad
+                        flat, offsets, spans[:, 0], starts, stops, L, pad
                     )
                     batch[name] = arr.astype(dtypes[name], copy=False)
                 else:

@@ -1,0 +1,95 @@
+"""Per-split data wiring: sources + metadata + transform pipelines in one object.
+
+Capability parity with replay/data/nn/parquet/parquet_module.py:20-206 (the
+LightningDataModule: per-split ParquetDataset construction, per-split transform
+pipelines applied after device transfer, multiple validation paths). Without a
+Lightning trainer the module is a plain factory: ``batches(split, epoch)``
+yields transformed fixed-shape batches ready for Trainer.fit/validate/predict —
+``fit(module.train_batches, ...)`` plugs straight in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional, Sequence
+
+from replay_tpu.data.nn.parquet import ParquetBatcher
+from replay_tpu.data.nn.partitioning import Partitioning
+
+SPLITS = ("train", "validate", "test", "predict")
+
+
+@dataclass
+class DataModule:
+    """Everything the trainer needs to pull batches for every split.
+
+    :param sources: split → parquet file/dataset path (any subset of
+        train/validate/test/predict; several validation paths can be expressed
+        as ``validate``, ``validate_2``, … — each key is its own stream).
+    :param metadata: list-column spec ``{column: {"shape": L, "padding": v}}``
+        shared by all splits (the reference's metadata tree).
+    :param transforms: split → transform pipeline (defaults to identity).
+    """
+
+    sources: Dict[str, str]
+    batch_size: int
+    metadata: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    transforms: Dict[str, Sequence] = field(default_factory=dict)
+    partition_size: int = 1 << 20
+    shuffle_train: bool = True
+    seed: int = 0
+    partitioning: Optional[Partitioning] = None
+
+    def __post_init__(self) -> None:
+        # lazy import: keep replay_tpu.data importable without the nn stack
+        from replay_tpu.nn.transform.transforms import Compose
+
+        self._pipelines = {
+            split: Compose(list(pipeline)) for split, pipeline in self.transforms.items()
+        }
+
+    def _batcher(self, split: str, epoch: int) -> ParquetBatcher:
+        if split not in self.sources:
+            msg = f"No source configured for split '{split}' (have {sorted(self.sources)})"
+            raise KeyError(msg)
+        batcher = ParquetBatcher(
+            self.sources[split],
+            batch_size=self.batch_size,
+            metadata=self.metadata,
+            partition_size=self.partition_size,
+            shuffle=self.shuffle_train and split == "train",
+            seed=self.seed,
+            partitioning=self.partitioning,
+        )
+        batcher.set_epoch(epoch)
+        return batcher
+
+    def batches(self, split: str, epoch: int = 0) -> Iterator[dict]:
+        """Transformed fixed-shape batches of one split."""
+        pipeline = self._pipelines.get(split) or self._pipelines.get(
+            split.split("_")[0]  # validate_2 falls back to the validate pipeline
+        )
+        import jax
+
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch)
+        for batch in self._batcher(split, epoch):
+            if pipeline is None:
+                yield batch
+            elif pipeline.needs_rng:
+                rng, sub = jax.random.split(rng)
+                yield pipeline(batch, sub)
+            else:
+                yield pipeline(batch)
+
+    # Trainer-shaped entry points -------------------------------------------- #
+    def train_batches(self, epoch: int = 0) -> Iterator[dict]:
+        return self.batches("train", epoch)
+
+    def val_batches(self) -> Iterator[dict]:
+        return self.batches("validate")
+
+    def test_batches(self) -> Iterator[dict]:
+        return self.batches("test")
+
+    def predict_batches(self) -> Iterator[dict]:
+        return self.batches("predict")

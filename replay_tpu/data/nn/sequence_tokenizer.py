@@ -219,7 +219,16 @@ class SequenceTokenizer:
         )
         mappings = json.loads((source / "encoder_mappings.json").read_text())
         for column, spec in mappings.items():
-            tokenizer._encoder._encoding_rules[column] = LabelEncodingRule._from_dict(spec)
+            if isinstance(spec, list):  # pre-unification format: [[label, code], ...]
+                rule = LabelEncodingRule(
+                    column,
+                    mapping={label: code for label, code in spec},
+                    handle_unknown=args["handle_unknown_rule"],
+                    default_value=args["default_value_rule"],
+                )
+            else:
+                rule = LabelEncodingRule._from_dict(spec)
+            tokenizer._encoder._encoding_rules[column] = rule
         columns = json.loads((source / "encoder_columns.json").read_text())
         tokenizer._encoder._query_column_name = columns["query"]
         tokenizer._encoder._item_column_name = columns["item"]

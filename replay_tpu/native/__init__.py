@@ -69,19 +69,27 @@ def _load() -> Optional[object]:
     return module
 
 
+_rebuild_tried = False
+
+
 def native_available() -> bool:
-    global _native
+    global _native, _rebuild_tried
     if _native is None:
         _native = _load() or _build()
-    if _native is not None and not hasattr(_native, "gather_pad_spans_i64"):
-        # artifact from an older kernel source: try a rebuild, but KEEP the old
-        # module if the toolchain is unavailable — its gather_pad still works
-        # (span calls route through the per-function guards below)
+    if (
+        _native is not None
+        and not hasattr(_native, "gather_pad_spans_i64")
+        and not _rebuild_tried
+    ):
+        # artifact from an older kernel source. Rebuild ONCE so future processes
+        # load the full kernel; THIS process keeps the old module (CPython caches
+        # extension modules by name, a reload would return the stale one) — its
+        # gather_pad still runs native and span calls take the numpy fallback
+        # via the per-function guard.
         global _build_attempted
+        _rebuild_tried = True
         _build_attempted = False
-        rebuilt = _build()
-        if rebuilt is not None:
-            _native = rebuilt
+        _build()
     return _native is not None
 
 

@@ -53,19 +53,20 @@ def initialize_distributed(
         "REPLAY_TPU_PROCESS_ID", "JAX_PROCESS_ID"
     )
 
-    if not _initialized:
-        if coordinator_address is not None or _on_tpu_pod():
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
-            logger.info(
-                "joined distributed job: process %d/%d",
-                jax.process_index(),
-                jax.process_count(),
-            )
+    # the flag marks an ACTUAL initialization: a no-op call (no coordinator, not
+    # a pod) must not block a later call that does carry a coordinator
+    if not _initialized and (coordinator_address is not None or _on_tpu_pod()):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
         _initialized = True
+        logger.info(
+            "joined distributed job: process %d/%d",
+            jax.process_index(),
+            jax.process_count(),
+        )
 
     return {
         "process_id": jax.process_index(),

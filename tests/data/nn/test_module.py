@@ -1,0 +1,59 @@
+"""DataModule: per-split sources + pipelines -> trainer-shaped batch streams."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import FeatureHint, FeatureType
+from replay_tpu.data.nn import (
+    DataModule,
+    SequentialDataset,
+    TensorFeatureInfo,
+    TensorSchema,
+    write_sequence_parquet,
+)
+from replay_tpu.nn.transform import RenameTransform, GroupTransform
+
+
+@pytest.fixture
+def sources(tmp_path):
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=20)
+    )
+    paths = {}
+    for split, n in (("train", 17), ("validate", 6)):
+        frame = pd.DataFrame({
+            "query_id": np.arange(n),
+            "item_id": [np.arange(i % 5 + 1) for i in range(n)],
+        })
+        path = str(tmp_path / f"{split}.parquet")
+        write_sequence_parquet(path, SequentialDataset(schema, "query_id", "item_id", frame))
+        paths[split] = path
+    return paths
+
+
+@pytest.mark.jax
+def test_per_split_streams(sources):
+    module = DataModule(
+        sources=sources,
+        batch_size=4,
+        metadata={"item_id": {"shape": 5, "padding": 20}},
+        transforms={
+            "train": [RenameTransform({"item_id_mask": "padding_mask"}),
+                      GroupTransform({"feature_tensors": ["item_id"]})],
+            "validate": [RenameTransform({"item_id_mask": "padding_mask"})],
+        },
+    )
+    train = list(module.train_batches(epoch=0))
+    assert len(train) == 5  # ceil(17/4)
+    assert "feature_tensors" in train[0] and "padding_mask" in train[0]
+    val = list(module.val_batches())
+    assert len(val) == 2
+    assert "padding_mask" in val[0] and "feature_tensors" not in val[0]
+    # train shuffling advances with the epoch; validation order is stable
+    epoch1 = [b["query_id"][b["valid"]] for b in module.train_batches(epoch=1)]
+    epoch0 = [b["query_id"][b["valid"]] for b in module.train_batches(epoch=0)]
+    assert not all(np.array_equal(a, b) for a, b in zip(epoch0, epoch1))
+    with pytest.raises(KeyError, match="No source"):
+        list(module.test_batches())
