@@ -488,6 +488,49 @@ class Trainer:
         queries = np.concatenate(all_queries) if all_queries else np.arange(items.shape[0])
         return queries, items, scores
 
+    def predict_query_embeddings(self, state: TrainState, batches: Iterable[Batch]):
+        """Last-position query embeddings [N, E] (the reference
+        QueryEmbeddingsPredictionCallback), e.g. for two-stage features."""
+        model = self.model
+        fn = jax.jit(
+            lambda params, feature_tensors, padding_mask: model.apply(
+                {"params": params},
+                feature_tensors,
+                padding_mask,
+                method=type(model).get_query_embeddings,
+            )
+        )
+        chunks, queries = [], []
+        for batch in batches:
+            batch = self._put_batch(batch)
+            embeddings = fn(state.params, batch[self.feature_field], batch[self.padding_mask_field])
+            valid = np.asarray(batch.get("valid", np.ones(embeddings.shape[0], bool)))
+            chunks.append(np.asarray(embeddings)[valid])
+            if "query_id" in batch:
+                queries.append(np.asarray(batch["query_id"])[valid])
+        embeddings = np.concatenate(chunks) if chunks else np.zeros((0, 0))
+        query_ids = np.concatenate(queries) if queries else np.arange(len(embeddings))
+        return query_ids, embeddings
+
+    def resize_vocabulary(
+        self, state: TrainState, new_cardinality: int, init_tensor=None
+    ) -> TrainState:
+        """Catalog growth between retrains: item-table surgery + fresh optimizer
+        state for the new shapes (step/rng carry over)."""
+        from replay_tpu.nn.vocabulary import resize_item_embeddings
+
+        params = resize_item_embeddings(
+            jax.tree.map(np.asarray, state.params), self.model.schema, new_cardinality,
+            init_tensor,
+        )
+        shardings = _params_shardings(self.mesh, params, self.shard_vocab)
+        params = jax.tree.map(jax.device_put, params, shardings)
+        self._train_step = None  # shapes changed: retrace
+        self._eval_logits = None
+        return TrainState(
+            step=state.step, params=params, opt_state=self._tx.init(params), rng=state.rng
+        )
+
     # -- checkpointing ------------------------------------------------------ #
     def save_checkpoint(self, path: str, state: TrainState) -> None:
         """Write the full TrainState (params + optimizer + PRNG) to ``path``."""

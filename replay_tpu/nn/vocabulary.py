@@ -1,0 +1,113 @@
+"""Item-vocabulary surgery on trained parameters.
+
+Capability parity with the reference's continual-catalog operations
+(replay/models/nn/sequential/sasrec/lightning.py:493-568:
+``set_item_embeddings_by_size`` / ``set_item_embeddings_by_tensor`` /
+``append_item_embeddings``): grow or replace the item-embedding table of an
+ALREADY-TRAINED model when the catalog changes between retrains.
+
+Pure functional: params in, params out. The padding row stays the LAST table
+row (the weight-tying alignment invariant, replay_tpu/nn/embedding.py), so
+growth moves the padding row to the new end and initializes fresh rows from
+the mean of the existing embeddings (the reference's default) or a caller
+tensor. The schema object is updated in place (cardinality/padding move
+together).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from replay_tpu.data.nn.schema import TensorSchema
+
+
+def _find_table_path(params, feature_name: str):
+    """Locate the '<...>/embedding_<feature>/table/embedding' leaf path."""
+    marker = f"embedding_{feature_name}"
+    matches = []
+
+    def visit(path, leaf):
+        path_str = jax.tree_util.keystr(path)
+        if marker in path_str and path_str.endswith("['embedding']"):
+            matches.append((path, leaf))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    if not matches:
+        msg = f"No embedding table found for feature '{feature_name}'."
+        raise ValueError(msg)
+    return matches
+
+
+def _replace_leaf(params, target_path, new_leaf):
+    def swap(path, leaf):
+        return new_leaf if path == target_path else leaf
+
+    return jax.tree_util.tree_map_with_path(swap, params)
+
+
+def resize_item_embeddings(
+    params,
+    schema: TensorSchema,
+    new_cardinality: int,
+    init_tensor: Optional[np.ndarray] = None,
+) -> dict:
+    """Grow (or shrink) the item table to ``new_cardinality`` (+1 padding row).
+
+    Existing item rows are preserved; new rows come from ``init_tensor`` when
+    given (``[new_items, E]`` for the appended rows or ``[new_cardinality, E]``
+    for a full replacement) else from the mean of the existing rows. The
+    schema's ITEM_ID cardinality (and its default padding value) is updated.
+    """
+    feature_name = schema.item_id_feature_name
+    if feature_name is None:
+        msg = "Schema has no ITEM_ID feature."
+        raise ValueError(msg)
+    old_cardinality = schema[feature_name].cardinality
+    for path, table in _find_table_path(params, feature_name):
+        table = np.asarray(table)
+        rows, dim = table.shape
+        if rows != old_cardinality + 1:
+            continue  # another feature's table that shares the name marker
+        items, padding_row = table[:old_cardinality], table[old_cardinality:]
+        if init_tensor is not None and len(init_tensor) == new_cardinality:
+            new_items = np.asarray(init_tensor, table.dtype)
+        elif new_cardinality <= old_cardinality:
+            new_items = items[:new_cardinality]
+        else:
+            extra = (
+                np.asarray(init_tensor, table.dtype)
+                if init_tensor is not None
+                else np.tile(items.mean(axis=0, keepdims=True), (new_cardinality - old_cardinality, 1))
+            )
+            if len(extra) != new_cardinality - old_cardinality:
+                msg = (
+                    f"init_tensor has {len(extra)} rows; expected "
+                    f"{new_cardinality - old_cardinality} appended or {new_cardinality} total."
+                )
+                raise ValueError(msg)
+            new_items = np.concatenate([items, extra])
+        new_table = np.concatenate([new_items, padding_row])  # padding stays LAST
+        params = _replace_leaf(params, path, new_table.astype(table.dtype))
+    schema[feature_name]._set_cardinality(new_cardinality)
+    # let the padding default re-resolve to the new cardinality (last-row invariant)
+    schema[feature_name]._padding_value = None
+    return params
+
+
+def append_item_embeddings(params, schema: TensorSchema, new_rows: np.ndarray) -> dict:
+    """Append ``[K, E]`` rows for K new catalog items (ref append_item_embeddings)."""
+    feature_name = schema.item_id_feature_name
+    new_rows = np.atleast_2d(np.asarray(new_rows))
+    return resize_item_embeddings(
+        params, schema, schema[feature_name].cardinality + len(new_rows), new_rows
+    )
+
+
+def set_item_embeddings(params, schema: TensorSchema, table: np.ndarray) -> dict:
+    """Replace the whole item table with ``[num_items, E]`` (ref
+    set_item_embeddings_by_tensor)."""
+    return resize_item_embeddings(params, schema, len(table), np.asarray(table))

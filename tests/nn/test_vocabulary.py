@@ -1,0 +1,89 @@
+"""Vocabulary surgery: catalog growth on trained parameters."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from replay_tpu.data import FeatureHint, FeatureType
+from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+from replay_tpu.nn import OptimizerFactory, Trainer
+from replay_tpu.nn.loss import CE
+from replay_tpu.nn.sequential.sasrec import SasRec
+from replay_tpu.nn.vocabulary import append_item_embeddings, resize_item_embeddings, set_item_embeddings
+
+pytestmark = pytest.mark.jax
+
+NUM_ITEMS, SEQ_LEN, BATCH = 8, 5, 4
+
+
+def make_schema(cardinality=NUM_ITEMS):
+    return TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=cardinality,
+                          embedding_dim=8)
+    )
+
+
+def make_batch(num_items, rng):
+    items = rng.integers(0, num_items, (BATCH, SEQ_LEN + 1)).astype(np.int32)
+    mask = np.ones((BATCH, SEQ_LEN), bool)
+    return {
+        "feature_tensors": {"item_id": items[:, :-1]},
+        "padding_mask": mask,
+        "positive_labels": items[:, 1:, None],
+        "target_padding_mask": mask[:, :, None],
+    }
+
+
+def test_grow_shrink_and_replace():
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    rng = np.random.default_rng(0)
+    params = model.init(jax.random.PRNGKey(0), {"item_id": np.zeros((2, SEQ_LEN), np.int32)},
+                        np.ones((2, SEQ_LEN), bool))["params"]
+    params = jax.tree.map(np.asarray, params)
+    old_table = params["body"]["embedder"]["embedding_item_id"]["table"]["embedding"].copy()
+
+    grown = resize_item_embeddings(params, schema, NUM_ITEMS + 3)
+    new_table = grown["body"]["embedder"]["embedding_item_id"]["table"]["embedding"]
+    assert new_table.shape == (NUM_ITEMS + 4, 8)
+    np.testing.assert_array_equal(new_table[:NUM_ITEMS], old_table[:NUM_ITEMS])
+    np.testing.assert_array_equal(new_table[-1], old_table[-1])  # padding row moved last
+    np.testing.assert_allclose(new_table[NUM_ITEMS], old_table[:NUM_ITEMS].mean(0), rtol=1e-6)
+    assert schema["item_id"].cardinality == NUM_ITEMS + 3
+    assert schema["item_id"].padding_value == NUM_ITEMS + 3
+
+    appended = append_item_embeddings(grown, schema, np.ones((2, 8)))
+    table2 = appended["body"]["embedder"]["embedding_item_id"]["table"]["embedding"]
+    assert table2.shape == (NUM_ITEMS + 6, 8)
+    np.testing.assert_array_equal(table2[NUM_ITEMS + 3], np.ones(8))
+
+    replaced = set_item_embeddings(appended, schema, np.full((4, 8), 2.0))
+    table3 = replaced["body"]["embedder"]["embedding_item_id"]["table"]["embedding"]
+    assert table3.shape == (5, 8)
+    assert schema["item_id"].cardinality == 4
+
+
+def test_trainer_resize_then_train():
+    """Growth mid-lifecycle: the resized state trains and scores the new items."""
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+    rng = np.random.default_rng(0)
+    state = trainer.init_state(make_batch(NUM_ITEMS, rng))
+    for _ in range(3):
+        state, _ = trainer.train_step(state, make_batch(NUM_ITEMS, rng))
+
+    new_items = NUM_ITEMS + 4
+    state = trainer.resize_vocabulary(state, new_items)
+    # trains on batches that contain the NEW item ids
+    for _ in range(3):
+        state, loss_value = trainer.train_step(state, make_batch(new_items, rng))
+    assert np.isfinite(float(loss_value))
+    logits = trainer.predict_logits(
+        state,
+        {"feature_tensors": {"item_id": np.zeros((2, SEQ_LEN), np.int32)},
+         "padding_mask": np.ones((2, SEQ_LEN), bool)},
+    )
+    assert logits.shape == (2, new_items)
