@@ -225,6 +225,7 @@ class Trainer:
         self._put_batch = _batch_sharding(self.mesh)
         self._train_step = None
         self._eval_logits = None
+        self._query_embeddings_fn = None
         self._forward_params = _signature_names(type(self.model).__call__)
         self._inference_params = (
             _signature_names(type(self.model).forward_inference)
@@ -492,14 +493,18 @@ class Trainer:
         """Last-position query embeddings [N, E] (the reference
         QueryEmbeddingsPredictionCallback), e.g. for two-stage features."""
         model = self.model
-        fn = jax.jit(
-            lambda params, feature_tensors, padding_mask: model.apply(
-                {"params": params},
-                feature_tensors,
-                padding_mask,
-                method=type(model).get_query_embeddings,
-            )
-        )
+        if self._query_embeddings_fn is None:
+
+            def embed(params, feature_tensors, padding_mask):
+                return model.apply(
+                    {"params": params},
+                    feature_tensors,
+                    padding_mask,
+                    method=type(model).get_query_embeddings,
+                )
+
+            self._query_embeddings_fn = jax.jit(embed)
+        fn = self._query_embeddings_fn
         chunks, queries = [], []
         for batch in batches:
             batch = self._put_batch(batch)
@@ -527,6 +532,7 @@ class Trainer:
         params = jax.tree.map(jax.device_put, params, shardings)
         self._train_step = None  # shapes changed: retrace
         self._eval_logits = None
+        self._query_embeddings_fn = None
         return TrainState(
             step=state.step, params=params, opt_state=self._tx.init(params), rng=state.rng
         )

@@ -26,7 +26,8 @@ from replay_tpu.data.nn.schema import TensorSchema
 
 def _find_table_path(params, feature_name: str):
     """Locate the '<...>/embedding_<feature>/table/embedding' leaf path."""
-    marker = f"embedding_{feature_name}"
+    # exact path segment: 'embedding_item' must NOT match 'embedding_item_category'
+    marker = f"['embedding_{feature_name}']"
     matches = []
 
     def visit(path, leaf):
@@ -67,15 +68,28 @@ def resize_item_embeddings(
         msg = "Schema has no ITEM_ID feature."
         raise ValueError(msg)
     old_cardinality = schema[feature_name].cardinality
+    resized = 0
     for path, table in _find_table_path(params, feature_name):
         table = np.asarray(table)
         rows, dim = table.shape
         if rows != old_cardinality + 1:
-            continue  # another feature's table that shares the name marker
+            msg = (
+                f"Item table at {jax.tree_util.keystr(path)} has {rows} rows; the "
+                f"schema says {old_cardinality}+1 — params and schema are out of "
+                "sync (was resize applied twice to the same state?)."
+            )
+            raise ValueError(msg)
+        resized += 1
         items, padding_row = table[:old_cardinality], table[old_cardinality:]
         if init_tensor is not None and len(init_tensor) == new_cardinality:
             new_items = np.asarray(init_tensor, table.dtype)
         elif new_cardinality <= old_cardinality:
+            if init_tensor is not None:
+                msg = (
+                    f"init_tensor has {len(init_tensor)} rows; a shrink to "
+                    f"{new_cardinality} items accepts only a full [new_cardinality, E] table."
+                )
+                raise ValueError(msg)
             new_items = items[:new_cardinality]
         else:
             extra = (
