@@ -164,3 +164,27 @@ def test_scheduler_factories():
         LRSchedulerFactory(kind="nope").create(1e-3)
     with pytest.raises(ValueError):
         OptimizerFactory(name="nope").create()
+
+
+@pytest.mark.jax
+def test_bfloat16_training_smoke(schema, pipelines):
+    """The bench configuration (bf16 compute dtype) trains to finite losses."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(17)
+    model = SasRec(schema=schema, embedding_dim=16, num_blocks=1,
+                   max_sequence_length=SEQ_LEN, dtype=jnp.bfloat16)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(learning_rate=1e-2))
+    state, losses = None, []
+    for _ in range(6):
+        batch = pipelines["train"](make_raw_batch(rng))
+        if state is None:
+            state = trainer.init_state(batch)
+        state, loss_value = trainer.train_step(state, batch)
+        losses.append(float(loss_value))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # parameters stay float32 (mixed precision: bf16 compute, f32 params)
+    import jax
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(state.params))
