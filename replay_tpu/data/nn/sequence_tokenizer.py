@@ -68,6 +68,14 @@ class SequenceTokenizer:
     def query_and_item_id_encoder(self):
         return self._encoder.query_and_item_id_encoder
 
+    def encode(self, dataset: Dataset) -> Dataset:
+        """Id-encode a Dataset with the fitted rules WITHOUT sequencing it —
+        e.g. to materialize encoded item features for TwoTower's FeaturesReader."""
+        if not self._fitted:
+            msg = "SequenceTokenizer is not fitted; call fit() first."
+            raise RuntimeError(msg)
+        return self._encoder.transform(dataset)
+
     # -- fit ---------------------------------------------------------------- #
     def fit(self, dataset: Dataset) -> "SequenceTokenizer":
         self._check_schema_against(dataset)

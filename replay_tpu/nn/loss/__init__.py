@@ -3,7 +3,7 @@ from .bce import BCE, BCESampled
 from .ce import CE, CESampled, CESampledWeighted, CEWeighted
 from .login_ce import LogInCE, LogInCESampled
 from .logout_ce import LogOutCE, LogOutCEWeighted
-from .sce import ScalableCrossEntropyLoss, SCEParams
+from .sce import SCE, ScalableCrossEntropyLoss, SCEParams
 
 __all__ = [
     "BCE",
@@ -17,6 +17,7 @@ __all__ = [
     "LogOutCE",
     "LogOutCEWeighted",
     "LossBase",
+    "SCE",
     "SCEParams",
     "ScalableCrossEntropyLoss",
     "broadcast_negatives",

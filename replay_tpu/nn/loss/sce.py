@@ -83,3 +83,53 @@ class ScalableCrossEntropyLoss:
         per_token = jnp.zeros(x.shape[0], dtype=x.dtype).at[top_x.reshape(-1)].max(nll.reshape(-1))
         counted = (per_token != 0) & loss_tokens
         return jnp.sum(per_token * counted) / jnp.maximum(jnp.sum(counted), 1.0)
+
+
+class SCE:
+    """Trainer-protocol adapter around :class:`ScalableCrossEntropyLoss`.
+
+    SCE consumes the RAW item-embedding table (not logits) and a PRNG key, so
+    the Trainer binds two extra hooks when it sees the flags below:
+    ``item_embeddings_callback`` (the model's ``get_item_weights``) and ``rng``
+    (a per-step key). Everything else follows the shared loss signature.
+    """
+
+    needs_item_embeddings = True
+    needs_rng = True
+
+    def __init__(self, sce_params: SCEParams) -> None:
+        self.inner = ScalableCrossEntropyLoss(sce_params)
+        self.item_embeddings_callback = None
+        self.logits_callback = None  # unused; kept for protocol symmetry
+        self.rng = None
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ):
+        if self.item_embeddings_callback is None or self.rng is None:
+            msg = "SCE requires the trainer to bind item_embeddings_callback and rng."
+            raise AttributeError(msg)
+        if positive_labels.ndim == 3 and positive_labels.shape[-1] != 1:
+            # dropped positives would be mined as hard negatives — reject loudly
+            msg = "Multi-positive labels are not supported by the SCE loss"
+            raise NotImplementedError(msg)
+        labels = positive_labels[..., 0] if positive_labels.ndim == 3 else positive_labels
+        tokens_mask = (
+            target_padding_mask[..., 0]
+            if target_padding_mask.ndim == 3
+            else target_padding_mask
+        )
+        return self.inner(
+            model_embeddings,
+            labels,
+            self.item_embeddings_callback(),
+            padding_mask,
+            self.rng,
+            tokens_mask=tokens_mask,
+        )

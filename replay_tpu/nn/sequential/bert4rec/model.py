@@ -193,6 +193,10 @@ class Bert4Rec(nn.Module):
         )
         return self.get_logits(hidden[:, -1, :], candidates_to_score)
 
+    def get_item_weights(self) -> jnp.ndarray:
+        """Item-embedding table [num_items, E] (the SCE loss's negatives pool)."""
+        return self.body.embedder.get_item_weights()
+
     def get_query_embeddings(
         self, feature_tensors: TensorMap, padding_mask: jnp.ndarray
     ) -> jnp.ndarray:

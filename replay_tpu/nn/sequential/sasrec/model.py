@@ -161,3 +161,7 @@ class SasRec(nn.Module):
     ) -> jnp.ndarray:
         """Last-position hidden state per query [B, E]."""
         return self.body(feature_tensors, padding_mask, deterministic=True)[:, -1, :]
+
+    def get_item_weights(self) -> jnp.ndarray:
+        """Item-embedding table [num_items, E] (the SCE loss's negatives pool)."""
+        return self.body.embedder.get_item_weights()

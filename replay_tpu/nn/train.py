@@ -278,11 +278,19 @@ class Trainer:
     # -- train ------------------------------------------------------------- #
     def _build_train_step(self):
         model, loss, tx = self.model, self.loss, self._tx
+        if getattr(loss, "needs_item_embeddings", False) and not hasattr(
+            type(model), "get_item_weights"
+        ):
+            msg = (
+                f"{type(loss).__name__} needs the raw item table but "
+                f"{type(model).__name__} defines no get_item_weights() method."
+            )
+            raise ValueError(msg)
         label_f, tmask_f, neg_f = self.label_field, self.target_mask_field, self.negative_field
         pad_f = self.padding_mask_field
 
         def train_step(state: TrainState, batch: Batch):
-            rng, dropout_rng = jax.random.split(state.rng)
+            rng, dropout_rng, loss_rng = jax.random.split(state.rng, 3)
             # batch-padding rows (fixed-shape final batch) get zero loss weight:
             # gate the target mask by the `valid` row flags from the batcher
             target_mask = batch[tmask_f]
@@ -304,6 +312,13 @@ class Trainer:
                 loss.logits_callback = partial(
                     model.apply, {"params": params}, method=type(model).get_logits, **logits_extra
                 )
+                if getattr(loss, "needs_item_embeddings", False):
+                    # SCE-style losses mine hard negatives from the raw item table
+                    loss.item_embeddings_callback = partial(
+                        model.apply, {"params": params}, method=type(model).get_item_weights
+                    )
+                if getattr(loss, "needs_rng", False):
+                    loss.rng = loss_rng
                 return loss(
                     hidden,
                     batch.get("feature_tensors", {}),
