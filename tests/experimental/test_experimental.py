@@ -167,3 +167,48 @@ def test_tisasrec_uses_time_intervals():
     logits = model.apply({"params": params}, {"item_id": items, "timestamp": ts1}, mask,
                          method=TiSasRec.forward_inference)
     assert logits.shape == (B, NUM_ITEMS)
+
+def test_tisasrec_trains_through_trainer():
+    import jax
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.nn import OptimizerFactory, Trainer
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec.ti_model import TiSasRec
+
+    NUM_ITEMS, L, B = 10, 6, 8
+    schema = TensorSchema(
+        [
+            TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                              feature_hint=FeatureHint.ITEM_ID, cardinality=NUM_ITEMS,
+                              embedding_dim=16),
+            TensorFeatureInfo("timestamp", FeatureType.NUMERICAL, is_seq=True,
+                              tensor_dim=1, embedding_dim=16),
+        ]
+    )
+    model = TiSasRec(schema=schema, embedding_dim=16, num_blocks=1,
+                     max_sequence_length=L, time_span=16)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=2e-2))
+    rng = np.random.default_rng(0)
+
+    def batch():
+        items = ((rng.integers(0, NUM_ITEMS, (B, 1)) + np.arange(L + 1)) % NUM_ITEMS).astype(np.int32)
+        ts = np.cumsum(rng.integers(1, 9, (B, L)), axis=1).astype(np.float32)
+        mask = np.ones((B, L), bool)
+        return {
+            "feature_tensors": {"item_id": items[:, :-1], "timestamp": ts},
+            "padding_mask": mask,
+            "positive_labels": items[:, 1:, None],
+            "target_padding_mask": mask[:, :, None],
+        }
+
+    state, losses = None, []
+    for _ in range(25):
+        b = batch()
+        if state is None:
+            state = trainer.init_state(b)
+        state, loss_value = trainer.train_step(state, b)
+        losses.append(float(loss_value))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7
+    logits = trainer.predict_logits(state, {k: batch()[k] for k in
+                                            ("feature_tensors", "padding_mask")})
+    assert logits.shape == (B, NUM_ITEMS)
