@@ -115,6 +115,15 @@ def main() -> None:
         state, loss_value = trainer.train_step(state, batch)
     jax.block_until_ready(loss_value)
 
+    # per-step FLOPs from XLA's own cost model of the compiled train step
+    step_flops = None
+    try:
+        analysis = trainer._train_step.lower(state, trainer._put_batch(batch)).compile().cost_analysis()
+        if analysis and "flops" in analysis:
+            step_flops = float(analysis["flops"])
+    except Exception:  # cost analysis is best-effort across backends
+        pass
+
     steps = 30
     start = time.perf_counter()
     for _ in range(steps):
@@ -123,17 +132,17 @@ def main() -> None:
     elapsed = time.perf_counter() - start
 
     samples_per_sec = steps * BATCH / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "sasrec_train_samples_per_sec",
-                "value": round(samples_per_sec, 1),
-                "unit": "samples/sec",
-                "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
-                "backend": jax.default_backend(),
-            }
-        )
-    )
+    record = {
+        "metric": "sasrec_train_samples_per_sec",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+        "backend": jax.default_backend(),
+        "step_ms": round(elapsed / steps * 1000, 2),
+    }
+    if step_flops:
+        record["tflops_per_sec"] = round(step_flops * steps / elapsed / 1e12, 3)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
