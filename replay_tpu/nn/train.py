@@ -349,7 +349,9 @@ class Trainer:
         train_batches: Iterable[Batch] | Callable[[], Iterable[Batch]],
         epochs: int = 1,
         state: Optional[TrainState] = None,
-        val_batches: Optional[Callable[[], Iterable[Batch]]] = None,
+        val_batches: Optional[
+            Callable[[], Iterable[Batch]] | Dict[str, Callable[[], Iterable[Batch]]]
+        ] = None,
         metrics: Sequence[str] = ("ndcg", "recall", "map"),
         top_k: Sequence[int] = (1, 5, 10),
         item_count: Optional[int] = None,
@@ -358,7 +360,9 @@ class Trainer:
         checkpoint_manager=None,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
-        ``val_batches`` is given, appending to :attr:`history`.
+        ``val_batches`` is given, appending to :attr:`history`. A dict of
+        factories runs several validation streams sequentially (the reference's
+        CombinedLoader), prefixing each stream's metric keys with its name.
 
         ``train_batches`` may be a re-iterable (e.g. a SequenceBatcher — its
         ``set_epoch`` is called so shuffling advances per epoch), a zero- or
@@ -400,16 +404,22 @@ class Trainer:
                 "train_loss": float(epoch_loss) / n_steps if n_steps else 0.0,
             }
             if val_batches is not None:
-                record.update(
-                    self.validate(
+                # several validation streams (the reference's sequential
+                # CombinedLoader): a dict of factories gets per-stream prefixes
+                streams = (
+                    val_batches if isinstance(val_batches, dict) else {"": val_batches}
+                )
+                for stream_name, factory in streams.items():
+                    stream_metrics = self.validate(
                         state,
-                        val_batches(),
+                        factory(),
                         metrics=metrics,
                         top_k=top_k,
                         item_count=item_count,
                         postprocessors=postprocessors,
                     )
-                )
+                    prefix = f"{stream_name}/" if stream_name else ""
+                    record.update({f"{prefix}{k}": v for k, v in stream_metrics.items()})
             self.history.append(record)
             logger.info("epoch %d: %s", epoch, record)
             if checkpoint_manager is not None and state is not None:

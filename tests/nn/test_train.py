@@ -219,3 +219,28 @@ def test_sce_loss_through_trainer(schema, pipelines):
         state, {"feature_tensors": {"item_id": raw["item_id"]},
                 "padding_mask": raw["item_id_mask"]})
     assert logits.shape == (BATCH, NUM_ITEMS)
+
+
+@pytest.mark.jax
+def test_fit_multiple_validation_streams(schema, pipelines):
+    """A dict of validation factories yields per-stream prefixed metrics
+    (the reference's sequential CombinedLoader over several val paths)."""
+    rng = np.random.default_rng(31)
+    model = SasRec(schema=schema, embedding_dim=16, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+
+    def make_val():
+        raw = make_raw_batch(rng)
+        batch = pipelines["validate"](dict(raw))
+        last = raw["item_id"][np.arange(BATCH), -1]
+        batch["ground_truth"] = ((last + 1) % NUM_ITEMS)[:, None].astype(np.int32)
+        return [batch]
+
+    state = trainer.fit(
+        lambda e: [pipelines["train"](make_raw_batch(rng))],
+        epochs=1,
+        val_batches={"val_a": make_val, "val_b": make_val},
+        metrics=("recall",), top_k=(5,),
+    )
+    record = trainer.history[-1]
+    assert "val_a/recall@5" in record and "val_b/recall@5" in record
