@@ -226,6 +226,7 @@ class Trainer:
         self._train_step = None
         self._eval_logits = None
         self._query_embeddings_fn = None
+        self._catalog_fn = None
         self._forward_params = _signature_names(type(self.model).__call__)
         self._inference_params = (
             _signature_names(type(self.model).forward_inference)
@@ -452,6 +453,44 @@ class Trainer:
             self._eval_logits = self._build_eval_logits()
         return self._eval_logits(state.params, self._put_batch(batch), candidates)
 
+    # -- eval-time catalog cache (TwoTower-style item towers) --------------- #
+    def _precompute_catalog(self, state: TrainState, batch: Batch):
+        """Encode the whole catalog ONCE per evaluation pass when the model has
+        an item tower (the reference ItemTower's eval cache, invalidated by
+        training simply because each validate/predict call recomputes it)."""
+        model = self.model
+        if not hasattr(type(model), "encode_items"):
+            return None
+        if self._catalog_fn is None:
+            self._catalog_fn = jax.jit(
+                lambda params, features: model.apply(
+                    {"params": params},
+                    item_feature_tensors=features,
+                    method=type(model).encode_items,
+                )
+            )
+        return self._catalog_fn(state.params, batch.get("item_feature_tensors"))
+
+    def _catalog_logits(self, state: TrainState, batch: Batch, catalog) -> jnp.ndarray:
+        """Score query embeddings against precomputed catalog embeddings."""
+        model = self.model
+        if self._query_embeddings_fn is None:
+
+            def embed(params, feature_tensors, padding_mask):
+                return model.apply(
+                    {"params": params},
+                    feature_tensors,
+                    padding_mask,
+                    method=type(model).get_query_embeddings,
+                )
+
+            self._query_embeddings_fn = jax.jit(embed)
+        batch = self._put_batch(batch)
+        queries = self._query_embeddings_fn(
+            state.params, batch[self.feature_field], batch[self.padding_mask_field]
+        )
+        return queries @ catalog.T
+
     def validate(
         self,
         state: TrainState,
@@ -463,10 +502,21 @@ class Trainer:
     ) -> Mapping[str, float]:
         """Top-k metrics over validation batches (ground_truth/train padded with
         −1, per MetricsBuilder's contract)."""
+        import itertools
+
         builder = MetricsBuilder(metrics=metrics, top_k=top_k, item_count=item_count)
         max_k = builder.max_k
-        for batch in batches:
-            logits = self.predict_logits(state, batch)
+        iterator = iter(batches)
+        try:
+            first = next(iterator)
+        except StopIteration:
+            return builder.get_metrics()
+        catalog = self._precompute_catalog(state, first)
+        for batch in itertools.chain([first], iterator):
+            if catalog is not None:
+                logits = self._catalog_logits(state, batch, catalog)
+            else:
+                logits = self.predict_logits(state, batch)
             for post in postprocessors:
                 logits = post(logits, batch)
             _, top_ids = jax.lax.top_k(logits, max_k)
@@ -491,9 +541,25 @@ class Trainer:
         postprocess → top-k → accumulate; candidate ids are mapped back to
         catalog ids when ``candidates`` is given.
         """
+        import itertools
+
         all_queries, all_items, all_scores = [], [], []
+        iterator = iter(batches)
+        try:
+            first = next(iterator)
+        except StopIteration:
+            iterator, first = iter(()), None
+        catalog = (
+            self._precompute_catalog(state, first)
+            if candidates is None and first is not None
+            else None
+        )
+        batches = itertools.chain([first], iterator) if first is not None else iterator
         for batch in batches:
-            logits = self.predict_logits(state, batch, candidates)
+            if catalog is not None:
+                logits = self._catalog_logits(state, batch, catalog)
+            else:
+                logits = self.predict_logits(state, batch, candidates)
             if candidates is not None:
                 # visible to postprocessors (SeenItemsFilter's candidate matching)
                 batch = {**batch, "candidates_to_score": jnp.asarray(candidates)}
@@ -558,6 +624,7 @@ class Trainer:
         self._train_step = None  # shapes changed: retrace
         self._eval_logits = None
         self._query_embeddings_fn = None
+        self._catalog_fn = None
         return TrainState(
             step=state.step, params=params, opt_state=self._tx.init(params), rng=state.rng
         )

@@ -158,3 +158,35 @@ def test_features_reader():
         FeaturesReader(item_schema, num_items=2).read(
             pd.DataFrame({"item_id": [0, 5], "category": [1, 2]})
         )
+
+
+@pytest.mark.jax
+def test_predict_uses_cached_catalog(trained, item_feature_tensors):
+    """predict_top_k's cached-catalog path returns the same ranking as
+    per-batch forward_inference, and encodes the catalog only once."""
+    trainer, state, _, raws = trained
+    raw = raws[0]
+    batch = {
+        "feature_tensors": {"item_id": raw["item_id"]},
+        "padding_mask": raw["item_id_mask"],
+        "item_feature_tensors": item_feature_tensors,
+        "query_id": np.arange(BATCH),
+    }
+    _, items_cached, scores_cached = trainer.predict_top_k(state, [dict(batch)], k=4)
+    per_batch = np.asarray(trainer.predict_logits(state, dict(batch)))
+    order = np.argsort(-per_batch, axis=1)[:, :4]
+    np.testing.assert_array_equal(items_cached, order)
+    np.testing.assert_allclose(
+        scores_cached, np.take_along_axis(per_batch, order, 1), rtol=1e-4, atol=1e-5
+    )
+    calls = {"n": 0}
+    original = trainer._catalog_fn
+
+    def counting(params, features):
+        calls["n"] += 1
+        return original(params, features)
+
+    trainer._catalog_fn = counting
+    trainer.predict_top_k(state, [dict(batch), dict(batch), dict(batch)], k=4)
+    trainer._catalog_fn = original
+    assert calls["n"] == 1  # one catalog encode for three batches
