@@ -359,11 +359,20 @@ class Trainer:
         postprocessors: Sequence[Callable] = (),
         log_every: int = 100,
         checkpoint_manager=None,
+        monitor: Optional[str] = None,
+        patience: Optional[int] = None,
+        mode: str = "max",
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`. A dict of
         factories runs several validation streams sequentially (the reference's
         CombinedLoader), prefixing each stream's metric keys with its name.
+
+        ``monitor`` (a history key, e.g. ``"ndcg@10"`` or ``"train_loss"``)
+        enables best-state tracking: fit returns the BEST state seen, marks the
+        winning checkpoint's metadata, and — with ``patience`` — stops early
+        after that many epochs without improvement (Lightning's
+        ModelCheckpoint(monitor=...) + EarlyStopping semantics).
 
         ``train_batches`` may be a re-iterable (e.g. a SequenceBatcher — its
         ``set_epoch`` is called so shuffling advances per epoch), a zero- or
@@ -389,6 +398,10 @@ class Trainer:
                 train_batches.set_epoch(epoch)
             return train_batches
 
+        if mode not in ("max", "min"):
+            msg = "mode must be 'max' or 'min'"
+            raise ValueError(msg)
+        best_value, best_state, stale_epochs = None, None, 0
         for epoch in range(epochs):
             epoch_loss, n_steps = None, 0
             for batch in batches_for(epoch):
@@ -423,12 +436,43 @@ class Trainer:
                     record.update({f"{prefix}{k}": v for k, v in stream_metrics.items()})
             self.history.append(record)
             logger.info("epoch %d: %s", epoch, record)
+
+            improved = False
+            if monitor is not None:
+                if monitor not in record:
+                    msg = f"monitor '{monitor}' not in the epoch record {sorted(record)}"
+                    raise KeyError(msg)
+                value = record[monitor]
+                improved = (
+                    best_value is None
+                    or (mode == "max" and value > best_value)
+                    or (mode == "min" and value < best_value)
+                )
+                if improved:
+                    # deep-copy: the NEXT train_step donates this state's buffers
+                    # (donate_argnums=0), which would leave a dead pytree here
+                    best_state = jax.tree.map(lambda x: x.copy(), state)
+                    best_value, stale_epochs = value, 0
+                else:
+                    stale_epochs += 1
             if checkpoint_manager is not None and state is not None:
-                checkpoint_manager.save(int(state.step), state, history=self.history)
+                checkpoint_manager.save(
+                    int(state.step),
+                    state,
+                    history=self.history,
+                    metadata={"best": improved, monitor: value} if monitor else None,
+                )
+                if improved:
+                    checkpoint_manager.mark_best(int(state.step))
+            if monitor is not None and patience is not None and stale_epochs >= patience:
+                logger.info(
+                    "early stop: no %s improvement for %d epochs", monitor, patience
+                )
+                break
         if state is None:
             msg = "fit() received no batches"
             raise ValueError(msg)
-        return state
+        return best_state if best_state is not None else state
 
     # -- eval / predict ---------------------------------------------------- #
     def _build_eval_logits(self):
@@ -471,8 +515,7 @@ class Trainer:
             )
         return self._catalog_fn(state.params, batch.get("item_feature_tensors"))
 
-    def _catalog_logits(self, state: TrainState, batch: Batch, catalog) -> jnp.ndarray:
-        """Score query embeddings against precomputed catalog embeddings."""
+    def _get_query_embeddings_fn(self):
         model = self.model
         if self._query_embeddings_fn is None:
 
@@ -485,8 +528,12 @@ class Trainer:
                 )
 
             self._query_embeddings_fn = jax.jit(embed)
+        return self._query_embeddings_fn
+
+    def _catalog_logits(self, state: TrainState, batch: Batch, catalog) -> jnp.ndarray:
+        """Score query embeddings against precomputed catalog embeddings."""
         batch = self._put_batch(batch)
-        queries = self._query_embeddings_fn(
+        queries = self._get_query_embeddings_fn()(
             state.params, batch[self.feature_field], batch[self.padding_mask_field]
         )
         return queries @ catalog.T
@@ -583,19 +630,7 @@ class Trainer:
     def predict_query_embeddings(self, state: TrainState, batches: Iterable[Batch]):
         """Last-position query embeddings [N, E] (the reference
         QueryEmbeddingsPredictionCallback), e.g. for two-stage features."""
-        model = self.model
-        if self._query_embeddings_fn is None:
-
-            def embed(params, feature_tensors, padding_mask):
-                return model.apply(
-                    {"params": params},
-                    feature_tensors,
-                    padding_mask,
-                    method=type(model).get_query_embeddings,
-                )
-
-            self._query_embeddings_fn = jax.jit(embed)
-        fn = self._query_embeddings_fn
+        fn = self._get_query_embeddings_fn()
         chunks, queries = [], []
         for batch in batches:
             batch = self._put_batch(batch)

@@ -101,9 +101,29 @@ class CheckpointManager:
         save_pytree(str(self._step_path(step)), state, {"step": step, **(metadata or {})})
         if history is not None:
             (self.directory / "history.json").write_text(json.dumps(history))
+        protected = self.best_step()
         for old in self.all_steps()[: -self.max_to_keep]:
+            if old == protected:  # the monitored winner survives rotation
+                continue
             self._step_path(old).with_suffix(".npz").unlink(missing_ok=True)
             self._step_path(old).with_suffix(".json").unlink(missing_ok=True)
+
+    # -- monitored-best tracking ------------------------------------------- #
+    def mark_best(self, step: int) -> None:
+        """Record ``step`` as the monitored winner (survives rotation)."""
+        (self.directory / "best.json").write_text(json.dumps({"step": step}))
+
+    def best_step(self) -> Optional[int]:
+        path = self.directory / "best.json"
+        if not path.exists():
+            return None
+        step = json.loads(path.read_text())["step"]
+        return step if self._step_path(step).with_suffix(".npz").exists() else None
+
+    def restore_best(self, template: Any) -> Any:
+        """Restore the monitored-best checkpoint (falls back to the latest)."""
+        step = self.best_step()
+        return self.restore(template, step=step)
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
         step = step if step is not None else self.latest_step()

@@ -244,3 +244,32 @@ def test_fit_multiple_validation_streams(schema, pipelines):
     )
     record = trainer.history[-1]
     assert "val_a/recall@5" in record and "val_b/recall@5" in record
+
+
+@pytest.mark.jax
+def test_monitor_early_stopping_and_best_state(schema, pipelines):
+    """fit(monitor=..., patience=...) returns the BEST state and stops early."""
+    rng = np.random.default_rng(41)
+    model = SasRec(schema=schema, embedding_dim=16, num_blocks=1, max_sequence_length=SEQ_LEN)
+    # a big lr makes late epochs noisy, so train_loss (mode=min) has a real best
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+    batches = [pipelines["train"](make_raw_batch(rng)) for _ in range(3)]
+    state = trainer.fit(lambda e: batches, epochs=12, monitor="train_loss",
+                        mode="min", patience=3)
+    losses = [h["train_loss"] for h in trainer.history]
+    best_epoch = int(np.argmin(losses))
+    # stopped no later than best + patience
+    assert len(losses) <= best_epoch + 1 + 3
+    # the RETURNED state is the best epoch's snapshot: right step, live buffers
+    assert int(state.step) == (best_epoch + 1) * 3
+    assert np.isfinite(np.asarray(jax.tree.leaves(state.params)[0])).all()
+    logits = trainer.predict_logits(
+        state,
+        {"feature_tensors": {"item_id": np.zeros((BATCH, SEQ_LEN), np.int32)},
+         "padding_mask": np.ones((BATCH, SEQ_LEN), bool)},
+    )
+    assert logits.shape == (BATCH, NUM_ITEMS)
+    with pytest.raises(KeyError, match="monitor"):
+        trainer.fit(lambda e: batches, epochs=1, monitor="ndcg@10")
+    with pytest.raises(ValueError, match="mode"):
+        trainer.fit(lambda e: batches, epochs=1, monitor="train_loss", mode="sideways")

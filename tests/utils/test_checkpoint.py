@@ -120,3 +120,16 @@ def test_fit_saves_checkpoints(tmp_path):
     state = trainer.fit(lambda epoch: batches, epochs=2, checkpoint_manager=manager)
     assert manager.latest_step() == int(state.step)
     assert len(manager.history()) == 2
+
+@pytest.mark.jax
+def test_best_checkpoint_survives_rotation(tmp_path):
+    manager = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+    tree = {"w": jnp.ones(2)}
+    manager.save(1, tree)
+    manager.mark_best(1)
+    for step in (2, 3, 4, 5):
+        manager.save(step, {"w": jnp.ones(2) * step})
+    assert 1 in manager.all_steps()  # the best survives max_to_keep=2
+    assert manager.best_step() == 1
+    best = manager.restore_best({"w": np.zeros(2)})
+    np.testing.assert_array_equal(best["w"], np.ones(2))
