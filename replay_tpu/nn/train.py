@@ -158,18 +158,71 @@ def make_mesh(
     return Mesh(grid, ("data", "model"))
 
 
-def _batch_sharding(mesh: Mesh) -> Callable[[Any], Any]:
-    """device_put a batch pytree with the leading axis sharded over ``data``."""
-    def put(batch):
-        def leaf_sharding(x):
-            x = jnp.asarray(x) if not isinstance(x, (jnp.ndarray, np.ndarray)) else x
-            if getattr(x, "ndim", 0) >= 1 and x.shape[0] % mesh.shape["data"] == 0:
-                return NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
-            return NamedSharding(mesh, P())  # e.g. shared [N] negative ids
+def _batch_sharding(mesh: Mesh, batch_dim_field: str = "padding_mask") -> Callable[[Any], Any]:
+    """Place a batch pytree with the leading axis sharded over ``data``.
 
-        return jax.tree.map(lambda x: jax.device_put(x, leaf_sharding(np.asarray(x))), batch)
+    Which leaves are data-parallel is decided by the batch dimension itself: a
+    leaf whose leading axis equals ``batch[batch_dim_field]``'s is a per-row
+    tensor and shards over ``data``; anything else (e.g. a shared ``[N]``
+    negative-id pool) is replicated. Multi-host, sharded leaves are assembled
+    with ``jax.make_array_from_process_local_data`` — each process contributes
+    ITS disjoint slice (the Partitioning seam's contract) and the global batch
+    is local × process_count; replicated leaves must be identical on every host.
+    """
+    multiprocess = jax.process_count() > 1
+    scale = jax.process_count() if multiprocess else 1
+
+    def put(batch):
+        reference = batch.get(batch_dim_field)
+        local_batch = np.asarray(reference).shape[0] if reference is not None else None
+
+        def place(x):
+            x = np.asarray(x)
+            is_batch_leaf = (
+                x.ndim >= 1
+                and local_batch is not None
+                and x.shape[0] == local_batch
+                and (local_batch * scale) % mesh.shape["data"] == 0
+            )
+            if is_batch_leaf:
+                sharding = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+            else:
+                sharding = NamedSharding(mesh, P())
+            if multiprocess:
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(place, batch)
 
     return put
+
+
+def _place_tree(tree: Any, shardings: Any) -> Any:
+    """Place host arrays under their shardings — multi-host aware: with several
+    processes, every leaf becomes a GLOBAL array assembled from identical
+    process-local data (params/state are replicated; all hosts compute the same
+    values from the same seed)."""
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x, s: jax.make_array_from_process_local_data(s, np.asarray(x)),
+            tree,
+            shardings,
+        )
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+def _globalize_scalars(mesh: Mesh, tree: Any) -> Any:
+    """Multi-host: promote process-local leaves (e.g. adam's ``count`` scalar,
+    created by ``tx.init`` outside any mesh) to replicated GLOBAL arrays; leaves
+    that already carry a mesh sharding pass through."""
+    replicated = NamedSharding(mesh, P())
+
+    def globalize(x):
+        if hasattr(x, "sharding") and getattr(x.sharding, "mesh", None) is not None:
+            return x
+        return jax.make_array_from_process_local_data(replicated, np.asarray(x))
+
+    return jax.tree.map(globalize, tree)
 
 
 def _params_shardings(mesh: Mesh, params: Any, shard_vocab: bool) -> Any:
@@ -222,7 +275,7 @@ class Trainer:
         if self.mesh is None:
             self.mesh = make_mesh()
         self._tx = self.optimizer.create()
-        self._put_batch = _batch_sharding(self.mesh)
+        self._put_batch = _batch_sharding(self.mesh, self.padding_mask_field)
         self._train_step = None
         self._eval_logits = None
         self._query_embeddings_fn = None
@@ -264,8 +317,16 @@ class Trainer:
             "params"
         ]
         shardings = _params_shardings(self.mesh, params, self.shard_vocab)
-        params = jax.tree.map(jax.device_put, params, shardings)
+        params = _place_tree(jax.tree.map(np.asarray, params), shardings)
         opt_state = self._tx.init(params)
+        if jax.process_count() > 1:
+            opt_state = _globalize_scalars(self.mesh, opt_state)
+            replicated = NamedSharding(self.mesh, P())
+            step, rng = (
+                jax.make_array_from_process_local_data(replicated, np.asarray(v))
+                for v in (jnp.zeros((), jnp.int32), state_rng)
+            )
+            return TrainState(step=step, params=params, opt_state=opt_state, rng=rng)
         return TrainState(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state, rng=state_rng
         )
@@ -400,6 +461,9 @@ class Trainer:
 
         if mode not in ("max", "min"):
             msg = "mode must be 'max' or 'min'"
+            raise ValueError(msg)
+        if patience is not None and patience < 1:
+            msg = "patience must be >= 1 (it counts consecutive non-improving epochs)"
             raise ValueError(msg)
         best_value, best_state, stale_epochs = None, None, 0
         for epoch in range(epochs):
@@ -655,13 +719,16 @@ class Trainer:
             init_tensor,
         )
         shardings = _params_shardings(self.mesh, params, self.shard_vocab)
-        params = jax.tree.map(jax.device_put, params, shardings)
+        params = _place_tree(params, shardings)
         self._train_step = None  # shapes changed: retrace
         self._eval_logits = None
         self._query_embeddings_fn = None
         self._catalog_fn = None
+        opt_state = self._tx.init(params)
+        if jax.process_count() > 1:
+            opt_state = _globalize_scalars(self.mesh, opt_state)
         return TrainState(
-            step=state.step, params=params, opt_state=self._tx.init(params), rng=state.rng
+            step=state.step, params=params, opt_state=opt_state, rng=state.rng
         )
 
     # -- checkpointing ------------------------------------------------------ #
@@ -679,15 +746,16 @@ class Trainer:
         template = self.init_state(example_batch)
         restored = restore_pytree(path, template)
 
-        def place(target_leaf, value):
+        def template_sharding(target_leaf):
             # inherit the template's MESH sharding (params AND optimizer moments
             # keep their vocab sharding); other leaves replicate over the mesh
             sharding = getattr(target_leaf, "sharding", None)
             if not isinstance(sharding, NamedSharding):
                 sharding = NamedSharding(self.mesh, P())
-            return jax.device_put(jnp.asarray(value), sharding)
+            return sharding
 
-        return jax.tree.map(place, template, restored)
+        shardings = jax.tree.map(template_sharding, template)
+        return _place_tree(restored, shardings)
 
     def predict_dataframe(self, state, batches, k, **kwargs):
         """predict_top_k as a tidy (query_id, item_id, rating) pandas frame —

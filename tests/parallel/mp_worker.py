@@ -1,0 +1,101 @@
+"""Worker for the true multi-process DP test: one host of a 2-host job.
+
+Launched by test_multiprocess.py with a clean CPU env (4 virtual devices per
+process). Joins the distributed job through the framework's own
+initialize_distributed, feeds ITS disjoint slice of a deterministic global
+batch, trains a small SASRec for a few steps over the 8-device global mesh, and
+writes the per-step (replicated, hence locally fetchable) losses to a file.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    coordinator = sys.argv[2]
+    out_path = sys.argv[3]
+
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer jax may configure this via env instead
+
+    from replay_tpu.parallel import initialize_distributed
+
+    layout = initialize_distributed(
+        coordinator_address=coordinator, num_processes=2, process_id=rank
+    )
+    assert layout["num_processes"] == 2, layout
+
+    import jax
+
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+
+    from replay_tpu.nn.sequential.sasrec import SasRec
+
+    num_items, seq_len, global_batch = 16, 6, 8
+    local = global_batch // 2
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+                          embedding_dim=16)
+    )
+    trainer = Trainer(
+        model=SasRec(schema=schema, embedding_dim=16, num_blocks=1,
+                     max_sequence_length=seq_len),
+        loss=CE(),
+        optimizer=OptimizerFactory(name="sgd", learning_rate=0.1),
+        mesh=make_mesh(),  # all 8 GLOBAL devices
+        seed=0,
+    )
+
+    def global_batch_for(step: int) -> dict:
+        rng = np.random.default_rng(step)  # same on every rank
+        items = rng.integers(0, num_items, (global_batch, seq_len + 1)).astype(np.int32)
+        mask = np.ones((global_batch, seq_len), bool)
+        return {
+            "feature_tensors": {"item_id": items[:, :-1]},
+            "padding_mask": mask,
+            "positive_labels": items[:, 1:, None],
+            "target_padding_mask": mask[:, :, None],
+        }
+
+    def local_slice(batch: dict) -> dict:
+        return {
+            k: ({n: v[rank * local : (rank + 1) * local] for n, v in val.items()}
+                if isinstance(val, dict)
+                else val[rank * local : (rank + 1) * local])
+            for k, val in batch.items()
+        }
+
+    state = trainer.init_state(local_slice(global_batch_for(0)))
+    losses = []
+    for step in range(3):
+        state, loss_value = trainer.train_step(state, local_slice(global_batch_for(step)))
+        losses.append(float(loss_value))  # replicated output: locally fetchable
+
+    # adam creates process-local optimizer scalars (count); one step proves the
+    # multi-host globalization of opt_state works
+    adam_trainer = Trainer(
+        model=trainer.model, loss=CE(),
+        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
+        mesh=make_mesh(), seed=0,
+    )
+    adam_state = adam_trainer.init_state(local_slice(global_batch_for(0)))
+    adam_state, adam_loss = adam_trainer.train_step(adam_state, local_slice(global_batch_for(0)))
+    assert np.isfinite(float(adam_loss))
+
+    with open(out_path, "w") as handle:
+        json.dump({"rank": rank, "losses": losses, "adam_loss": float(adam_loss)}, handle)
+
+
+if __name__ == "__main__":
+    main()
