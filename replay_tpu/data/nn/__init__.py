@@ -2,6 +2,7 @@ from .iterator import SequenceBatcher, validation_batches
 from .module import DataModule
 from .parquet import ParquetBatcher, write_sequence_parquet
 from .partitioning import Partitioning, ReplicasInfo
+from .prefetch import prefetch
 from .schema import TensorFeatureInfo, TensorFeatureSource, TensorMap, TensorSchema
 from .sequence_tokenizer import SequenceTokenizer
 from .sequential_dataset import SequentialDataset
@@ -12,6 +13,7 @@ __all__ = [
     "Partitioning",
     "ReplicasInfo",
     "SequenceBatcher",
+    "prefetch",
     "SequenceTokenizer",
     "SequentialDataset",
     "TensorFeatureInfo",

@@ -264,3 +264,45 @@ class TestSequenceBatcher:
         assert batch["train"].shape[0] == 2
         # padding slots are -1
         assert (batch["ground_truth"][batch["ground_truth"] < 0] == -1).all()
+
+
+class TestPrefetch:
+    def test_order_and_completion(self):
+        from replay_tpu.data.nn import prefetch
+
+        items = list(prefetch(iter(range(20)), depth=3))
+        assert items == list(range(20))
+
+    def test_producer_exception_surfaces(self):
+        from replay_tpu.data.nn import prefetch
+
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        it = prefetch(gen(), depth=2)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_bad_depth(self):
+        from replay_tpu.data.nn import prefetch
+
+        with pytest.raises(ValueError):
+            list(prefetch([1], depth=0))
+
+    def test_overlaps_slow_producer(self):
+        import time
+
+        from replay_tpu.data.nn import prefetch
+
+        def slow():
+            for i in range(5):
+                time.sleep(0.02)
+                yield i
+
+        start = time.perf_counter()
+        for _ in prefetch(slow(), depth=4):
+            time.sleep(0.02)  # consumer work overlaps producer work
+        elapsed = time.perf_counter() - start
+        assert elapsed < 0.17  # ~0.1 + eps when overlapped; 0.2 serial
