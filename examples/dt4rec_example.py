@@ -1,0 +1,81 @@
+"""DT4Rec end-to-end — the examples/train_dt4rec.py flow on synthetic data.
+
+Offline RL as return-conditioned sequence modeling: trajectories carry
+returns-to-go; inference conditions on a HIGH target return so the policy
+imitates its best-outcome behavior.
+
+Run: JAX_PLATFORMS=cpu python examples/dt4rec_example.py
+"""
+
+import numpy as np
+
+from replay_tpu.data import FeatureHint, FeatureType
+from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema, prefetch
+from replay_tpu.experimental import DT4Rec
+from replay_tpu.nn import OptimizerFactory, Trainer
+from replay_tpu.nn.loss import CE
+
+NUM_ITEMS, SEQ_LEN, BATCH, STEPS = 50, 10, 64, 120
+
+
+def make_batches(rng: np.random.Generator):
+    """Logged trajectories: 'good' sessions walk the catalog coherently (high
+    return), 'bad' sessions jump randomly (low return)."""
+    for _ in range(STEPS):
+        items = np.zeros((BATCH, SEQ_LEN), np.int32)
+        rtg = np.zeros((BATCH, SEQ_LEN), np.float32)
+        for b in range(BATCH):
+            good = rng.random() < 0.5
+            if good:
+                start = rng.integers(0, NUM_ITEMS)
+                items[b] = (start + np.arange(SEQ_LEN)) % NUM_ITEMS
+            else:
+                items[b] = rng.integers(0, NUM_ITEMS, SEQ_LEN)
+            reward = 1.0 if good else 0.1
+            rtg[b] = reward * (SEQ_LEN - np.arange(SEQ_LEN)) / SEQ_LEN
+        yield {
+            "feature_tensors": {"item_id": items},
+            "padding_mask": np.ones((BATCH, SEQ_LEN), bool),
+            "returns_to_go": rtg,
+            "positive_labels": items[:, :, None],
+            "target_padding_mask": np.ones((BATCH, SEQ_LEN, 1), bool),
+        }
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=NUM_ITEMS,
+                          embedding_dim=64)
+    )
+    model = DT4Rec(schema=schema, embedding_dim=64, num_blocks=2,
+                   max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(learning_rate=1e-3))
+    state, losses = None, []
+    for batch in prefetch(make_batches(rng), depth=2):
+        if state is None:
+            state = trainer.init_state(batch)
+        state, loss_value = trainer.train_step(state, batch)
+        losses.append(float(loss_value))
+    print(f"loss {losses[0]:.3f} -> {np.mean(losses[-10:]):.3f}")
+
+    # condition on a HIGH target return: coherent-walk continuations should rank
+    # the true next item well
+    probe = np.tile((np.arange(SEQ_LEN) % NUM_ITEMS).astype(np.int32), (BATCH, 1))
+    logits = trainer.predict_logits(
+        state,
+        {
+            "feature_tensors": {"item_id": probe},
+            "padding_mask": np.ones((BATCH, SEQ_LEN), bool),
+            "returns_to_go": np.ones((BATCH, SEQ_LEN), np.float32),
+        },
+    )
+    top1 = np.asarray(logits).argmax(axis=1)
+    hit = float((top1 == SEQ_LEN % NUM_ITEMS).mean())
+    print(f"high-return conditioning: top-1 next-item accuracy {hit:.2f}")
+
+
+if __name__ == "__main__":
+    main()

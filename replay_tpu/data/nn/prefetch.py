@@ -1,10 +1,13 @@
 """Background-thread batch prefetching.
 
-The reference overlaps input work with compute through torch DataLoader worker
-processes; here one daemon thread stays ahead of the training loop by
-``depth`` batches (host numpy work only — device_put still happens on the
-consumer thread, keeping JAX single-threaded per process). On TPU this hides
-the host-side gather/transform time behind the device step.
+Capability parity with the reference's input/compute overlap, which comes from
+torch DataLoader worker processes feeding the parquet pipeline (ref
+replay/data/nn/parquet/parquet_dataset.py:49-52 thread tuning; worker identity
+folded into the replica id at info/replicas.py:17-20). Here one daemon thread
+stays ahead of the training loop by ``depth`` batches (host numpy work only —
+device_put still happens on the consumer thread, keeping JAX single-threaded
+per process). On TPU this hides host-side gather/transform time behind the
+device step.
 """
 
 from __future__ import annotations
@@ -20,29 +23,54 @@ def prefetch(batches: Iterable, depth: int = 2) -> Iterator:
     """Iterate ``batches`` with a ``depth``-deep background producer thread.
 
     Exceptions in the producer are re-raised in the consumer at the point of
-    consumption; the thread is a daemon, so abandoning the iterator never hangs
-    interpreter shutdown.
+    consumption. Abandoning the iterator (``close()``/GeneratorExit — e.g. the
+    training loop raised) signals the producer to stop, so neither the thread
+    nor its buffered batches outlive the consumer.
     """
     if depth < 1:
         msg = "depth must be >= 1"
         raise ValueError(msg)
+    return _prefetch_iter(batches, depth)
+
+
+def _prefetch_iter(batches: Iterable, depth: int) -> Iterator:
     buffer: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def offer(item) -> bool:
+        """put() that gives up when the consumer has gone away."""
+        while not stop.is_set():
+            try:
+                buffer.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer() -> None:
         try:
             for batch in batches:
-                buffer.put(batch)
+                if not offer(batch):
+                    return
         except BaseException as error:  # noqa: BLE001 - relayed to the consumer
-            buffer.put((_SENTINEL, error))
+            offer((_SENTINEL, error))
             return
-        buffer.put((_SENTINEL, None))
+        offer((_SENTINEL, None))
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()
-    while True:
-        item = buffer.get()
-        if isinstance(item, tuple) and len(item) == 2 and item[0] is _SENTINEL:
-            if item[1] is not None:
-                raise item[1]
-            return
-        yield item
+    try:
+        while True:
+            item = buffer.get()
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _SENTINEL:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        stop.set()
+        try:  # unblock a producer waiting on a full queue
+            while True:
+                buffer.get_nowait()
+        except queue.Empty:
+            pass

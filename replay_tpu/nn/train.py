@@ -423,6 +423,7 @@ class Trainer:
         monitor: Optional[str] = None,
         patience: Optional[int] = None,
         mode: str = "max",
+        prefetch: int = 0,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`. A dict of
@@ -468,7 +469,12 @@ class Trainer:
         best_value, best_state, stale_epochs = None, None, 0
         for epoch in range(epochs):
             epoch_loss, n_steps = None, 0
-            for batch in batches_for(epoch):
+            epoch_batches = batches_for(epoch)
+            if prefetch:
+                from replay_tpu.data.nn.prefetch import prefetch as _prefetch
+
+                epoch_batches = _prefetch(iter(epoch_batches), depth=prefetch)
+            for batch in epoch_batches:
                 if state is None:
                     state = self.init_state(batch)
                 state, loss_value = self.train_step(state, batch)
