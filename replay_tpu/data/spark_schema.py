@@ -1,0 +1,43 @@
+"""Typed Spark StructType for interaction logs (input-adapter support).
+
+Capability parity with replay/data/spark_schema.py:7 (get_schema). Spark is an
+INPUT adapter in this framework (README "Design stance"): this helper exists so
+code that hands interaction frames over from a Spark job can build the matching
+schema; it requires pyspark at call time and degrades with a clear error when
+the engine is absent (the availability-flag pattern of utils/types.py).
+"""
+
+from __future__ import annotations
+
+from replay_tpu.utils.types import PYSPARK_AVAILABLE
+
+
+def get_schema(
+    query_column: str = "query_id",
+    item_column: str = "item_id",
+    timestamp_column: str = "timestamp",
+    rating_column: str = "rating",
+):
+    """StructType(query, item, timestamp, rating) for a typed interactions log."""
+    if not PYSPARK_AVAILABLE:  # pragma: no cover - pyspark absent in this image
+        msg = (
+            "get_schema builds a pyspark StructType but pyspark is not installed; "
+            "convert your log to pandas/parquet instead (Spark is an input adapter "
+            "here, not an execution engine)."
+        )
+        raise ImportError(msg)
+    from pyspark.sql.types import (  # pragma: no cover
+        DoubleType,
+        LongType,
+        StructField,
+        StructType,
+    )
+
+    return StructType(  # pragma: no cover
+        [
+            StructField(query_column, LongType(), nullable=False),
+            StructField(item_column, LongType(), nullable=False),
+            StructField(timestamp_column, DoubleType(), nullable=False),
+            StructField(rating_column, DoubleType(), nullable=False),
+        ]
+    )

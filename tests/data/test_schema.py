@@ -100,3 +100,16 @@ def test_copy_resets_cardinality():
     schema = FeatureSchema([FeatureInfo("x", FeatureType.CATEGORICAL, cardinality=7)])
     copied = schema.copy()
     assert copied["x"]._cardinality is None
+
+
+def test_spark_schema_gated():
+    import pytest as _pytest
+
+    from replay_tpu.data.spark_schema import get_schema
+    from replay_tpu.utils.types import PYSPARK_AVAILABLE
+
+    if PYSPARK_AVAILABLE:  # pragma: no cover - pyspark absent in this image
+        assert get_schema() is not None
+    else:
+        with _pytest.raises(ImportError, match="input adapter"):
+            get_schema()
