@@ -1,0 +1,56 @@
+"""Session state, logging configuration, profiling hooks."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from replay_tpu.utils import State, StepTimer, get_default_mesh, setup_logging, trace
+
+
+def test_setup_logging_idempotent():
+    logger = setup_logging("WARNING")
+    assert logger.level == logging.WARNING
+    again = setup_logging("INFO")
+    assert again is logger and again.level == logging.INFO
+    assert len(logger.handlers) == 1  # no handler duplication
+
+
+@pytest.mark.jax
+def test_state_singleton_and_default_mesh():
+    State.reset()
+    a, b = State(), State()
+    assert a is b
+    mesh = get_default_mesh()
+    assert mesh.shape["data"] * mesh.shape["model"] == len(a.devices)
+    a.set_mesh("sentinel")
+    assert State().mesh == "sentinel"
+    State.reset()
+
+
+@pytest.mark.jax
+def test_step_timer():
+    import jax.numpy as jnp
+
+    timer = StepTimer(warmup_steps=2, samples_per_step=8)
+    result = jnp.ones(())
+    for _ in range(6):
+        timer.tick(result)
+    stats = timer.finish(result)
+    assert stats["steps"] == 4
+    assert stats["steps_per_sec"] > 0
+    assert stats["samples_per_sec"] == pytest.approx(stats["steps_per_sec"] * 8)
+    empty = StepTimer(warmup_steps=5)
+    empty.tick()
+    assert np.isnan(empty.finish()["steps_per_sec"])
+
+
+@pytest.mark.jax
+def test_trace_writes_profile(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with trace(str(tmp_path / "prof")):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    produced = list((tmp_path / "prof").rglob("*"))
+    assert produced  # a trace directory with events was written
