@@ -3,8 +3,9 @@
 Capability parity with replay/models/slim.py:20 (ElasticNet regression per item
 with a zeroed diagonal; beta = L2, lambda_ = L1). The reference parallelizes
 per-item sklearn ElasticNet fits through pandas UDFs; here ALL items are solved
-simultaneously with proximal gradient (ISTA) on the dense [I, I] weight matrix —
-two matmuls per step on the MXU instead of I independent CPU solvers.
+simultaneously with ACCELERATED proximal gradient (FISTA momentum) on the dense
+[I, I] weight matrix — two matmuls per step on the MXU instead of I independent
+CPU solvers, converging in far fewer sweeps than plain ISTA.
 """
 
 from __future__ import annotations
@@ -56,14 +57,19 @@ class SLIM(ItemKNN):
         step = 1.0 / max(lipschitz, 1e-9)
 
         @jax.jit
-        def ista_step(weights):
-            grad = gram @ weights - gram + self.beta * weights
-            updated = weights - step * grad
-            # soft-threshold (L1 prox), non-negativity, zero diagonal
-            updated = jnp.maximum(updated - step * self.lambda_, 0.0)
-            return updated * (1.0 - jnp.eye(n_items, dtype=updated.dtype))
+        def fista_step(weights, momentum, t):
+            # accelerated proximal gradient (FISTA): gradient at the momentum
+            # point, then soft-threshold (L1 prox), non-negativity, zero diagonal
+            grad = gram @ momentum - gram + self.beta * momentum
+            updated = jnp.maximum(momentum - step * (grad + self.lambda_), 0.0)
+            # in-trace mask: XLA fuses the iota comparison, no persistent buffer
+            updated = updated * (1.0 - jnp.eye(n_items, dtype=updated.dtype))
+            t_next = (1.0 + jnp.sqrt(1.0 + 4.0 * t * t)) / 2.0
+            momentum_next = updated + ((t - 1.0) / t_next) * (updated - weights)
+            return updated, momentum_next, t_next
 
         weights = jnp.zeros((n_items, n_items), jnp.float32)
+        momentum, t = weights, jnp.ones(())
         for _ in range(self.num_iterations):
-            weights = ista_step(weights)
+            weights, momentum, t = fista_step(weights, momentum, t)
         self.similarity = np.asarray(weights)
