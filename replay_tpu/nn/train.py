@@ -211,6 +211,15 @@ def _place_tree(tree: Any, shardings: Any) -> Any:
     return jax.tree.map(jax.device_put, tree, shardings)
 
 
+def _local_rows(array: jnp.ndarray) -> np.ndarray:
+    """This process's rows of a batch-dim-sharded global array (identity in
+    single-process runs, where every array is fully addressable)."""
+    if jax.process_count() == 1 or getattr(array, "is_fully_addressable", True):
+        return np.asarray(array)
+    shards = sorted(array.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(shard.data) for shard in shards], axis=0)
+
+
 def _globalize_scalars(mesh: Mesh, tree: Any) -> Any:
     """Multi-host: promote process-local leaves (e.g. adam's ``count`` scalar,
     created by ``tx.init`` outside any mesh) to replicated GLOBAL arrays; leaves
@@ -638,8 +647,16 @@ class Trainer:
                 logits = post(logits, batch)
             _, top_ids = jax.lax.top_k(logits, max_k)
             builder.add_prediction(
-                top_ids, batch["ground_truth"], batch.get("train"), batch.get("valid")
+                _local_rows(top_ids), batch["ground_truth"], batch.get("train"),
+                batch.get("valid"),
             )
+        if jax.process_count() > 1:
+            # every host accumulated only ITS shard: sum the (psum-able) states
+            # across hosts — the reference's sync_dist=True reduction
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(builder.state())
+            builder.load_state(jax.tree.map(lambda x: np.asarray(x).sum(axis=0), gathered))
         return builder.get_metrics()
 
     def predict_top_k(

@@ -82,6 +82,19 @@ def main() -> None:
         state, loss_value = trainer.train_step(state, local_slice(global_batch_for(step)))
         losses.append(float(loss_value))  # replicated output: locally fetchable
 
+    # distributed validation: each host feeds its shard, metric states are
+    # all-gathered and summed — both ranks must report identical global metrics
+    val_rng = np.random.default_rng(99)
+    val_items = val_rng.integers(0, num_items, (global_batch, seq_len)).astype(np.int32)
+    val_gt = val_rng.integers(0, num_items, (global_batch, 2)).astype(np.int64)
+    val_batch_local = {
+        "feature_tensors": {"item_id": val_items[rank * local : (rank + 1) * local]},
+        "padding_mask": np.ones((local, seq_len), bool),
+        "ground_truth": val_gt[rank * local : (rank + 1) * local],
+    }
+    metrics = trainer.validate(state, [val_batch_local], metrics=("recall", "ndcg"),
+                               top_k=(3,))
+
     # adam creates process-local optimizer scalars (count); one step proves the
     # multi-host globalization of opt_state works
     adam_trainer = Trainer(
@@ -94,7 +107,11 @@ def main() -> None:
     assert np.isfinite(float(adam_loss))
 
     with open(out_path, "w") as handle:
-        json.dump({"rank": rank, "losses": losses, "adam_loss": float(adam_loss)}, handle)
+        json.dump(
+            {"rank": rank, "losses": losses, "adam_loss": float(adam_loss),
+             "metrics": {k: float(v) for k, v in metrics.items()}},
+            handle,
+        )
 
 
 if __name__ == "__main__":

@@ -90,3 +90,21 @@ def test_two_process_dp_matches_single_process(tmp_path):
         reference_losses.append(float(loss_value))
 
     np.testing.assert_allclose(results[0]["losses"], reference_losses, rtol=1e-5)
+
+    # distributed validation: both hosts report the same GLOBAL metrics, equal
+    # to a single-process validate over the full batch
+    assert results[0]["metrics"] == results[1]["metrics"]
+    val_rng = np.random.default_rng(99)
+    val_items = val_rng.integers(0, num_items, (global_batch, seq_len)).astype(np.int32)
+    val_gt = val_rng.integers(0, num_items, (global_batch, 2)).astype(np.int64)
+    reference_metrics = trainer.validate(
+        state,
+        [{
+            "feature_tensors": {"item_id": val_items},
+            "padding_mask": np.ones((global_batch, seq_len), bool),
+            "ground_truth": val_gt,
+        }],
+        metrics=("recall", "ndcg"), top_k=(3,),
+    )
+    for key, value in reference_metrics.items():
+        assert results[0]["metrics"][key] == pytest.approx(value, rel=1e-5), key
