@@ -1,4 +1,6 @@
-"""Fused attention kernel (pallas, TPU).
+"""Fused attention kernel (pallas, TPU). Beyond-parity: the reference has no
+custom kernels (torch MultiheadAttention is its hot op, SURVEY.md §2.3); this
+is the TPU-first replacement for that path.
 
 The hot op of every sequential recommender here is the [B, H, L, L] attention.
 XLA already fuses most of it; this kernel removes the HBM materialization of the

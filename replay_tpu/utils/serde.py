@@ -1,4 +1,5 @@
-"""Shared JSON-serialization helpers for `.replay` artifacts."""
+"""Shared JSON-serialization helpers for `.replay` artifacts (the init_args.json
+convention of replay/utils/model_handler.py:42 and every saver in this repo)."""
 
 from __future__ import annotations
 
