@@ -17,7 +17,7 @@ NamedSharding in the trainer.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,6 +49,12 @@ class SequenceBatcher:
         events are kept (inference — the reference predict path).
     :param partitioning: replica-sharding seam; defaults to the single-replica
         identity partitioning.
+    :param bucket_boundaries: optional ascending lengths (e.g. ``(16, 50)``)
+        enabling length-bucketed batching: each entry lands in the smallest
+        bucket holding it, and every batch is padded only to ITS bucket's
+        length (the SURVEY §7 padding-waste mitigation). XLA compiles one
+        program per distinct shape — a handful of buckets, not per-batch
+        dynamic shapes. ``max_sequence_length`` remains the top bucket.
     """
 
     dataset: SequentialDataset
@@ -60,8 +66,22 @@ class SequenceBatcher:
     seed: int = 0
     partitioning: Optional[Partitioning] = None
     epoch: int = field(default=0)
+    bucket_boundaries: Optional[Sequence[int]] = None
 
     def __post_init__(self) -> None:
+        if (
+            self.bucket_boundaries
+            and self.partitioning is not None
+            and self.partitioning.replicas.num_replicas > 1
+        ):
+            # bucketed widths/step counts differ per replica, breaking the
+            # same-shape-per-step collective invariant (partitioning.py)
+            msg = (
+                "bucket_boundaries cannot be combined with multi-replica "
+                "partitioning: hosts would emit differing batch shapes/counts. "
+                "Use fixed-shape batches for multi-host training."
+            )
+            raise ValueError(msg)
         self._schema = self.dataset.schema
         self._seq_names = [f.name for f in self._schema.all_features if f.is_seq]
         self._scalar_names = [f.name for f in self._schema.all_features if not f.is_seq]
@@ -95,13 +115,30 @@ class SequenceBatcher:
                 continue  # exotic dtype: the per-row python path handles it
             self._flat[name] = (flat, offsets)
 
+    def _buckets(self) -> List[int]:
+        # boundaries above max_sequence_length would out-grow positional tables
+        boundaries = sorted(
+            b for b in set(self.bucket_boundaries or ()) if b < self.max_sequence_length
+        )
+        boundaries.append(self.max_sequence_length)
+        return boundaries
+
+    def _bucket_ids(self, entries: np.ndarray, boundaries: List[int]) -> np.ndarray:
+        """Vectorized: bucket index of every (row, start, stop) entry."""
+        lengths = np.minimum(entries[:, 2] - entries[:, 1], self.max_sequence_length)
+        return np.searchsorted(np.asarray(boundaries), lengths, side="left")
+
     def __len__(self) -> int:
         """Number of fixed-size batches for THIS replica (ceil semantics)."""
         from replay_tpu.data.batching import uniform_batch_count
 
         part = self.partitioning or Partitioning()
-        per_replica = len(part.generate(len(self._index), self.epoch))
-        return uniform_batch_count(per_replica, self.batch_size)
+        order = part.generate(len(self._index), self.epoch)
+        if not self.bucket_boundaries:
+            return uniform_batch_count(len(order), self.batch_size)
+        bucket_ids = self._bucket_ids(self._entries[order], self._buckets())
+        counts = np.bincount(bucket_ids)
+        return int(sum(uniform_batch_count(int(n), self.batch_size) for n in counts if n))
 
     def set_epoch(self, epoch: int) -> None:
         """Advance the shuffle epoch (folds into the partitioning seed)."""
@@ -121,61 +158,79 @@ class SequenceBatcher:
         sample = self.dataset.get_sequence(0, name) if len(self.dataset) else np.zeros(0)
         return np.int32 if np.issubdtype(np.asarray(sample).dtype, np.integer) else np.float32
 
+    def _make_batch(self, chunk: np.ndarray, L: int, dtypes: Dict) -> Batch:
+        n_real = len(chunk)
+        if n_real < self.batch_size:  # pad final batch by repeating its first row
+            chunk = np.concatenate(
+                [chunk, np.full(self.batch_size - n_real, chunk[0], dtype=chunk.dtype)]
+            )
+        batch: Batch = {}
+        spans = self._entries[chunk]  # [B, 3] (row, start, stop)
+        for name in self._seq_names:
+            pad = self._padding_value(name)
+            if name in self._flat:
+                from replay_tpu.native import gather_pad_spans
+
+                flat, offsets = self._flat[name]
+                # a secondary feature may be shorter than the item sequence
+                # that defined the window: clamp to ITS row length (the same
+                # silent-truncation semantics as python slicing)
+                row_len = offsets[spans[:, 0] + 1] - offsets[spans[:, 0]]
+                stops = np.minimum(spans[:, 2], row_len)
+                starts = np.minimum(spans[:, 1], stops)
+                arr, mask = gather_pad_spans(
+                    flat, offsets, spans[:, 0], starts, stops, L, pad
+                )
+                batch[name] = arr.astype(dtypes[name], copy=False)
+            else:
+                arr = np.full((self.batch_size, L), pad, dtype=dtypes[name])
+                mask = np.zeros((self.batch_size, L), dtype=bool)
+                for b, entry in enumerate(chunk):
+                    row, start, stop = self._index[entry]
+                    seq = self.dataset.get_sequence(row, name)[start:stop]
+                    seq = seq[-L:]
+                    arr[b, L - len(seq) :] = seq
+                    mask[b, L - len(seq) :] = True
+                batch[name] = arr
+            batch[f"{name}_mask"] = np.asarray(mask, bool)
+        for name in self._scalar_names:
+            batch[name] = np.asarray(
+                [
+                    np.asarray(
+                        self.dataset.get_sequence(self._index[entry][0], name)
+                    ).reshape(-1)[0]
+                    for entry in chunk
+                ]
+            )
+        batch["query_id"] = np.asarray(
+            [self.dataset.get_query_id(self._index[entry][0]) for entry in chunk]
+        )
+        valid = np.zeros(self.batch_size, dtype=bool)
+        valid[:n_real] = True
+        batch["valid"] = valid
+        return batch
+
     def __iter__(self) -> Iterator[Batch]:
         order = self._entry_order()
-        L = self.max_sequence_length
         dtypes = {name: self._dtype(name) for name in self._seq_names}
-        for chunk_start in range(0, len(order), self.batch_size):
-            chunk = order[chunk_start : chunk_start + self.batch_size]
-            n_real = len(chunk)
-            if n_real < self.batch_size:  # pad final batch by repeating its first row
-                chunk = np.concatenate(
-                    [chunk, np.full(self.batch_size - n_real, chunk[0], dtype=chunk.dtype)]
-                )
-            batch: Batch = {}
-            spans = self._entries[chunk]  # [B, 3] (row, start, stop)
-            for name in self._seq_names:
-                pad = self._padding_value(name)
-                if name in self._flat:
-                    from replay_tpu.native import gather_pad_spans
-
-                    flat, offsets = self._flat[name]
-                    # a secondary feature may be shorter than the item sequence
-                    # that defined the window: clamp to ITS row length (the same
-                    # silent-truncation semantics as python slicing)
-                    row_len = offsets[spans[:, 0] + 1] - offsets[spans[:, 0]]
-                    stops = np.minimum(spans[:, 2], row_len)
-                    starts = np.minimum(spans[:, 1], stops)
-                    arr, mask = gather_pad_spans(
-                        flat, offsets, spans[:, 0], starts, stops, L, pad
-                    )
-                    batch[name] = arr.astype(dtypes[name], copy=False)
-                else:
-                    arr = np.full((self.batch_size, L), pad, dtype=dtypes[name])
-                    mask = np.zeros((self.batch_size, L), dtype=bool)
-                    for b, entry in enumerate(chunk):
-                        row, start, stop = self._index[entry]
-                        seq = self.dataset.get_sequence(row, name)[start:stop]
-                        arr[b, L - len(seq) :] = seq
-                        mask[b, L - len(seq) :] = True
-                    batch[name] = arr
-                batch[f"{name}_mask"] = np.asarray(mask, bool)
-            for name in self._scalar_names:
-                batch[name] = np.asarray(
-                    [
-                        np.asarray(
-                            self.dataset.get_sequence(self._index[entry][0], name)
-                        ).reshape(-1)[0]
-                        for entry in chunk
-                    ]
-                )
-            batch["query_id"] = np.asarray(
-                [self.dataset.get_query_id(self._index[entry][0]) for entry in chunk]
-            )
-            valid = np.zeros(self.batch_size, dtype=bool)
-            valid[:n_real] = True
-            batch["valid"] = valid
-            yield batch
+        if not self.bucket_boundaries:
+            L = self.max_sequence_length
+            for chunk_start in range(0, len(order), self.batch_size):
+                yield self._make_batch(order[chunk_start : chunk_start + self.batch_size], L, dtypes)
+            return
+        # length-bucketed: every batch pads only to its bucket's length
+        boundaries = self._buckets()
+        bucket_ids = self._bucket_ids(self._entries[order], boundaries)
+        queues: Dict[int, list] = {bucket: [] for bucket in boundaries}
+        for entry, bucket_id in zip(order, bucket_ids):
+            bucket = boundaries[bucket_id]
+            queues[bucket].append(entry)
+            if len(queues[bucket]) == self.batch_size:
+                yield self._make_batch(np.asarray(queues[bucket]), bucket, dtypes)
+                queues[bucket] = []
+        for bucket in boundaries:  # flush short tails (padded + valid-masked)
+            if queues[bucket]:
+                yield self._make_batch(np.asarray(queues[bucket]), bucket, dtypes)
 
 
 def validation_batches(

@@ -88,6 +88,20 @@ def tensor_schema_rich() -> TensorSchema:
     )
 
 
+def make_item_seq_dataset(lengths, num_items=10):
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=num_items)
+    )
+    frame = pd.DataFrame(
+        {
+            "query_id": np.arange(len(lengths)),
+            "item_id": [np.arange(n) % num_items for n in lengths],
+        }
+    )
+    return SequentialDataset(schema, "query_id", "item_id", frame)
+
+
 class TestSequenceTokenizer:
     def test_fit_transform_sequences(self, rich_dataset, tensor_schema_rich):
         tokenizer = SequenceTokenizer(tensor_schema_rich)
@@ -194,22 +208,7 @@ class TestPartitioning:
 
 class TestSequenceBatcher:
     def make_seq_dataset(self, lengths, num_items=10):
-        schema = TensorSchema(
-            TensorFeatureInfo(
-                "item_id",
-                FeatureType.CATEGORICAL,
-                is_seq=True,
-                feature_hint=FeatureHint.ITEM_ID,
-                cardinality=num_items,
-            )
-        )
-        frame = pd.DataFrame(
-            {
-                "query_id": np.arange(len(lengths)),
-                "item_id": [np.arange(n) % num_items for n in lengths],
-            }
-        )
-        return SequentialDataset(schema, "query_id", "item_id", frame)
+        return make_item_seq_dataset(lengths, num_items)
 
     def test_fixed_shapes_and_left_padding(self):
         ds = self.make_seq_dataset([3, 5, 2])
@@ -331,3 +330,53 @@ class TestPrefetch:
         count_after_close = produced["n"]
         time.sleep(0.3)
         assert produced["n"] == count_after_close  # producer actually stopped
+
+
+class TestBucketedBatching:
+    def make_seq_dataset(self, lengths, num_items=30):
+        return make_item_seq_dataset(lengths, num_items)
+
+    def test_shapes_follow_buckets_and_coverage(self):
+        lengths = [3, 4, 5, 12, 14, 15, 16, 2]
+        ds = self.make_seq_dataset(lengths)
+        batcher = SequenceBatcher(ds, batch_size=2, max_sequence_length=16,
+                                  bucket_boundaries=(5, 16))
+        batches = list(batcher)
+        assert len(batches) == len(batcher)
+        widths = sorted({b["item_id"].shape[1] for b in batches})
+        assert widths == [5, 16]
+        # short sequences pad only to 5, not 16
+        seen = []
+        for batch in batches:
+            assert batch["item_id"].shape[0] == 2
+            seen.extend(batch["query_id"][batch["valid"]].tolist())
+        assert sorted(seen) == list(range(len(lengths)))  # every query exactly once
+        # the padding waste shrinks vs unbucketed
+        def waste(bs):
+            return sum(int((~b["item_id_mask"][b["valid"]]).sum()) for b in bs)
+        unbucketed = list(SequenceBatcher(ds, batch_size=2, max_sequence_length=16))
+        assert waste(batches) < waste(unbucketed)
+
+    def test_buckets_with_windows(self):
+        ds = self.make_seq_dataset([40, 3])
+        batcher = SequenceBatcher(ds, batch_size=1, max_sequence_length=16,
+                                  windows=True, bucket_boundaries=(4, 16))
+        rows = []
+        for batch in batcher:
+            width = batch["item_id"].shape[1]
+            assert width in (4, 16)
+            rows.extend(batch["item_id"][batch["valid"]][batch["item_id_mask"][batch["valid"]]].tolist())
+        assert sorted(set(rows)) == sorted(set(np.arange(40) % 30) | {0, 1, 2})
+
+    def test_bucket_guards(self):
+        ds = self.make_seq_dataset([3, 8])
+        # boundaries above max are dropped; max stays the top bucket
+        batcher = SequenceBatcher(ds, batch_size=1, max_sequence_length=8,
+                                  bucket_boundaries=(4, 100))
+        assert batcher._buckets() == [4, 8]
+        assert max(b["item_id"].shape[1] for b in batcher) == 8
+        # multi-replica + buckets is rejected
+        with pytest.raises(ValueError, match="multi-replica"):
+            SequenceBatcher(ds, batch_size=1, max_sequence_length=8,
+                            bucket_boundaries=(4,),
+                            partitioning=Partitioning(ReplicasInfo(2, 0)))
