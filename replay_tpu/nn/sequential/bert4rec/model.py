@@ -45,6 +45,7 @@ class Bert4RecBody(nn.Module):
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
     num_passes_over_block: int = 1
+    remat: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -70,6 +71,7 @@ class Bert4RecBody(nn.Module):
             num_heads=self.num_heads,
             hidden_dim=self.hidden_dim or self.embedding_dim * 4,
             dropout_rate=self.dropout_rate,
+            remat=self.remat,
             dtype=self.dtype,
             name="encoder",
         )
@@ -120,6 +122,7 @@ class Bert4Rec(nn.Module):
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
     num_passes_over_block: int = 1
+    remat: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -133,6 +136,7 @@ class Bert4Rec(nn.Module):
             hidden_dim=self.hidden_dim,
             dropout_rate=self.dropout_rate,
             num_passes_over_block=self.num_passes_over_block,
+            remat=self.remat,
             excluded_features=self.excluded_features,
             dtype=self.dtype,
             name="body",

@@ -41,6 +41,7 @@ class SasRecBody(nn.Module):
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
     encoder_type: str = "sasrec"
+    remat: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -64,6 +65,7 @@ class SasRecBody(nn.Module):
         if encoder_cls is None:
             msg = f"Unknown encoder_type: {self.encoder_type}"
             raise ValueError(msg)
+        encoder_kwargs = {"remat": self.remat} if self.encoder_type == "sasrec" else {}
         self.encoder = encoder_cls(
             num_blocks=self.num_blocks,
             num_heads=self.num_heads,
@@ -71,6 +73,7 @@ class SasRecBody(nn.Module):
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
             name="encoder",
+            **encoder_kwargs,
         )
         self.final_norm = nn.LayerNorm(dtype=self.dtype, name="final_norm")
 
@@ -100,6 +103,7 @@ class SasRec(nn.Module):
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
     encoder_type: str = "sasrec"
+    remat: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -113,6 +117,7 @@ class SasRec(nn.Module):
             hidden_dim=self.hidden_dim,
             dropout_rate=self.dropout_rate,
             encoder_type=self.encoder_type,
+            remat=self.remat,
             excluded_features=self.excluded_features,
             dtype=self.dtype,
             name="body",

@@ -17,13 +17,46 @@ from replay_tpu.nn.attention import MultiHeadAttention, MultiHeadDifferentialAtt
 from replay_tpu.nn.ffn import PointWiseFeedForward, SwiGLU
 
 
+class _SasRecBlock(nn.Module):
+    """One pre-LN block: LayerNorm → MHA → residual → LayerNorm → FFN."""
+
+    num_heads: int
+    hidden_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, keep, deterministic: bool = True):
+        h = nn.LayerNorm(dtype=self.dtype, name="attn_norm")(x)
+        h = MultiHeadAttention(
+            num_heads=self.num_heads,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="attention",
+        )(h, attention_mask, deterministic=deterministic)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ffn_norm")(x)
+        x = PointWiseFeedForward(
+            hidden_dim=self.hidden_dim,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="ffn",
+        )(h, deterministic=deterministic)
+        return x * keep  # zero out padded positions between blocks
+
+
 class SasRecTransformerLayer(nn.Module):
-    """N pre-LN blocks: LayerNorm → MHA → residual → LayerNorm → point-wise FFN."""
+    """N pre-LN blocks: LayerNorm → MHA → residual → LayerNorm → point-wise FFN.
+
+    ``remat=True`` rematerializes each block's activations on the backward pass
+    (jax.checkpoint) — the HBM-for-FLOPs trade for long sequences / big batches.
+    """
 
     num_blocks: int
     num_heads: int
     hidden_dim: int
     dropout_rate: float = 0.0
+    remat: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -35,23 +68,17 @@ class SasRecTransformerLayer(nn.Module):
         deterministic: bool = True,
     ) -> jnp.ndarray:
         keep = padding_mask[..., None].astype(x.dtype)
+        block_cls = (
+            nn.remat(_SasRecBlock, static_argnums=(4,)) if self.remat else _SasRecBlock
+        )
         for i in range(self.num_blocks):
-            h = nn.LayerNorm(dtype=self.dtype, name=f"attn_norm_{i}")(x)
-            h = MultiHeadAttention(
+            x = block_cls(
                 num_heads=self.num_heads,
-                dropout_rate=self.dropout_rate,
-                dtype=self.dtype,
-                name=f"attention_{i}",
-            )(h, attention_mask, deterministic=deterministic)
-            x = x + h
-            h = nn.LayerNorm(dtype=self.dtype, name=f"ffn_norm_{i}")(x)
-            x = PointWiseFeedForward(
                 hidden_dim=self.hidden_dim,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
-                name=f"ffn_{i}",
-            )(h, deterministic=deterministic)
-            x = x * keep  # zero out padded positions between blocks
+                name=f"block_{i}",
+            )(x, attention_mask, keep, deterministic)
         return x
 
 
