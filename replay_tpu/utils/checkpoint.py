@@ -25,14 +25,36 @@ import jax
 import numpy as np
 
 
-def save_pytree(path: str, tree: Any, metadata: Optional[dict] = None) -> None:
-    """Write a pytree's leaves (+ optional JSON metadata) to ``<path>.npz/.json``."""
+def save_pytree(
+    path: str, tree: Any, metadata: Optional[dict] = None, backend: str = "npz"
+) -> None:
+    """Write a pytree's leaves (+ optional JSON metadata) to ``<path>``.
+
+    ``backend="npz"`` (default) stores the flattened leaf list in one ``.npz``;
+    ``backend="orbax"`` delegates the tree to orbax's StandardCheckpointer
+    (sharded/async-capable storage for very large states) — both restore through
+    the same template-driven :func:`restore_pytree`.
+    """
     target = Path(path)
     target.parent.mkdir(parents=True, exist_ok=True)
     leaves = jax.tree.leaves(tree)
-    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
-    np.savez(str(target.with_suffix(".npz")), **arrays)
-    meta = {"num_leaves": len(leaves), **(metadata or {})}
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+
+        checkpointer = ocp.StandardCheckpointer()
+        checkpointer.save(
+            (target.parent / (target.name + ".orbax")).absolute(),
+            jax.tree.map(np.asarray, tree),
+            force=True,
+        )
+        checkpointer.wait_until_finished()
+    elif backend == "npz":
+        arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+        np.savez(str(target.with_suffix(".npz")), **arrays)
+    else:
+        msg = f"Unknown checkpoint backend: {backend}"
+        raise ValueError(msg)
+    meta = {"num_leaves": len(leaves), "backend": backend, **(metadata or {})}
     target.with_suffix(".json").write_text(json.dumps(meta))
 
 
@@ -43,8 +65,22 @@ def restore_pytree(path: str, template: Any) -> Any:
     cache-shape check of the reference, generalized).
     """
     target = Path(path)
-    with np.load(str(target.with_suffix(".npz"))) as payload:
-        leaves = [payload[f"leaf_{i}"] for i in range(len(payload.files))]
+    meta_path = target.with_suffix(".json")
+    backend = "npz"
+    if meta_path.exists():
+        backend = json.loads(meta_path.read_text()).get("backend", "npz")
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+
+        checkpointer = ocp.StandardCheckpointer()
+        restored = checkpointer.restore(
+            (target.parent / (target.name + ".orbax")).absolute(),
+            jax.tree.map(np.asarray, template),
+        )
+        leaves = [np.asarray(leaf) for leaf in jax.tree.leaves(restored)]
+    else:
+        with np.load(str(target.with_suffix(".npz"))) as payload:
+            leaves = [payload[f"leaf_{i}"] for i in range(len(payload.files))]
     template_leaves, treedef = jax.tree.flatten(template)
     if len(leaves) != len(template_leaves):
         msg = (
@@ -74,17 +110,25 @@ class CheckpointManager:
     callback's state_dict).
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+    def __init__(self, directory: str, max_to_keep: int = 3, backend: str = "npz") -> None:
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
+        self.backend = backend
 
     def _step_path(self, step: int) -> Path:
         return self.directory / f"step_{step}"
 
+    def _delete_step(self, step: int) -> None:
+        self._step_path(step).with_suffix(".npz").unlink(missing_ok=True)
+        self._step_path(step).with_suffix(".json").unlink(missing_ok=True)
+        shutil.rmtree(self.directory / f"step_{step}.orbax", ignore_errors=True)
+
     def all_steps(self) -> List[int]:
+        # the JSON sidecar exists for every backend
         return sorted(
-            int(p.stem.split("_")[1]) for p in self.directory.glob("step_*.npz")
+            int(p.stem.split("_")[1]) for p in self.directory.glob("step_*.json")
+            if p.name != "best.json"
         )
 
     def latest_step(self) -> Optional[int]:
@@ -98,15 +142,17 @@ class CheckpointManager:
         history: Optional[List[Dict[str, float]]] = None,
         metadata: Optional[dict] = None,
     ) -> None:
-        save_pytree(str(self._step_path(step)), state, {"step": step, **(metadata or {})})
+        save_pytree(
+            str(self._step_path(step)), state, {"step": step, **(metadata or {})},
+            backend=self.backend,
+        )
         if history is not None:
             (self.directory / "history.json").write_text(json.dumps(history))
         protected = self.best_step()
         for old in self.all_steps()[: -self.max_to_keep]:
             if old == protected:  # the monitored winner survives rotation
                 continue
-            self._step_path(old).with_suffix(".npz").unlink(missing_ok=True)
-            self._step_path(old).with_suffix(".json").unlink(missing_ok=True)
+            self._delete_step(old)
 
     # -- monitored-best tracking ------------------------------------------- #
     def mark_best(self, step: int) -> None:
@@ -118,7 +164,11 @@ class CheckpointManager:
         if not path.exists():
             return None
         step = json.loads(path.read_text())["step"]
-        return step if self._step_path(step).with_suffix(".npz").exists() else None
+        payload_exists = (
+            self._step_path(step).with_suffix(".npz").exists()
+            or (self.directory / f"step_{step}.orbax").exists()
+        )
+        return step if payload_exists else None
 
     def restore_best(self, template: Any) -> Any:
         """Restore the monitored-best checkpoint (falls back to the latest)."""

@@ -273,3 +273,43 @@ def test_monitor_early_stopping_and_best_state(schema, pipelines):
         trainer.fit(lambda e: batches, epochs=1, monitor="ndcg@10")
     with pytest.raises(ValueError, match="mode"):
         trainer.fit(lambda e: batches, epochs=1, monitor="train_loss", mode="sideways")
+
+
+@pytest.mark.jax
+@pytest.mark.parametrize("loss_name", ["CE", "CESampled", "BCE", "BCESampled",
+                                       "LogInCE", "LogInCESampled", "LogOutCE", "SCE"])
+def test_every_loss_trains_through_trainer(loss_name, schema, pipelines):
+    """The trainer × loss matrix: every protocol loss runs a finite, decreasing
+    training step stream on the same template batches."""
+    from replay_tpu.nn import loss as loss_module
+    from replay_tpu.nn.loss import SCE, SCEParams
+    from replay_tpu.nn.transform import Compose, UniformNegativeSamplingTransform
+    from replay_tpu.nn.transform.template import make_default_sasrec_transforms
+
+    if loss_name == "SCE":
+        loss = SCE(SCEParams(n_buckets=4, bucket_size_x=8, bucket_size_y=6))
+    elif loss_name in ("LogInCE", "LogOutCE"):
+        loss = getattr(loss_module, loss_name)(cardinality=NUM_ITEMS)
+    else:
+        loss = getattr(loss_module, loss_name)()
+    sampled = "Sampled" in loss_name
+    transforms = make_default_sasrec_transforms(schema)["train"]
+    if sampled:
+        transforms = transforms + [
+            UniformNegativeSamplingTransform(cardinality=NUM_ITEMS, num_negative_samples=4)
+        ]
+    pipeline = Compose(transforms)
+    model = SasRec(schema=schema, embedding_dim=16, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=loss, optimizer=OptimizerFactory(learning_rate=2e-2))
+    rng = np.random.default_rng(3)
+    key = jax.random.PRNGKey(0)
+    state, losses = None, []
+    for _ in range(10):
+        key, sub = jax.random.split(key)
+        batch = pipeline(make_raw_batch(rng), sub if pipeline.needs_rng else None)
+        if state is None:
+            state = trainer.init_state(batch)
+        state, loss_value = trainer.train_step(state, batch)
+        losses.append(float(loss_value))
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])

@@ -133,3 +133,27 @@ def test_best_checkpoint_survives_rotation(tmp_path):
     assert manager.best_step() == 1
     best = manager.restore_best({"w": np.zeros(2)})
     np.testing.assert_array_equal(best["w"], np.ones(2))
+
+
+@pytest.mark.jax
+def test_orbax_backend_roundtrip_and_rotation(tmp_path):
+    """The orbax storage backend round-trips TrainStates and rotates cleanly."""
+    pytest.importorskip("orbax.checkpoint")
+    trainer = make_trainer()
+    state = trainer.init_state(make_batch(0))
+    state, _ = trainer.train_step(state, make_batch(0))
+    manager = CheckpointManager(str(tmp_path / "orbax_run"), max_to_keep=2, backend="orbax")
+    for step in (1, 2, 3):
+        manager.save(step, state)
+    assert manager.all_steps() == [2, 3]  # rotation removed the orbax dir too
+    assert not (tmp_path / "orbax_run" / "step_1.orbax").exists()
+    template = trainer.init_state(make_batch(0))
+    restored = manager.restore(template)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6),
+        restored.params,
+        state.params,
+    )
+    with pytest.raises(ValueError, match="backend"):
+        from replay_tpu.utils.checkpoint import save_pytree
+        save_pytree(str(tmp_path / "x"), {"a": jnp.ones(2)}, backend="zzz")
