@@ -42,10 +42,10 @@ def save_pytree(
         import orbax.checkpoint as ocp
 
         checkpointer = ocp.StandardCheckpointer()
+        # hand orbax the tree AS-IS: it understands (sharded) jax.Arrays, so no
+        # host gather happens and multi-host saves write each shard in place
         checkpointer.save(
-            (target.parent / (target.name + ".orbax")).absolute(),
-            jax.tree.map(np.asarray, tree),
-            force=True,
+            (target.parent / (target.name + ".orbax")).absolute(), tree, force=True
         )
         checkpointer.wait_until_finished()
     elif backend == "npz":
@@ -54,7 +54,8 @@ def save_pytree(
     else:
         msg = f"Unknown checkpoint backend: {backend}"
         raise ValueError(msg)
-    meta = {"num_leaves": len(leaves), "backend": backend, **(metadata or {})}
+    # reserved keys win over caller metadata: restore routes on "backend"
+    meta = {**(metadata or {}), "num_leaves": len(leaves), "backend": backend}
     target.with_suffix(".json").write_text(json.dumps(meta))
 
 
@@ -73,9 +74,10 @@ def restore_pytree(path: str, template: Any) -> Any:
         import orbax.checkpoint as ocp
 
         checkpointer = ocp.StandardCheckpointer()
+        # abstract target: shapes/dtypes only, no host materialization of the template
+        abstract = jax.eval_shape(lambda t: t, template)
         restored = checkpointer.restore(
-            (target.parent / (target.name + ".orbax")).absolute(),
-            jax.tree.map(np.asarray, template),
+            (target.parent / (target.name + ".orbax")).absolute(), abstract
         )
         leaves = [np.asarray(leaf) for leaf in jax.tree.leaves(restored)]
     else:
@@ -126,10 +128,7 @@ class CheckpointManager:
 
     def all_steps(self) -> List[int]:
         # the JSON sidecar exists for every backend
-        return sorted(
-            int(p.stem.split("_")[1]) for p in self.directory.glob("step_*.json")
-            if p.name != "best.json"
-        )
+        return sorted(int(p.stem.split("_")[1]) for p in self.directory.glob("step_*.json"))
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
