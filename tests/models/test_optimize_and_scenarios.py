@@ -72,3 +72,16 @@ def test_fallback_cold_query_served():
     recs = scenario.predict(dataset, k=3, queries=[777], filter_seen_items=False)
     assert set(recs["query_id"]) == {777}
     assert len(recs) == 3  # fully served by the popularity fallback
+
+def test_fallback_save_load_roundtrip(tmp_path):
+    dataset = make_dataset(grouped_log())
+    scenario = Fallback(main=ItemKNN(num_neighbours=3), fallback=PopRec()).fit(dataset)
+    before = scenario.predict(dataset, k=4)
+    scenario.save(str(tmp_path / "fb"))
+    restored = Fallback.load(str(tmp_path / "fb"))
+    after = restored.predict(dataset, k=4)
+    pd.testing.assert_frame_equal(
+        before.reset_index(drop=True), after.reset_index(drop=True)
+    )
+    assert type(restored.main).__name__ == "ItemKNN"
+    assert type(restored.fallback).__name__ == "PopRec"
