@@ -40,6 +40,9 @@ def _backend_healthy(timeout: float = 180.0) -> bool:
     return probe.returncode == 0
 
 
+PROBE_TIMEOUT = float(os.environ.get("REPLAY_TPU_BENCH_PROBE_TIMEOUT", "120"))
+
+
 def _reexec_on_cpu() -> None:
     """Fall back to a clean-CPU interpreter so a number is always recorded."""
     env = dict(os.environ)
@@ -54,7 +57,7 @@ def _reexec_on_cpu() -> None:
 def main() -> None:
     if not os.environ.get("REPLAY_TPU_BENCH_FALLBACK"):
         try:
-            healthy = _backend_healthy()
+            healthy = _backend_healthy(PROBE_TIMEOUT)
         except subprocess.TimeoutExpired:
             healthy = False
         if not healthy:
@@ -115,6 +118,14 @@ def main() -> None:
         state, loss_value = trainer.train_step(state, batch)
     jax.block_until_ready(loss_value)
 
+    # adapt the measurement length to the backend speed (a slow CPU fallback
+    # must not blow the driver's time budget; a fast chip gets a longer window)
+    probe_start = time.perf_counter()
+    state, loss_value = trainer.train_step(state, batch)
+    jax.block_until_ready(loss_value)
+    probe_step = time.perf_counter() - probe_start
+    steps = int(np.clip(45.0 / max(probe_step, 1e-6), 10, 30))
+
     # per-step FLOPs from XLA's own cost model of the compiled train step
     step_flops = None
     try:
@@ -124,7 +135,6 @@ def main() -> None:
     except Exception:  # cost analysis is best-effort across backends
         pass
 
-    steps = 30
     start = time.perf_counter()
     for _ in range(steps):
         state, loss_value = trainer.train_step(state, batch)
