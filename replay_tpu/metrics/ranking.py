@@ -18,7 +18,17 @@ from .base import Metric, MetricsReturnType
 
 
 class RankingMetric(Metric):
-    """Shared vectorized evaluation: subclasses map the hit matrix to values."""
+    """Shared vectorized evaluation: subclasses map the hit matrix to values.
+
+    Intentional divergence from the reference on DUPLICATED recommendation
+    lists: recommendations are treated as an ordered SET — a duplicate item
+    keeps its first rank only — so precision/MAP/recall stay bounded by 1.
+    The reference counts each occurrence of a duplicated relevant item
+    (replay/metrics/base_metric.py warns but still scores per occurrence),
+    so metric values differ on such inputs; on duplicate-free lists (the
+    contract of every top-k producer in this framework) the two definitions
+    coincide. See PARITY.md §metrics.
+    """
 
     def _evaluate(self, ground_truth: dict, recs: dict, *extra) -> MetricsReturnType:
         users = list(ground_truth.keys())

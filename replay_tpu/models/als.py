@@ -96,8 +96,12 @@ class ALS(ANNMixin, BaseRecommender):
             ratings = np.maximum(ratings, 0.0)
         n_users, n_items = len(q_index), len(i_index)
 
-        u_idx, u_val, u_mask = _padded_groups(users, items, ratings, n_users)
-        i_idx, i_val, i_mask = _padded_groups(items, users, ratings, n_items)
+        u_idx, u_val, u_mask = (
+            jax.device_put(a) for a in _padded_groups(users, items, ratings, n_users)
+        )
+        i_idx, i_val, i_mask = (
+            jax.device_put(a) for a in _padded_groups(items, users, ratings, n_items)
+        )
 
         rng = np.random.default_rng(self.seed)
         scale = 1.0 / np.sqrt(self.rank)
@@ -125,16 +129,28 @@ class ALS(ANNMixin, BaseRecommender):
         self.user_factors = np.asarray(user_factors)
         self.item_factors = np.asarray(item_factors)
 
+    def _warm_blocks(self, queries, items):
+        q_pos = pd.Index(self.fit_queries).get_indexer(np.asarray(queries))
+        i_pos = pd.Index(self.fit_items).get_indexer(np.asarray(items))
+        known_q, known_i = q_pos >= 0, i_pos >= 0
+        return (
+            np.asarray(queries)[known_q],
+            np.asarray(items)[known_i],
+            self.user_factors[q_pos[known_q]],
+            self.item_factors[i_pos[known_i]],
+        )
+
+    def _dense_scores(self, dataset, queries, items):
+        # device top-k path (models/base.py): one [Q, R] x [R, I] MXU matmul
+        warm_queries, warm_items, user_vecs, item_vecs = self._warm_blocks(queries, items)
+        import jax.numpy as jnp
+
+        scores = jnp.asarray(user_vecs) @ jnp.asarray(item_vecs).T
+        return scores, warm_queries, warm_items
+
     def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
-        q_index = pd.Index(self.fit_queries)
-        i_index = pd.Index(self.fit_items)
-        q_pos = q_index.get_indexer(np.asarray(queries))
-        i_pos = i_index.get_indexer(np.asarray(items))
-        known_q = q_pos >= 0
-        known_i = i_pos >= 0
-        warm_queries = np.asarray(queries)[known_q]
-        warm_items = np.asarray(items)[known_i]
-        scores = self.user_factors[q_pos[known_q]] @ self.item_factors[i_pos[known_i]].T
+        warm_queries, warm_items, user_vecs, item_vecs = self._warm_blocks(queries, items)
+        scores = user_vecs @ item_vecs.T
         return pd.DataFrame(
             {
                 self.query_column: np.repeat(warm_queries, len(warm_items)),

@@ -95,6 +95,16 @@ class BaseRecommender(OptimizeMixin):
         )
 
         self._predict_k = k  # read by _broadcast_item_scores' candidate pruning
+        dense = self._dense_scores(dataset, queries, items)
+        if dense is not None:
+            matrix, kept_queries, kept_items = dense
+            return self._topk_from_dense(
+                matrix,
+                kept_queries,
+                kept_items,
+                interactions if filter_seen_items else None,
+                k,
+            )
         scores = self._predict_scores(dataset, queries, items)
         if filter_seen_items and interactions is not None:
             seen = interactions[
@@ -115,6 +125,56 @@ class BaseRecommender(OptimizeMixin):
         )
         top = ranked.groupby(self.query_column, sort=False).head(k)
         return top.reset_index(drop=True)
+
+    def _dense_scores(self, dataset: Optional[Dataset], queries, items):
+        """Optional fast path: ``(score_matrix [Q', I'], kept_queries, kept_items)``.
+
+        Models that can score a dense query×item block return it here; ``predict``
+        then seen-filters and top-ks ON DEVICE (``jax.lax.top_k`` — the exact-MIPS
+        design of models/ann.py) instead of exploding a Q×I-row frame through
+        pandas. Entries the model would exclude from the frame path must already
+        be ``-inf`` in the matrix; queries/items it cannot score (cold) are
+        dropped from ``kept_*``. ``None`` falls back to :meth:`_predict_scores`.
+        """
+        return None
+
+    def _topk_from_dense(
+        self,
+        matrix,
+        kept_queries: np.ndarray,
+        kept_items: np.ndarray,
+        interactions: Optional[pd.DataFrame],
+        k: int,
+    ) -> pd.DataFrame:
+        import jax
+        import jax.numpy as jnp
+
+        q_index = pd.Index(np.asarray(kept_queries))
+        i_index = pd.Index(np.asarray(kept_items))
+        scores = jnp.asarray(matrix, jnp.float32)
+        if interactions is not None:
+            sub = interactions[
+                interactions[self.query_column].isin(q_index)
+                & interactions[self.item_column].isin(i_index)
+            ]
+            rows = q_index.get_indexer(sub[self.query_column])
+            cols = i_index.get_indexer(sub[self.item_column])
+            keep = (rows >= 0) & (cols >= 0)
+            scores = scores.at[rows[keep], cols[keep]].set(-jnp.inf)
+        k_eff = min(k, len(i_index))
+        values, idx = jax.lax.top_k(scores, k_eff)
+        values = np.asarray(values)
+        items_out = np.asarray(i_index.to_numpy())[np.asarray(idx)]
+        frame = pd.DataFrame(
+            {
+                self.query_column: np.repeat(q_index.to_numpy(), k_eff),
+                self.item_column: items_out.reshape(-1),
+                "rating": values.reshape(-1),
+            }
+        )
+        # fully-filtered rows (user saw everything / model scored nothing) drop
+        # out, exactly like the frame path after its seen-merge
+        return frame[np.isfinite(frame["rating"])].reset_index(drop=True)
 
     def _predict_scores(
         self, dataset: Optional[Dataset], queries: np.ndarray, items: np.ndarray

@@ -94,9 +94,10 @@ class ItemKNN(BaseRecommender):
         self.similarity = sim.astype(np.float32)
 
     # -- predict ------------------------------------------------------------ #
-    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+    def _profile_matrix(self, dataset, queries) -> np.ndarray:
+        """[Q, I_fit] query interaction profiles from the dataset."""
         if dataset is None:
-            msg = "ItemKNN needs the interactions dataset to score queries."
+            msg = f"{type(self).__name__} needs the interactions dataset to score queries."
             raise ValueError(msg)
         interactions = dataset.interactions
         q_index = pd.Index(np.asarray(queries))
@@ -114,6 +115,27 @@ class ItemKNN(BaseRecommender):
             else np.ones(len(sub), np.float32)
         )
         np.maximum.at(seen, (rows, cols), values)
+        return seen
+
+    def _dense_scores(self, dataset, queries, items):
+        # device top-k path (models/base.py): profile x similarity on the MXU;
+        # the frame path drops non-positive scores, so they become -inf here
+        import jax.numpy as jnp
+
+        seen = self._profile_matrix(dataset, queries)
+        i_index = pd.Index(self.fit_items)
+        item_positions = i_index.get_indexer(np.asarray(items))
+        known = item_positions >= 0
+        wanted = np.asarray(items)[known]
+        scores = jnp.asarray(seen) @ jnp.asarray(self.similarity)
+        block = scores[:, item_positions[known]]
+        block = jnp.where(block > 0, block, -jnp.inf)
+        return block, np.asarray(queries), wanted
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        seen = self._profile_matrix(dataset, queries)
+        q_index = pd.Index(np.asarray(queries))
+        i_index = pd.Index(self.fit_items)
         scores = seen @ self.similarity  # [Q, I] x [I, I]
         item_positions = i_index.get_indexer(np.asarray(items))
         known = item_positions >= 0

@@ -46,6 +46,7 @@ class Bert4RecBody(nn.Module):
     dropout_rate: float = 0.0
     num_passes_over_block: int = 1
     remat: bool = False
+    use_flash: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -72,6 +73,7 @@ class Bert4RecBody(nn.Module):
             hidden_dim=self.hidden_dim or self.embedding_dim * 4,
             dropout_rate=self.dropout_rate,
             remat=self.remat,
+            use_flash=self.use_flash,
             dtype=self.dtype,
             name="encoder",
         )
@@ -123,6 +125,7 @@ class Bert4Rec(nn.Module):
     dropout_rate: float = 0.0
     num_passes_over_block: int = 1
     remat: bool = False
+    use_flash: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -137,6 +140,7 @@ class Bert4Rec(nn.Module):
             dropout_rate=self.dropout_rate,
             num_passes_over_block=self.num_passes_over_block,
             remat=self.remat,
+            use_flash=self.use_flash,
             excluded_features=self.excluded_features,
             dtype=self.dtype,
             name="body",

@@ -42,6 +42,7 @@ class SasRecBody(nn.Module):
     dropout_rate: float = 0.0
     encoder_type: str = "sasrec"
     remat: bool = False
+    use_flash: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -65,7 +66,11 @@ class SasRecBody(nn.Module):
         if encoder_cls is None:
             msg = f"Unknown encoder_type: {self.encoder_type}"
             raise ValueError(msg)
-        encoder_kwargs = {"remat": self.remat} if self.encoder_type == "sasrec" else {}
+        encoder_kwargs = (
+            {"remat": self.remat, "use_flash": self.use_flash}
+            if self.encoder_type == "sasrec"
+            else {}
+        )
         self.encoder = encoder_cls(
             num_blocks=self.num_blocks,
             num_heads=self.num_heads,
@@ -104,6 +109,7 @@ class SasRec(nn.Module):
     dropout_rate: float = 0.0
     encoder_type: str = "sasrec"
     remat: bool = False
+    use_flash: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -118,6 +124,7 @@ class SasRec(nn.Module):
             dropout_rate=self.dropout_rate,
             encoder_type=self.encoder_type,
             remat=self.remat,
+            use_flash=self.use_flash,
             excluded_features=self.excluded_features,
             dtype=self.dtype,
             name="body",

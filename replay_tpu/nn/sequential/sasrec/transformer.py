@@ -23,6 +23,7 @@ class _SasRecBlock(nn.Module):
     num_heads: int
     hidden_dim: int
     dropout_rate: float = 0.0
+    use_flash: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -31,6 +32,7 @@ class _SasRecBlock(nn.Module):
         h = MultiHeadAttention(
             num_heads=self.num_heads,
             dropout_rate=self.dropout_rate,
+            use_flash=self.use_flash,
             dtype=self.dtype,
             name="attention",
         )(h, attention_mask, deterministic=deterministic)
@@ -57,6 +59,7 @@ class SasRecTransformerLayer(nn.Module):
     hidden_dim: int
     dropout_rate: float = 0.0
     remat: bool = False
+    use_flash: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -76,6 +79,7 @@ class SasRecTransformerLayer(nn.Module):
                 num_heads=self.num_heads,
                 hidden_dim=self.hidden_dim,
                 dropout_rate=self.dropout_rate,
+                use_flash=self.use_flash,
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x, attention_mask, keep, deterministic)

@@ -286,6 +286,7 @@ class Trainer:
         self._tx = self.optimizer.create()
         self._put_batch = _batch_sharding(self.mesh, self.padding_mask_field)
         self._train_step = None
+        self._train_scan = None
         self._eval_logits = None
         self._query_embeddings_fn = None
         self._catalog_fn = None
@@ -407,13 +408,60 @@ class Trainer:
             )
             return new_state, loss_value
 
-        return jax.jit(train_step, donate_argnums=0)
+        return train_step
 
     def train_step(self, state: TrainState, batch: Batch) -> Tuple[TrainState, jnp.ndarray]:
         """One jitted optimizer step on a (data-sharded) batch."""
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = jax.jit(self._build_train_step(), donate_argnums=0)
         return self._train_step(state, self._put_batch(batch))
+
+    def train_steps(
+        self, state: TrainState, batches: Sequence[Batch]
+    ) -> Tuple[TrainState, np.ndarray]:
+        """``len(batches)`` optimizer steps in ONE XLA dispatch (``lax.scan``).
+
+        Amortizes host→device dispatch latency over K steps — the TPU stays busy
+        while the host is out of the loop (one compiled program per chunk
+        length). Returns the per-step losses as a ``[K]`` array. Identical math
+        to K :meth:`train_step` calls.
+        """
+        if self._train_scan is None:
+            step_fn = self._build_train_step()
+            self._train_scan = jax.jit(
+                lambda s, stacked: jax.lax.scan(step_fn, s, stacked), donate_argnums=0
+            )
+        stacked = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *list(batches)
+        )
+        new_state, losses = self._train_scan(state, self._put_stacked(stacked))
+        return new_state, np.asarray(losses)
+
+    def _put_stacked(self, stacked: Batch) -> Batch:
+        """Device placement for a [K, ...] stack of batches: the per-row leaves
+        shard over ``data`` on their SECOND axis (axis 0 is the scan axis)."""
+        multiprocess = jax.process_count() > 1
+        scale = jax.process_count() if multiprocess else 1
+        reference = stacked.get(self.padding_mask_field)
+        local_batch = np.asarray(reference).shape[1] if reference is not None else None
+
+        def place(x):
+            x = np.asarray(x)
+            is_batch_leaf = (
+                x.ndim >= 2
+                and local_batch is not None
+                and x.shape[1] == local_batch
+                and (local_batch * scale) % self.mesh.shape["data"] == 0
+            )
+            if is_batch_leaf:
+                sharding = NamedSharding(self.mesh, P(None, "data", *([None] * (x.ndim - 2))))
+            else:
+                sharding = NamedSharding(self.mesh, P())
+            if multiprocess:
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(place, stacked)
 
     def fit(
         self,
@@ -744,6 +792,7 @@ class Trainer:
         shardings = _params_shardings(self.mesh, params, self.shard_vocab)
         params = _place_tree(params, shardings)
         self._train_step = None  # shapes changed: retrace
+        self._train_scan = None
         self._eval_logits = None
         self._query_embeddings_fn = None
         self._catalog_fn = None
@@ -755,11 +804,17 @@ class Trainer:
         )
 
     # -- checkpointing ------------------------------------------------------ #
-    def save_checkpoint(self, path: str, state: TrainState) -> None:
-        """Write the full TrainState (params + optimizer + PRNG) to ``path``."""
+    def save_checkpoint(
+        self, path: str, state: TrainState, backend: Optional[str] = None
+    ) -> None:
+        """Write the full TrainState (params + optimizer + PRNG) to ``path``.
+
+        ``backend=None`` defers to save_pytree's default: npz on one process,
+        orbax under multi-host (npz would host-gather non-addressable leaves).
+        """
         from replay_tpu.utils.checkpoint import save_pytree
 
-        save_pytree(path, state, {"step": int(state.step)})
+        save_pytree(path, state, {"step": int(state.step)}, backend=backend)
 
     def restore_checkpoint(self, path: str, example_batch: Batch) -> TrainState:
         """Rebuild a TrainState from disk; the example batch supplies the template

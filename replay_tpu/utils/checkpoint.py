@@ -26,15 +26,21 @@ import numpy as np
 
 
 def save_pytree(
-    path: str, tree: Any, metadata: Optional[dict] = None, backend: str = "npz"
+    path: str, tree: Any, metadata: Optional[dict] = None, backend: Optional[str] = None
 ) -> None:
     """Write a pytree's leaves (+ optional JSON metadata) to ``<path>``.
 
-    ``backend="npz"`` (default) stores the flattened leaf list in one ``.npz``;
+    ``backend="npz"`` stores the flattened leaf list in one ``.npz``;
     ``backend="orbax"`` delegates the tree to orbax's StandardCheckpointer
     (sharded/async-capable storage for very large states) — both restore through
-    the same template-driven :func:`restore_pytree`.
+    the same template-driven :func:`restore_pytree`. ``backend=None`` (default)
+    picks npz on one process and orbax under multi-host: npz gathers every leaf
+    to host memory, which raises on leaves that are not fully addressable
+    (e.g. vocab-sharded embeddings with process_count>1), while orbax writes
+    each shard in place.
     """
+    if backend is None:
+        backend = "orbax" if jax.process_count() > 1 else "npz"
     target = Path(path)
     target.parent.mkdir(parents=True, exist_ok=True)
     leaves = jax.tree.leaves(tree)
@@ -74,8 +80,17 @@ def restore_pytree(path: str, template: Any) -> Any:
         import orbax.checkpoint as ocp
 
         checkpointer = ocp.StandardCheckpointer()
-        # abstract target: shapes/dtypes only, no host materialization of the template
-        abstract = jax.eval_shape(lambda t: t, template)
+
+        # abstract target: shapes/dtypes (+ shardings when the template leaves
+        # are live jax.Arrays) — without shardings orbax falls back to
+        # sharding-from-file, which is unsafe when restoring on a different
+        # device topology than the save (the multi-host recovery scenario)
+        def _abstract_leaf(leaf):
+            if isinstance(leaf, jax.Array):
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+            return jax.eval_shape(lambda x: x, leaf)
+
+        abstract = jax.tree.map(_abstract_leaf, template)
         restored = checkpointer.restore(
             (target.parent / (target.name + ".orbax")).absolute(), abstract
         )
@@ -97,6 +112,19 @@ def restore_pytree(path: str, template: Any) -> Any:
                 f"{tuple(np.shape(expected))}."
             )
             raise ValueError(msg)
+        expected_dtype = getattr(expected, "dtype", None)
+        # compare both sides as jax would see them (float64 host arrays mean
+        # float32 under the default x64-disabled config, on the template AND in
+        # an npz written from a host-numpy tree)
+        if expected_dtype is not None and jax.dtypes.canonicalize_dtype(
+            saved.dtype
+        ) != jax.dtypes.canonicalize_dtype(expected_dtype):
+            msg = (
+                f"Leaf {i} dtype {saved.dtype} does not match template "
+                f"{np.dtype(expected_dtype)} — checkpoint saved from a "
+                "different-precision config."
+            )
+            raise ValueError(msg)
     return jax.tree.unflatten(treedef, leaves)
 
 
@@ -112,7 +140,9 @@ class CheckpointManager:
     callback's state_dict).
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3, backend: str = "npz") -> None:
+    def __init__(
+        self, directory: str, max_to_keep: int = 3, backend: Optional[str] = None
+    ) -> None:
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep

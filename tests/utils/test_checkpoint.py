@@ -157,3 +157,47 @@ def test_orbax_backend_roundtrip_and_rotation(tmp_path):
     with pytest.raises(ValueError, match="backend"):
         from replay_tpu.utils.checkpoint import save_pytree
         save_pytree(str(tmp_path / "x"), {"a": jnp.ones(2)}, backend="zzz")
+
+
+@pytest.mark.jax
+def test_restore_rejects_dtype_mismatch(tmp_path):
+    """A checkpoint saved from a different-precision config is a hard error,
+    not a silent mixed-precision restore."""
+    save_pytree(str(tmp_path / "f32"), {"w": jnp.ones((2, 2), jnp.float32)})
+    with pytest.raises(ValueError, match="dtype"):
+        restore_pytree(str(tmp_path / "f32"), {"w": np.zeros((2, 2), np.float16)})
+
+
+@pytest.mark.jax
+def test_orbax_abstract_target_carries_sharding(tmp_path):
+    """Orbax restore targets built from live jax.Arrays keep their sharding, so
+    restore does not fall back to (topology-unsafe) sharding-from-file."""
+    pytest.importorskip("orbax.checkpoint")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh()
+    tree = {"w": jax.device_put(jnp.ones((4, 4)), NamedSharding(mesh, P()))}
+    save_pytree(str(tmp_path / "s"), tree, backend="orbax")
+    with np.errstate(all="ignore"):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)  # sharding-from-file warns
+            restored = restore_pytree(str(tmp_path / "s"), tree)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones((4, 4)))
+
+
+@pytest.mark.jax
+def test_trainer_save_checkpoint_backend_param(tmp_path):
+    """Trainer.save_checkpoint honors an explicit backend choice."""
+    pytest.importorskip("orbax.checkpoint")
+    trainer = make_trainer()
+    state = trainer.init_state(make_batch(0))
+    trainer.save_checkpoint(str(tmp_path / "ck"), state, backend="orbax")
+    assert (tmp_path / "ck.orbax").exists()
+    restored = trainer.restore_checkpoint(str(tmp_path / "ck"), make_batch(0))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6),
+        restored.params,
+        state.params,
+    )
