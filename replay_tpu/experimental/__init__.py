@@ -8,9 +8,25 @@ pattern in replay_tpu.utils.types is the extension seam to add them where the
 libraries exist.
 """
 
+from .admm_slim import ADMMSLIM
+from .cql import CQL, MdpDatasetBuilder
+from .ddpg import DDPG
 from .dt4rec import DT4Rec
+from .hierarchical import HierarchicalRecommender
 from .mult_vae import MultVAE
 from .neural_ts import NeuralTS
 from .neuro_mf import NeuroMF
+from .u_lin_ucb import ULinUCB
 
-__all__ = ["DT4Rec", "MultVAE", "NeuralTS", "NeuroMF"]
+__all__ = [
+    "ADMMSLIM",
+    "CQL",
+    "DDPG",
+    "DT4Rec",
+    "HierarchicalRecommender",
+    "MdpDatasetBuilder",
+    "MultVAE",
+    "NeuralTS",
+    "NeuroMF",
+    "ULinUCB",
+]

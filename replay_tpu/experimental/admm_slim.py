@@ -1,0 +1,130 @@
+"""ADMM SLIM: sparse item-item weights via the alternating direction method.
+
+Capability parity with replay/experimental/models/admm_slim.py:68 (ADMMSLIM:
+B-update from a cached inverse, zero-diagonal correction, L1 soft-threshold
+C-update, dual update, adaptive rho, primal/dual-residual stopping rule —
+the numba kernel at :17-65) on the NeighbourRec predict contract.
+
+TPU design: the reference runs a numba-parallel host kernel per iteration; here
+the whole ADMM loop is ONE ``lax.while_loop`` program — the [I, I] matrix
+updates are MXU matmuls and the data-dependent stopping rule stays on device
+(compiler-friendly control flow instead of a host-side while). As in the
+reference, the inverse is computed once with the initial rho and reused across
+rho adaptations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.models.knn import ItemKNN
+
+
+class ADMMSLIM(ItemKNN):
+    """SLIM with ADMM optimization (WSDM'20), adaptive-rho variant."""
+
+    # soft-thresholded weights are signed; negative-score recs stay valid
+    _drop_nonpositive_scores = False
+
+    threshold: float = 5.0
+    multiplicator: float = 2.0
+    eps_abs: float = 1.0e-3
+    eps_rel: float = 1.0e-3
+    max_iteration: int = 100
+
+    _init_arg_names = ["lambda_1", "lambda_2", "seed"]
+    _search_space = {
+        "lambda_1": {"type": "loguniform", "args": [1e-9, 50]},
+        "lambda_2": {"type": "loguniform", "args": [1e-9, 5000]},
+    }
+
+    def __init__(
+        self,
+        lambda_1: float = 5.0,
+        lambda_2: float = 5000.0,
+        seed: Optional[int] = None,
+    ) -> None:
+        super().__init__(num_neighbours=None)
+        if lambda_1 < 0 or lambda_2 <= 0:
+            msg = "Invalid regularization parameters"
+            raise ValueError(msg)
+        self.lambda_1 = lambda_1
+        self.lambda_2 = lambda_2
+        self.rho = lambda_2
+        self.seed = seed
+
+    def _fit(self, dataset: Dataset) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        matrix = self._interaction_matrix(dataset)  # [U, I]
+        n_items = matrix.shape[1]
+        xtx = jnp.asarray(matrix.T @ matrix)
+        lambda_1, eps_abs, eps_rel = self.lambda_1, self.eps_abs, self.eps_rel
+        threshold, multiplicator = self.threshold, self.multiplicator
+        max_iteration = self.max_iteration
+
+        rng = np.random.default_rng(self.seed)
+        init_b = jnp.asarray(rng.random((n_items, n_items), np.float32))
+        init_c = jnp.asarray(rng.random((n_items, n_items), np.float32))
+        init_gamma = jnp.asarray(rng.random((n_items, n_items), np.float32))
+
+        @jax.jit
+        def solve(xtx, mat_b, mat_c, mat_gamma):
+            # the inverse is computed ONCE with the initial rho and reused
+            # across rho adaptations, exactly like the reference (:158)
+            inv_matrix = jnp.linalg.inv(
+                xtx + (self.lambda_2 + self.rho) * jnp.eye(n_items, dtype=xtx.dtype)
+            )
+            p_x = inv_matrix @ xtx
+            inv_diag = jnp.diag(inv_matrix)
+
+            def body(carry):
+                mat_b, mat_c, mat_gamma, rho, *_ , iteration = carry
+                mat_b = p_x + inv_matrix @ (rho * mat_c - mat_gamma)
+                vec_gamma = jnp.diag(mat_b) / inv_diag
+                mat_b = mat_b - inv_matrix * vec_gamma  # zero-diagonal correction
+                prev_c = mat_c
+                mat_c = mat_b + mat_gamma / rho
+                coef = lambda_1 / rho
+                mat_c = jnp.maximum(mat_c - coef, 0.0) - jnp.maximum(-mat_c - coef, 0.0)
+                mat_gamma = mat_gamma + rho * (mat_b - mat_c)
+                r_primal = jnp.linalg.norm(mat_b - mat_c)
+                r_dual = jnp.linalg.norm(-rho * (mat_c - prev_c))
+                eps_primal = eps_abs * n_items + eps_rel * jnp.maximum(
+                    jnp.linalg.norm(mat_b), jnp.linalg.norm(mat_c)
+                )
+                eps_dual = eps_abs * n_items + eps_rel * jnp.linalg.norm(mat_gamma)
+                rho = jnp.where(
+                    r_primal > threshold * r_dual,
+                    rho * multiplicator,
+                    jnp.where(threshold * r_primal < r_dual, rho / multiplicator, rho),
+                )
+                return (
+                    mat_b, mat_c, mat_gamma, rho,
+                    r_primal, r_dual, eps_primal, eps_dual, iteration + 1,
+                )
+
+            def cond(carry):
+                *_, r_primal, r_dual, eps_primal, eps_dual, iteration = carry
+                return ((r_primal > eps_primal) | (r_dual > eps_dual)) & (
+                    iteration < max_iteration
+                )
+
+            init = (
+                mat_b, mat_c, mat_gamma, jnp.asarray(self.rho, xtx.dtype),
+                jnp.linalg.norm(mat_b - mat_c),
+                jnp.linalg.norm(self.rho * mat_c),
+                jnp.zeros((), xtx.dtype),
+                jnp.zeros((), xtx.dtype),
+                jnp.zeros((), jnp.int32),
+            )
+            final = jax.lax.while_loop(cond, body, init)
+            return final[1], final[8]  # mat_c, iterations
+
+        mat_c, iterations = solve(xtx, init_b, init_c, init_gamma)
+        self.num_fit_iterations = int(iterations)
+        self.similarity = np.asarray(mat_c, np.float32)

@@ -1,0 +1,406 @@
+"""DDPG recommender (DRR state representation + deterministic policy gradient).
+
+Capability parity with replay/experimental/models/ddpg.py:475 (DDPG over the
+DRR actor of :154 — state = [user_emb, user_emb*drr_ave, drr_ave] where
+drr_ave is a learned weighted average of the last ``memory_size`` relevant
+items — with the multi-head quantile critic of :234 (Bayes-UCBDQN), a
+simulated interaction Env (:281) that rewards recommending a user's logged
+items and rolls their memory, a replay buffer, gaussian/OU action noise and
+Polyak-averaged target networks).
+
+TPU design: the environment rollout is a ``lax.scan`` over trajectory steps
+for a whole user batch at once — memory updates, reward lookup against the
+user-item matrix and the already-recommended mask are device ops with static
+shapes (candidates = the full catalog with masking, instead of the
+reference's per-user python resampling of a dynamic candidate set). Gradient
+updates are one jitted step over minibatches drawn from the on-device
+transition store.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.models.base import BaseRecommender
+
+
+class DDPG(BaseRecommender):
+    """Deep deterministic policy gradient with the DRR state encoder."""
+
+    min_value: float = -10.0
+    max_value: float = 10.0
+
+    _init_arg_names = [
+        "embedding_dim",
+        "hidden_dim",
+        "memory_size",
+        "gamma",
+        "tau",
+        "value_lr",
+        "policy_lr",
+        "noise_sigma",
+        "noise_theta",
+        "noise_type",
+        "n_critics_head",
+        "critic_heads_q",
+        "user_batch_size",
+        "trajectory_len",
+        "epochs",
+        "batch_size",
+        "seed",
+    ]
+    _search_space = {
+        "noise_sigma": {"type": "uniform", "args": [0.1, 0.6]},
+        "gamma": {"type": "uniform", "args": [0.7, 1.0]},
+        "value_lr": {"type": "loguniform", "args": [1e-7, 1e-1]},
+        "policy_lr": {"type": "loguniform", "args": [1e-7, 1e-1]},
+        "memory_size": {"type": "categorical", "args": [3, 5, 7, 9]},
+        "noise_type": {"type": "categorical", "args": ["gauss", "ou"]},
+    }
+
+    def __init__(
+        self,
+        embedding_dim: int = 8,
+        hidden_dim: int = 16,
+        memory_size: int = 5,
+        gamma: float = 0.8,
+        tau: float = 1e-3,
+        value_lr: float = 1e-5,
+        policy_lr: float = 1e-5,
+        noise_sigma: float = 0.2,
+        noise_theta: float = 0.05,
+        noise_type: str = "gauss",
+        n_critics_head: int = 10,
+        critic_heads_q: float = 0.15,
+        user_batch_size: int = 8,
+        trajectory_len: int = 10,
+        epochs: int = 1,
+        batch_size: int = 512,
+        seed: Optional[int] = 9,
+    ) -> None:
+        super().__init__()
+        if noise_type not in ("gauss", "ou"):
+            msg = "noise_type must be one of ['gauss', 'ou']"
+            raise ValueError(msg)
+        self.embedding_dim = embedding_dim
+        self.hidden_dim = hidden_dim
+        self.memory_size = memory_size
+        self.gamma = gamma
+        self.tau = tau
+        self.value_lr = value_lr
+        self.policy_lr = policy_lr
+        self.noise_sigma = noise_sigma
+        self.noise_theta = noise_theta
+        self.noise_type = noise_type
+        self.n_critics_head = n_critics_head
+        self.critic_heads_q = critic_heads_q
+        self.user_batch_size = user_batch_size
+        self.trajectory_len = trajectory_len
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self._params = None
+        self.memory: Optional[np.ndarray] = None  # [U, M] item positions
+        self.loss_history: list = []
+
+    # -- networks ----------------------------------------------------------- #
+    def _build(self, n_users: int, n_items: int):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        emb, hidden, mem = self.embedding_dim, self.hidden_dim, self.memory_size
+        heads, heads_q = self.n_critics_head, self.critic_heads_q
+
+        class StateRepr(nn.Module):
+            @nn.compact
+            def __call__(self, user, memory):
+                user_emb = nn.Embed(n_users, emb, name="user_embeddings")(user)
+                # row n_items is the zero-init padding slot for empty memory
+                item_table = nn.Embed(n_items + 1, emb, name="item_embeddings")
+                mem_emb = item_table(memory)  # [B, M, E]
+                weights = self.param("drr_weights", nn.initializers.normal(0.1), (mem,))
+                bias = self.param("drr_bias", nn.initializers.zeros, (1,))
+                drr_ave = jnp.einsum("m,bme->be", weights, mem_emb) + bias
+                return jnp.concatenate([user_emb, user_emb * drr_ave, drr_ave], axis=-1)
+
+        class Actor(nn.Module):
+            @nn.compact
+            def __call__(self, state):
+                h = nn.relu(nn.LayerNorm()(nn.Dense(hidden)(state)))
+                return nn.Dense(emb)(h)
+
+        class Critic(nn.Module):
+            @nn.compact
+            def __call__(self, state, action):
+                x = jnp.concatenate([state, action], axis=-1)
+                h = nn.relu(nn.LayerNorm()(nn.Dense(hidden)(x)))
+                outs = jnp.stack(
+                    [nn.Dense(1, name=f"head_{i}")(h)[..., 0] for i in range(heads)]
+                )
+                return jnp.quantile(outs, heads_q, axis=0)
+
+        return StateRepr(), Actor(), Critic()
+
+    # -- fit ---------------------------------------------------------------- #
+    def _fit(self, dataset: Dataset) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        interactions = dataset.interactions
+        q_index = pd.Index(self.fit_queries)
+        i_index = pd.Index(self.fit_items)
+        n_users, n_items = len(q_index), len(i_index)
+        rows = q_index.get_indexer(interactions[self.query_column])
+        cols = i_index.get_indexer(interactions[self.item_column])
+        related = np.zeros((n_users, n_items), np.float32)
+        related[rows, cols] = 1.0
+        related_dev = jnp.asarray(related)
+
+        state_repr, actor, critic = self._build(n_users, n_items)
+        rng = jax.random.PRNGKey(self.seed or 0)
+        rng, s_rng, a_rng, c_rng = jax.random.split(rng, 4)
+        dummy_u = jnp.zeros((1,), jnp.int32)
+        dummy_m = jnp.full((1, self.memory_size), n_items, jnp.int32)
+        sr_params = state_repr.init(s_rng, dummy_u, dummy_m)
+        dummy_state = state_repr.apply(sr_params, dummy_u, dummy_m)
+        actor_params = actor.init(a_rng, dummy_state)
+        critic_params = critic.init(c_rng, dummy_state, jnp.zeros((1, self.embedding_dim)))
+        params = {
+            "state": sr_params,
+            "actor": actor_params,
+            "critic": critic_params,
+            "t_state": sr_params,
+            "t_actor": actor_params,
+            "t_critic": critic_params,
+        }
+        policy_tx = optax.adam(self.policy_lr)
+        value_tx = optax.adam(self.value_lr)
+        opt_state = {
+            "policy": policy_tx.init({"state": sr_params, "actor": actor_params}),
+            "value": value_tx.init(params["critic"]),
+        }
+
+        gamma, tau = self.gamma, self.tau
+        sigma, theta = self.noise_sigma, self.noise_theta
+        use_ou = self.noise_type == "ou"
+        min_v, max_v = self.min_value, self.max_value
+
+        def actor_forward(p_state, p_actor, users, memory):
+            state = state_repr.apply(p_state, users, memory)
+            return state, actor.apply(p_actor, state)
+
+        def rollout(params, users, memory, rng):
+            """T env steps for one user batch → stacked transitions."""
+            item_table = params["state"]["params"]["item_embeddings"]["embedding"]
+
+            def step(carry, step_rng):
+                memory, taken, noise = carry
+                state, action_emb = actor_forward(
+                    params["state"], params["actor"], users, memory
+                )
+                if use_ou:
+                    noise = (
+                        noise
+                        - theta * noise
+                        + sigma * jax.random.normal(step_rng, action_emb.shape)
+                    )
+                    noisy = action_emb + noise
+                else:
+                    noisy = action_emb + sigma * jax.random.normal(
+                        step_rng, action_emb.shape
+                    )
+                scores = noisy @ item_table[:n_items].T  # [B, I]
+                scores = jnp.where(taken > 0, -jnp.inf, scores)
+                chosen = jnp.argmax(scores, axis=-1)  # [B]
+                reward = related_dev[users, chosen]
+                # roll memory left and append on reward, else keep
+                rolled = jnp.concatenate([memory[:, 1:], chosen[:, None]], axis=1)
+                new_memory = jnp.where((reward > 0)[:, None], rolled, memory)
+                new_taken = taken.at[jnp.arange(users.shape[0]), chosen].set(1.0)
+                transition = (memory, noisy, reward, new_memory)
+                return (new_memory, new_taken, noise), transition
+
+            taken0 = jnp.zeros((users.shape[0], n_items))
+            noise0 = jnp.zeros((users.shape[0], self.embedding_dim))
+            step_rngs = jax.random.split(rng, self.trajectory_len)
+            (memory, _, _), transitions = jax.lax.scan(
+                step, (memory, taken0, noise0), step_rngs
+            )
+            return memory, transitions
+
+        rollout = jax.jit(rollout)
+
+        def update(params, opt_state, batch):
+            users, memory, action, reward, next_memory = batch
+
+            def value_loss_fn(critic_params):
+                state = state_repr.apply(params["state"], users, memory)
+                next_state = state_repr.apply(params["t_state"], users, next_memory)
+                next_action = actor.apply(params["t_actor"], next_state)
+                target_q = critic.apply(params["t_critic"], next_state, next_action)
+                # every transition continues the episode (done=0), ref :576
+                expected = jnp.clip(reward + gamma * target_q, min_v, max_v)
+                value = critic.apply(critic_params, state, action)
+                return jnp.mean((value - jax.lax.stop_gradient(expected)) ** 2)
+
+            def policy_loss_fn(p):
+                state = state_repr.apply(p["state"], users, memory)
+                proto = actor.apply(p["actor"], state)
+                return -jnp.mean(
+                    critic.apply(
+                        params["critic"], jax.lax.stop_gradient(state), proto
+                    )
+                )
+
+            value_loss, value_grads = jax.value_and_grad(value_loss_fn)(params["critic"])
+            policy_loss, policy_grads = jax.value_and_grad(policy_loss_fn)(
+                {"state": params["state"], "actor": params["actor"]}
+            )
+            up, new_value_opt = value_tx.update(value_grads, opt_state["value"])
+            new_critic = optax.apply_updates(params["critic"], up)
+            up, new_policy_opt = policy_tx.update(policy_grads, opt_state["policy"])
+            new_sa = optax.apply_updates(
+                {"state": params["state"], "actor": params["actor"]}, up
+            )
+            polyak = lambda t, c: jax.tree.map(
+                lambda a, b: (1.0 - tau) * a + tau * b, t, c
+            )
+            new_params = {
+                "state": new_sa["state"],
+                "actor": new_sa["actor"],
+                "critic": new_critic,
+                "t_state": polyak(params["t_state"], new_sa["state"]),
+                "t_actor": polyak(params["t_actor"], new_sa["actor"]),
+                "t_critic": polyak(params["t_critic"], new_critic),
+            }
+            new_opt = {"policy": new_policy_opt, "value": new_value_opt}
+            return new_params, new_opt, jnp.stack([value_loss, policy_loss])
+
+        update = jax.jit(update)
+
+        memory_all = np.full((n_users, self.memory_size), n_items, np.int32)
+        # preallocated ring buffer: per-iteration appends and samples are O(1)
+        # in the total transition count (reference buffer_size analogue)
+        capacity = min(1_000_000, max(self.epochs * n_users * self.trajectory_len, 1))
+        ring = {
+            "users": np.zeros(capacity, np.int32),
+            "memory": np.zeros((capacity, self.memory_size), np.int32),
+            "action": np.zeros((capacity, self.embedding_dim), np.float32),
+            "reward": np.zeros(capacity, np.float32),
+            "next_memory": np.zeros((capacity, self.memory_size), np.int32),
+        }
+        write_pos, filled = 0, 0
+
+        def push(key, values):
+            count = len(values)
+            span = np.arange(write_pos, write_pos + count) % capacity
+            ring[key][span] = values
+
+        np_rng = np.random.default_rng(self.seed)
+        losses = []
+        for _ in range(self.epochs):
+            order = np_rng.permutation(n_users)
+            for start in range(0, n_users, self.user_batch_size):
+                batch_users = order[start : start + self.user_batch_size]
+                rng, roll_rng = jax.random.split(rng)
+                new_memory, transitions = rollout(
+                    params,
+                    jnp.asarray(batch_users),
+                    jnp.asarray(memory_all[batch_users]),
+                    roll_rng,
+                )
+                memory_all[batch_users] = np.asarray(new_memory)
+                mem_t, act_t, rew_t, next_t = (np.asarray(t) for t in transitions)
+                steps = mem_t.shape[0]
+                count = steps * len(batch_users)
+                push("users", np.tile(batch_users, steps))
+                push("memory", mem_t.reshape(-1, self.memory_size))
+                push("action", act_t.reshape(-1, self.embedding_dim))
+                push("reward", rew_t.reshape(-1))
+                push("next_memory", next_t.reshape(-1, self.memory_size))
+                write_pos = (write_pos + count) % capacity
+                filled = min(filled + count, capacity)
+                if filled >= self.batch_size:
+                    idx = np_rng.integers(0, filled, self.batch_size)
+                    params, opt_state, step_losses = update(
+                        params,
+                        opt_state,
+                        (
+                            jnp.asarray(ring["users"][idx]),
+                            jnp.asarray(ring["memory"][idx]),
+                            jnp.asarray(ring["action"][idx]),
+                            jnp.asarray(ring["reward"][idx]),
+                            jnp.asarray(ring["next_memory"][idx]),
+                        ),
+                    )
+                    losses.append(np.asarray(step_losses))
+
+        self._params = jax.tree.map(np.asarray, params)
+        self.memory = memory_all
+        self.loss_history = np.asarray(losses) if losses else np.zeros((0, 2))
+        self._state_repr, self._actor, self._critic = state_repr, actor, critic
+
+    # -- predict ------------------------------------------------------------ #
+    def _dense_scores(self, dataset, queries, items):
+        import jax.numpy as jnp
+
+        q_pos = pd.Index(self.fit_queries).get_indexer(np.asarray(queries))
+        i_pos = pd.Index(self.fit_items).get_indexer(np.asarray(items))
+        known_q, known_i = q_pos >= 0, i_pos >= 0
+        n_items = len(self.fit_items)
+        state_repr, actor, _ = self._build(len(self.fit_queries), n_items)
+        users = jnp.asarray(q_pos[known_q])
+        memory = jnp.asarray(self.memory[q_pos[known_q]])
+        state = state_repr.apply(self._params["state"], users, memory)
+        action = actor.apply(self._params["actor"], state)
+        table = self._params["state"]["params"]["item_embeddings"]["embedding"]
+        scores = action @ table[:n_items].T
+        return (
+            scores[:, i_pos[known_i]],
+            np.asarray(queries)[known_q],
+            np.asarray(items)[known_i],
+        )
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        return self._dense_block_frame(*self._dense_scores(dataset, queries, items))
+
+    # -- save / load --------------------------------------------------------- #
+    def _save_model(self, target: Path) -> None:
+        import jax
+
+        leaves, _ = jax.tree_util.tree_flatten(self._params)
+        np.savez_compressed(
+            target / "ddpg.npz",
+            memory=self.memory,
+            **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)},
+        )
+
+    def _load_model(self, source: Path) -> None:
+        import jax
+
+        with np.load(source / "ddpg.npz") as payload:
+            self.memory = payload["memory"]
+            leaves = [payload[f"leaf_{i}"] for i in range(len(payload.files) - 1)]
+        n_users, n_items = len(self.fit_queries), len(self.fit_items)
+        state_repr, actor, critic = self._build(n_users, n_items)
+        import jax.numpy as jnp
+
+        rng = jax.random.PRNGKey(0)
+        dummy_u = jnp.zeros((1,), jnp.int32)
+        dummy_m = jnp.full((1, self.memory_size), n_items, jnp.int32)
+        sr = state_repr.init(rng, dummy_u, dummy_m)
+        state = state_repr.apply(sr, dummy_u, dummy_m)
+        ap = actor.init(rng, state)
+        cp = critic.init(rng, state, jnp.zeros((1, self.embedding_dim)))
+        template = {
+            "state": sr, "actor": ap, "critic": cp,
+            "t_state": sr, "t_actor": ap, "t_critic": cp,
+        }
+        _, treedef = jax.tree_util.tree_flatten(template)
+        self._params = jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,264 @@
+"""HierarchicalRecommender: a recommender tree over clustered item space (HCB).
+
+Capability parity with replay/experimental/models/hierarchical_recommender.py:13
+(Song et al., arXiv 2110.09905 generalized): the item space is recursively
+clustered into a tree of given ``depth``; every node mounts a fresh recommender
+(default :class:`~replay_tpu.experimental.u_lin_ucb.ULinUCB`) trained on the
+log with items relabeled to the node's cluster ids and cluster CENTROIDS as
+item features; prediction walks the tree — each non-leaf picks one child per
+user (k=1, no seen-filter), leaves emit the final k items (ref Node:129-242,
+Clusterer:245-319, DiscreteClusterer:322).
+
+The cluster model is any object with the sklearn ``fit_predict(X) -> labels``
+API (sklearn ships in this image); leaves use the discrete one-item-per-cluster
+assignment like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.data.schema import (
+    FeatureHint,
+    FeatureInfo,
+    FeatureSchema,
+    FeatureSource,
+    FeatureType,
+)
+from replay_tpu.models.base import BaseRecommender
+
+from .u_lin_ucb import ULinUCB
+
+
+class DiscreteClusterer:
+    """Every item is its own cluster (leaf level, ref :322)."""
+
+    def fit_predict(self, features: np.ndarray) -> np.ndarray:
+        self.cluster_centers_ = features
+        return np.arange(features.shape[0])
+
+
+class _Clusterer:
+    """Item-id ↔ cluster-id maps + centroid features around a cluster model."""
+
+    def __init__(self, model) -> None:
+        self._model = model
+
+    def fit(self, items: pd.DataFrame, item_column: str) -> None:
+        items = items.sort_values(by=item_column)
+        ids = items[item_column].to_numpy()
+        features = items.drop(columns=item_column).to_numpy(np.float64)
+        raw = np.asarray(self._model.fit_predict(features))
+        # compact labels to 0..C-1 in first-appearance order (sklearn already
+        # returns compact labels; this guards custom models)
+        _, labels = np.unique(raw, return_inverse=True)
+        self._item_to_cluster = dict(zip(ids, labels))
+        self._cluster_to_item = dict(zip(labels, ids))  # meaningful for leaves
+        frame = pd.DataFrame(features)
+        frame["__cluster"] = labels
+        centers = frame.groupby("__cluster").mean().sort_index()
+        self._centers = centers.to_numpy(np.float64)
+        self.num_clusters = len(centers)
+
+    def predict(self, item_ids) -> np.ndarray:
+        return np.asarray(pd.Series(np.asarray(item_ids)).map(self._item_to_cluster))
+
+    def predict_items(self, cluster_ids) -> np.ndarray:
+        return np.asarray(pd.Series(np.asarray(cluster_ids)).map(self._cluster_to_item))
+
+    def centers_frame(self, item_column: str) -> pd.DataFrame:
+        frame = pd.DataFrame(
+            self._centers, columns=[f"f_{i}" for i in range(self._centers.shape[1])]
+        )
+        frame.insert(0, item_column, np.arange(self.num_clusters))
+        return frame
+
+
+class _Node:
+    def __init__(self, tree: "HierarchicalRecommender", level: int) -> None:
+        self.tree = tree
+        self.level = level
+        self.is_leaf = level == tree.depth - 1
+        self.children: Optional[list] = None
+        self.clusterer = _Clusterer(
+            DiscreteClusterer() if self.is_leaf else tree._make_cluster_model()
+        )
+        self.recommender = tree.recommender_class(**tree.recommender_params)
+
+    def procreate(self, items: pd.DataFrame, item_column: str) -> None:
+        self.clusterer.fit(items, item_column)
+        if not self.is_leaf:
+            labels = self.clusterer.predict(items[item_column])
+            self.children = [None] * self.clusterer.num_clusters
+            for cluster_id, cluster_items in items.groupby(labels):
+                child = _Node(self.tree, self.level + 1)
+                child.procreate(cluster_items, item_column)
+                self.children[int(cluster_id)] = child
+
+    def fit(self, log: pd.DataFrame, user_features: Optional[pd.DataFrame]) -> None:
+        tree = self.tree
+        clusters = self.clusterer.predict(log[tree.item_column])
+        if not self.is_leaf:
+            for cluster_id, cluster_log in log.groupby(clusters):
+                self.children[int(cluster_id)].fit(cluster_log, user_features)
+        relabeled = log.drop(columns=tree.item_column).assign(
+            **{tree.item_column: clusters}
+        )
+        self.recommender.fit(
+            tree._node_dataset(relabeled, self.clusterer.centers_frame(tree.item_column))
+        )
+
+    def predict(
+        self,
+        log: pd.DataFrame,
+        k: int,
+        users: np.ndarray,
+        items: pd.DataFrame,
+        filter_seen_items: bool,
+    ) -> pd.DataFrame:
+        tree = self.tree
+        log_clusters = self.clusterer.predict(log[tree.item_column])
+        relabeled_log = log.drop(columns=tree.item_column).assign(
+            **{tree.item_column: log_clusters}
+        )
+        if self.is_leaf:
+            dataset = tree._node_dataset(
+                relabeled_log, self.clusterer.centers_frame(tree.item_column)
+            )
+            pred = self.recommender.predict(
+                dataset, k, queries=users, filter_seen_items=filter_seen_items
+            )
+            pred[tree.item_column] = self.clusterer.predict_items(pred[tree.item_column])
+            return pred
+        dataset = tree._node_dataset(
+            relabeled_log, self.clusterer.centers_frame(tree.item_column)
+        )
+        routed = self.recommender.predict(
+            dataset, 1, queries=users, filter_seen_items=False
+        )
+        item_clusters = self.clusterer.predict(items[tree.item_column])
+        parts = []
+        for cluster_id, routed_users in routed.groupby(tree.item_column):
+            child = self.children[int(cluster_id)]
+            keep = log_clusters == cluster_id
+            parts.append(
+                child.predict(
+                    log[keep],
+                    k,
+                    routed_users[tree.query_column].to_numpy(),
+                    items[item_clusters == cluster_id],
+                    filter_seen_items,
+                )
+            )
+        if not parts:
+            return pd.DataFrame(columns=[tree.query_column, tree.item_column, "rating"])
+        return pd.concat(parts, ignore_index=True)
+
+
+class HierarchicalRecommender(BaseRecommender):
+    """Recommender tree over a clustered item space (HCB by default)."""
+
+    _init_arg_names = ["depth", "num_clusters", "recommender_params"]
+
+    def __init__(
+        self,
+        depth: int = 2,
+        cluster_model=None,
+        num_clusters: int = 8,
+        recommender_class: Type[BaseRecommender] = ULinUCB,
+        recommender_params: Optional[dict] = None,
+    ) -> None:
+        super().__init__()
+        if depth < 1:
+            msg = "depth must be >= 1"
+            raise ValueError(msg)
+        self.depth = depth
+        self.cluster_model = cluster_model
+        self.num_clusters = num_clusters
+        self.recommender_class = recommender_class
+        self.recommender_params = dict(recommender_params or {})
+        self.root: Optional[_Node] = None
+
+    def _make_cluster_model(self):
+        if self.cluster_model is not None:
+            import copy
+
+            return copy.deepcopy(self.cluster_model)
+        from sklearn.cluster import KMeans
+
+        return KMeans(n_clusters=self.num_clusters, n_init=4, random_state=0)
+
+    def _node_dataset(self, log: pd.DataFrame, item_features: pd.DataFrame) -> Dataset:
+        features = [
+            FeatureInfo(self.query_column, FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+            FeatureInfo(self.item_column, FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+        ]
+        if self.rating_column and self.rating_column in log:
+            features.append(
+                FeatureInfo(self.rating_column, FeatureType.NUMERICAL, FeatureHint.RATING)
+            )
+        if self.timestamp_column and self.timestamp_column in log:
+            features.append(
+                FeatureInfo(
+                    self.timestamp_column, FeatureType.NUMERICAL, FeatureHint.TIMESTAMP
+                )
+            )
+        features += [
+            FeatureInfo(c, FeatureType.NUMERICAL, feature_source=FeatureSource.ITEM_FEATURES)
+            for c in item_features.columns
+            if c != self.item_column
+        ]
+        return Dataset(
+            feature_schema=FeatureSchema(features),
+            interactions=log.reset_index(drop=True),
+            item_features=item_features,
+            check_consistency=False,
+        )
+
+    def _fit(self, dataset: Dataset) -> None:
+        if dataset.item_features is None:
+            msg = "HierarchicalRecommender needs dataset.item_features for clustering"
+            raise ValueError(msg)
+        self.root = _Node(self, level=0)
+        self.root.procreate(dataset.item_features.copy(), self.item_column)
+        self.root.fit(dataset.interactions, dataset.query_features)
+
+    def predict(
+        self,
+        dataset: Optional[Dataset],
+        k: int,
+        queries=None,
+        items=None,
+        filter_seen_items: bool = True,
+    ) -> pd.DataFrame:
+        """Tree-walk prediction (overrides the dense base pipeline: the
+        seen-filter and top-k happen inside each leaf's recommender)."""
+        self._check_fitted()
+        if dataset is None:
+            msg = (
+                "HierarchicalRecommender needs the dataset at predict time "
+                "(interactions route users through the tree; item_features "
+                "carry the clustered catalog)."
+            )
+            raise ValueError(msg)
+        interactions = dataset.interactions
+        if queries is None:
+            queries = np.sort(interactions[self.query_column].unique())
+        else:
+            queries = np.sort(np.asarray(pd.Series(queries).unique()))
+        item_frame = dataset.item_features
+        if items is not None:
+            wanted = np.asarray(pd.Series(items).unique())
+            item_frame = item_frame[item_frame[self.item_column].isin(wanted)]
+        pred = self.root.predict(
+            interactions, k, np.asarray(queries), item_frame, filter_seen_items
+        )
+        return self._top_k(pred, k)
+
+    def _save_model(self, target) -> None:  # pragma: no cover - structural
+        msg = "HierarchicalRecommender does not support save/load (fit is cheap; refit instead)"
+        raise NotImplementedError(msg)

@@ -1,0 +1,127 @@
+"""ULinUCB: user-disjoint linear UCB over item features.
+
+Capability parity with replay/experimental/models/u_lin_ucb.py:11 (Song et al.,
+arXiv 2110.09905): a SHARED design matrix A and reward vector b accumulated
+sequentially over users (sorted by id), with each user's theta and UCB row
+computed at their point in the sweep — the model the HierarchicalRecommender
+mounts at every tree node by default.
+
+TPU design: the reference's per-user python loop becomes one ``lax.scan`` over
+the user axis with per-user interaction lists padded to a static width: the
+rank-update of A, the [D, D] solve and the [I] UCB row all run per scan tick
+on device.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.models.base import BaseRecommender
+
+
+class ULinUCB(BaseRecommender):
+    """User-disjoint LinUCB (contextual bandit over item features)."""
+
+    can_predict_cold_queries = True  # unseen users score zero on every arm
+
+    _init_arg_names = ["alpha"]
+    _search_space = {"alpha": {"type": "uniform", "args": [-5.0, 5.0]}}
+
+    def __init__(self, alpha: float = -2.0) -> None:
+        super().__init__()
+        self.alpha = alpha
+        self.ucb: Optional[np.ndarray] = None  # [U_fit, I_fit]
+
+    def _item_feature_matrix(self, dataset: Dataset) -> np.ndarray:
+        if dataset.item_features is None:
+            msg = "ULinUCB needs dataset.item_features"
+            raise ValueError(msg)
+        features = dataset.item_features
+        features = (
+            features.set_index(self.item_column)
+            .loc[pd.Index(self.fit_items)]
+            .to_numpy(np.float32)
+        )
+        return features
+
+    def _fit(self, dataset: Dataset) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        interactions = dataset.interactions
+        features = self._item_feature_matrix(dataset)  # [I, D]
+        n_items, dim = features.shape
+        q_index = pd.Index(self.fit_queries)
+        i_index = pd.Index(self.fit_items)
+        rows = q_index.get_indexer(interactions[self.query_column])
+        cols = i_index.get_indexer(interactions[self.item_column])
+        rewards = (
+            interactions[self.rating_column].to_numpy(np.float32)
+            if self.rating_column
+            else np.ones(len(interactions), np.float32)
+        )
+        n_users = len(q_index)
+        counts = np.bincount(rows, minlength=n_users)
+        width = max(int(counts.max()), 1)
+        order = np.argsort(rows, kind="stable")
+        positions = np.concatenate([np.arange(c) for c in counts]) if len(rows) else np.zeros(0, int)
+        item_pad = np.zeros((n_users, width), np.int32)
+        reward_pad = np.zeros((n_users, width), np.float32)
+        mask_pad = np.zeros((n_users, width), np.float32)
+        item_pad[rows[order], positions] = cols[order]
+        reward_pad[rows[order], positions] = rewards[order]
+        mask_pad[rows[order], positions] = 1.0
+
+        alpha = self.alpha
+        feats = jnp.asarray(features)
+
+        @jax.jit
+        def sweep(item_pad, reward_pad, mask_pad):
+            def step(carry, per_user):
+                mat_a, vec_b = carry
+                items, rewards, mask = per_user
+                f = feats[items] * mask[:, None]  # padded rows vanish
+                mat_a = mat_a + f.T @ f
+                vec_b = vec_b + f.T @ (rewards * mask)
+                theta = jnp.linalg.solve(mat_a, vec_b)
+                inv_f = jnp.linalg.solve(mat_a, feats.T)  # [D, I]
+                spread = jnp.sqrt(jnp.sum(feats.T * inv_f, axis=0))
+                ucb_row = feats @ theta + alpha * spread
+                return (mat_a, vec_b), ucb_row
+
+            init = (jnp.eye(dim), jnp.zeros((dim,)))
+            _, ucb = jax.lax.scan(step, init, (item_pad, reward_pad, mask_pad))
+            return ucb
+
+        self.ucb = np.asarray(sweep(item_pad, reward_pad, mask_pad))
+
+    def _dense_scores(self, dataset, queries, items):
+        import jax.numpy as jnp
+
+        q_pos = pd.Index(self.fit_queries).get_indexer(np.asarray(queries))
+        i_pos = pd.Index(self.fit_items).get_indexer(np.asarray(items))
+        known_i = i_pos >= 0
+        # queries unseen at fit time keep a ZERO ucb row instead of dropping
+        # out — mirrors the reference, whose _init_params allocates rows for
+        # every user and never updates absent ones (u_lin_ucb.py:89-92); the
+        # HierarchicalRecommender relies on this when routing explorers into
+        # clusters they have no history in
+        matrix = np.zeros((len(q_pos), int(known_i.sum())), np.float32)
+        warm = q_pos >= 0
+        matrix[warm] = self.ucb[np.ix_(q_pos[warm], i_pos[known_i])]
+        return jnp.asarray(matrix), np.asarray(queries), np.asarray(items)[known_i]
+
+    def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
+        return self._dense_block_frame(*self._dense_scores(dataset, queries, items))
+
+    def _save_model(self, target: Path) -> None:
+        np.savez_compressed(target / "ucb.npz", ucb=self.ucb)
+
+    def _load_model(self, source: Path) -> None:
+        with np.load(source / "ucb.npz") as payload:
+            self.ucb = payload["ucb"]

@@ -138,6 +138,19 @@ class BaseRecommender(OptimizeMixin):
         """
         return None
 
+    def _dense_block_frame(
+        self, matrix, kept_queries: np.ndarray, kept_items: np.ndarray
+    ) -> pd.DataFrame:
+        """Explode a [Q', I'] score block into the tidy (query, item, rating)
+        frame of the `_predict_scores` contract."""
+        return pd.DataFrame(
+            {
+                self.query_column: np.repeat(np.asarray(kept_queries), len(kept_items)),
+                self.item_column: np.tile(np.asarray(kept_items), len(kept_queries)),
+                "rating": np.asarray(matrix).reshape(-1),
+            }
+        )
+
     def _topk_from_dense(
         self,
         matrix,

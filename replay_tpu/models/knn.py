@@ -26,6 +26,10 @@ from .base import BaseRecommender
 
 class ItemKNN(BaseRecommender):
     _init_arg_names = ["num_neighbours", "use_rating", "shrink", "weighting"]
+    # cosine/count similarities are non-negative, so zero scores mean "no
+    # evidence" and are dropped; subclasses with signed weights (ADMM SLIM)
+    # turn this off
+    _drop_nonpositive_scores = True
     _search_space = {
         "num_neighbours": {"type": "int", "args": [5, 100]},
         "shrink": {"type": "uniform", "args": [0.0, 50.0]},
@@ -129,7 +133,8 @@ class ItemKNN(BaseRecommender):
         wanted = np.asarray(items)[known]
         scores = jnp.asarray(seen) @ jnp.asarray(self.similarity)
         block = scores[:, item_positions[known]]
-        block = jnp.where(block > 0, block, -jnp.inf)
+        if self._drop_nonpositive_scores:
+            block = jnp.where(block > 0, block, -jnp.inf)
         return block, np.asarray(queries), wanted
 
     def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
@@ -148,7 +153,7 @@ class ItemKNN(BaseRecommender):
                 "rating": block.reshape(-1),
             }
         )
-        return frame[frame["rating"] > 0]
+        return frame[frame["rating"] > 0] if self._drop_nonpositive_scores else frame
 
     def get_nearest_items(self, items, k: int) -> pd.DataFrame:
         """Top-k similar items per given item (ref NeighbourRec API)."""

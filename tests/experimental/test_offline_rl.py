@@ -1,0 +1,323 @@
+"""Experimental tier round 3: CQL, DDPG, ADMM SLIM, ULinUCB, Hierarchical."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import Dataset, FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.data.schema import FeatureSource
+from replay_tpu.experimental import (
+    ADMMSLIM,
+    CQL,
+    DDPG,
+    HierarchicalRecommender,
+    MdpDatasetBuilder,
+    ULinUCB,
+)
+
+pytestmark = pytest.mark.jax
+
+
+def block_log(num_users=20, group=10, per_user=7, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for user in range(num_users):
+        liked = np.arange(group) + (user % 2) * group
+        for t, item in enumerate(rng.choice(liked, per_user, replace=False)):
+            rows.append((user, int(item), float(1 + rng.integers(0, 5)), t))
+    return pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+
+
+def base_schema():
+    return [
+        FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+        FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+        FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+        FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+    ]
+
+
+def make_dataset(log, item_features=None):
+    schema = base_schema()
+    if item_features is not None:
+        schema += [
+            FeatureInfo(c, FeatureType.NUMERICAL, feature_source=FeatureSource.ITEM_FEATURES)
+            for c in item_features.columns
+            if c != "item_id"
+        ]
+    return Dataset(
+        feature_schema=FeatureSchema(schema), interactions=log, item_features=item_features
+    )
+
+
+def grouped_item_features(n_items=20):
+    return pd.DataFrame(
+        {
+            "item_id": np.arange(n_items),
+            "f0": np.where(np.arange(n_items) < n_items // 2, 1.0, -1.0),
+            "f1": (np.arange(n_items) % (n_items // 2)) / float(n_items // 2),
+        }
+    )
+
+
+def in_group_rate(recs, group=10):
+    return np.mean(
+        [
+            (row.query_id % 2) * group <= row.item_id < (row.query_id % 2 + 1) * group
+            for row in recs.itertuples()
+        ]
+    )
+
+
+# --------------------------------------------------------------------------- #
+# MDP builder
+# --------------------------------------------------------------------------- #
+def test_mdp_builder_semantics():
+    log = block_log()
+    mdp = MdpDatasetBuilder(top_k=2).build(
+        log.rename(columns={"query_id": "q", "item_id": "i"}),
+        "q", "i", "rating", "timestamp", seed=0,
+    )
+    n_users = log["query_id"].nunique()
+    assert mdp["observations"].shape == (len(log), 2)
+    assert mdp["actions"].shape == (len(log), 1)
+    # one terminal per user, at their latest interaction
+    assert mdp["terminals"].sum() == n_users
+    frame = pd.DataFrame(
+        {
+            "q": mdp["observations"][:, 0],
+            "r": mdp["rewards"],
+            "t": mdp["terminals"],
+        }
+    )
+    assert (frame.groupby("q")["r"].sum() == 2).all()  # exactly top_k rewarded
+    assert (frame.groupby("q")["t"].apply(lambda s: s.to_numpy()[-1]) == 1).all()
+    with pytest.raises(ValueError, match="positive"):
+        MdpDatasetBuilder(top_k=1, action_randomization_scale=0.0)
+
+
+# --------------------------------------------------------------------------- #
+# CQL
+# --------------------------------------------------------------------------- #
+def test_cql_trains_and_roundtrips(tmp_path):
+    dataset = make_dataset(block_log())
+    model = CQL(top_k=2, n_steps=400, batch_size=32, hidden_dims=(32, 32), seed=0)
+    recs = model.fit_predict(dataset, k=3)
+    assert set(recs.columns) == {"query_id", "item_id", "rating"}
+    assert recs.groupby("query_id").size().eq(3).all()
+    # the DEFINING CQL behavior: the conservative gap (logsumexp over sampled
+    # actions minus Q on data actions) is pushed down over training
+    gap = model.loss_history[:, 3]
+    assert gap[-100:].mean() < gap[:100].mean()
+    assert np.isfinite(model.loss_history).all()
+    # seen items are filtered
+    seen = set(map(tuple, dataset.interactions[["query_id", "item_id"]].to_numpy()))
+    assert not (set(map(tuple, recs[["query_id", "item_id"]].to_numpy())) & seen)
+    model.save(str(tmp_path / "cql"))
+    restored = CQL.load(str(tmp_path / "cql"))
+    pd.testing.assert_frame_equal(
+        recs.reset_index(drop=True), restored.predict(dataset, k=3).reset_index(drop=True)
+    )
+
+
+def test_cql_scores_cold_queries():
+    dataset = make_dataset(block_log())
+    model = CQL(top_k=2, n_steps=50, batch_size=16, hidden_dims=(16,), seed=0)
+    model.fit(dataset)
+    recs = model.predict(dataset, k=2, queries=[999], filter_seen_items=False)
+    assert len(recs) == 2  # the policy generalizes over the observation space
+
+
+# --------------------------------------------------------------------------- #
+# DDPG
+# --------------------------------------------------------------------------- #
+def test_ddpg_trains_and_roundtrips(tmp_path):
+    dataset = make_dataset(block_log(num_users=16, group=8, per_user=6))
+    model = DDPG(epochs=3, batch_size=64, user_batch_size=8, trajectory_len=6, seed=0)
+    recs = model.fit_predict(dataset, k=3)
+    assert recs.groupby("query_id").size().eq(3).all()
+    assert len(model.loss_history) > 0  # updates actually ran
+    assert np.isfinite(model.loss_history).all()
+    # memory tracks rewarded (related) items per user
+    assert model.memory.shape == (16, model.memory_size)
+    model.save(str(tmp_path / "ddpg"))
+    restored = DDPG.load(str(tmp_path / "ddpg"))
+    pd.testing.assert_frame_equal(
+        recs.reset_index(drop=True), restored.predict(dataset, k=3).reset_index(drop=True)
+    )
+
+
+def test_ddpg_rejects_bad_noise():
+    with pytest.raises(ValueError, match="noise_type"):
+        DDPG(noise_type="brown")
+
+
+def test_ddpg_ou_noise_runs():
+    dataset = make_dataset(block_log(num_users=8, group=6, per_user=4))
+    model = DDPG(
+        noise_type="ou", epochs=1, batch_size=16, user_batch_size=4,
+        trajectory_len=4, seed=0,
+    )
+    recs = model.fit_predict(dataset, k=2)
+    assert recs.groupby("query_id").size().eq(2).all()
+
+
+# --------------------------------------------------------------------------- #
+# ADMM SLIM
+# --------------------------------------------------------------------------- #
+def test_admm_slim_learns_groups(tmp_path):
+    dataset = make_dataset(block_log())
+    model = ADMMSLIM(lambda_1=0.5, lambda_2=50.0, seed=0)
+    recs = model.fit_predict(dataset, k=3)
+    assert in_group_rate(recs) > 0.9
+    assert 0 < model.num_fit_iterations <= model.max_iteration
+    # zero diagonal: an item must not recommend itself through self-similarity
+    assert np.abs(np.diag(model.similarity)).max() < 1e-4
+    model.save(str(tmp_path / "admm"))
+    restored = ADMMSLIM.load(str(tmp_path / "admm"))
+    pd.testing.assert_frame_equal(
+        recs.reset_index(drop=True), restored.predict(dataset, k=3).reset_index(drop=True)
+    )
+
+
+def test_admm_slim_validates_params():
+    with pytest.raises(ValueError, match="regularization"):
+        ADMMSLIM(lambda_1=-1.0)
+    with pytest.raises(ValueError, match="regularization"):
+        ADMMSLIM(lambda_2=0.0)
+
+
+# --------------------------------------------------------------------------- #
+# ULinUCB
+# --------------------------------------------------------------------------- #
+def test_u_lin_ucb_fit_predict(tmp_path):
+    log = block_log()
+    dataset = make_dataset(log, grouped_item_features())
+    model = ULinUCB(alpha=-2.0)
+    recs = model.fit_predict(dataset, k=3)
+    assert model.ucb.shape == (20, 20)
+    assert recs.groupby("query_id").size().eq(3).all()
+    model.save(str(tmp_path / "ulinucb"))
+    restored = ULinUCB.load(str(tmp_path / "ulinucb"))
+    pd.testing.assert_frame_equal(
+        recs.reset_index(drop=True), restored.predict(dataset, k=3).reset_index(drop=True)
+    )
+
+
+def test_u_lin_ucb_matches_sequential_reference():
+    """The lax.scan sweep equals a straight numpy transcription of the math."""
+    log = block_log(num_users=6, group=4, per_user=3)
+    feats = grouped_item_features(8)
+    dataset = make_dataset(log, feats)
+    model = ULinUCB(alpha=0.5).fit(dataset)
+
+    # the model's item universe is fit_items (items present in the log)
+    i_index = pd.Index(model.fit_items)
+    F = feats.set_index("item_id").loc[i_index][["f0", "f1"]].to_numpy(float)
+    A = np.eye(2)
+    b = np.zeros(2)
+    expected = np.zeros((len(model.fit_queries), len(i_index)))
+    for row, user in enumerate(model.fit_queries):
+        sub = log[log.query_id == user]
+        fu = F[i_index.get_indexer(sub.item_id)]
+        A = A + fu.T @ fu
+        b = b + fu.T @ sub.rating.to_numpy(float)
+        theta = np.linalg.solve(A, b)
+        spread = np.sqrt(np.sum(F.T * np.linalg.solve(A, F.T), axis=0))
+        expected[row] = F @ theta + 0.5 * spread
+    np.testing.assert_allclose(model.ucb, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_u_lin_ucb_needs_item_features():
+    dataset = make_dataset(block_log())
+    with pytest.raises(ValueError, match="item_features"):
+        ULinUCB().fit(dataset)
+
+
+# --------------------------------------------------------------------------- #
+# HierarchicalRecommender
+# --------------------------------------------------------------------------- #
+def test_hierarchical_routes_through_tree():
+    dataset = make_dataset(block_log(), grouped_item_features())
+    model = HierarchicalRecommender(depth=2, num_clusters=2)
+    recs = model.fit_predict(dataset, k=3)
+    assert recs.groupby("query_id").size().le(3).all()
+    assert len(recs) > 0
+    # tree structure: root has one child per cluster, children are leaves
+    assert model.root.children is not None
+    assert all(child.is_leaf for child in model.root.children)
+
+
+def test_hierarchical_depth_one_is_flat():
+    dataset = make_dataset(block_log(), grouped_item_features())
+    model = HierarchicalRecommender(depth=1)
+    recs = model.fit_predict(dataset, k=2)
+    assert model.root.is_leaf
+    assert recs.groupby("query_id").size().le(2).all()
+
+
+def test_hierarchical_custom_cluster_model():
+    from sklearn.cluster import AgglomerativeClustering
+
+    dataset = make_dataset(block_log(), grouped_item_features())
+    model = HierarchicalRecommender(
+        depth=2, cluster_model=AgglomerativeClustering(n_clusters=2)
+    )
+    recs = model.fit_predict(dataset, k=2)
+    assert len(recs) > 0
+
+    with pytest.raises(ValueError, match="depth"):
+        HierarchicalRecommender(depth=0)
+
+    with pytest.raises(ValueError, match="item_features"):
+        HierarchicalRecommender(depth=1).fit(make_dataset(block_log()))
+
+
+def test_cql_respects_custom_column_names():
+    """Regression: rating/timestamp columns under non-default names."""
+    log = block_log().rename(columns={"rating": "relevance", "timestamp": "ts"})
+    schema = FeatureSchema(
+        [
+            FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+            FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+            FeatureInfo("relevance", FeatureType.NUMERICAL, FeatureHint.RATING),
+            FeatureInfo("ts", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+        ]
+    )
+    dataset = Dataset(feature_schema=schema, interactions=log)
+    model = CQL(top_k=2, n_steps=30, batch_size=16, hidden_dims=(8,), seed=0)
+    recs = model.fit_predict(dataset, k=2)
+    assert recs.groupby("query_id").size().eq(2).all()
+
+
+def test_mdp_builder_tied_timestamps_keep_terminal_last():
+    """Regression: ties at a user's max timestamp must not leave the terminal
+    mid-episode (which chains rows into the next user's Bellman targets)."""
+    log = pd.DataFrame(
+        {
+            "query_id": [0, 0, 0, 1, 1],
+            "item_id": [0, 1, 2, 3, 4],
+            "rating": [1.0, 2.0, 3.0, 1.0, 2.0],
+            "timestamp": [0, 5, 5, 0, 1],
+        }
+    )
+    mdp = MdpDatasetBuilder(top_k=1).build(
+        log, "query_id", "item_id", "rating", "timestamp", seed=0
+    )
+    terminals = mdp["terminals"]
+    users = mdp["observations"][:, 0]
+    # the terminal of each user is on their LAST row in episode order
+    for user in (0, 1):
+        rows = np.where(users == user)[0]
+        assert terminals[rows[-1]] == 1
+        assert terminals[rows[:-1]].sum() == 0
+
+
+def test_u_lin_ucb_unknown_queries_score_zero():
+    """Regression: unseen users keep a zero UCB row (reference semantics) so
+    tree routing never silently drops them."""
+    dataset = make_dataset(block_log(), grouped_item_features())
+    model = ULinUCB(alpha=-2.0).fit(dataset)
+    recs = model.predict(dataset, k=2, queries=[777], filter_seen_items=False)
+    assert len(recs) == 2
+    assert (recs["rating"] == 0).all()
