@@ -29,7 +29,7 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 from replay_tpu.data.nn.partitioning import Partitioning
-from replay_tpu.native import gather_pad
+from replay_tpu.native import gather_pad, gather_pad_2d
 
 Batch = Dict[str, np.ndarray]
 
@@ -60,7 +60,15 @@ class ParquetBatcher:
     def _slabs(self):
         import pyarrow.dataset as ds
 
-        dataset = ds.dataset(self.source, format="parquet")
+        if "://" in str(self.source):
+            # remote/URI sources (s3://, gs://, hdfs://, file://) resolve
+            # through arrow's filesystem registry — ref parquet_dataset.py:133
+            from pyarrow.fs import FileSystem
+
+            filesystem, path = FileSystem.from_uri(str(self.source))
+            dataset = ds.dataset(path, format="parquet", filesystem=filesystem)
+        else:
+            dataset = ds.dataset(self.source, format="parquet")
         names = self.columns or dataset.schema.names
         yield from dataset.to_batches(columns=names, batch_size=self.partition_size)
 
@@ -78,10 +86,51 @@ class ParquetBatcher:
                     raise ValueError(msg)
                 combined = column.combine_chunks() if isinstance(column, pa.ChunkedArray) else column
                 offsets = np.asarray(combined.offsets, np.int64)
-                values = np.asarray(combined.values)  # keeps int vs float dtype
-                tensor, mask = gather_pad(
-                    values, offsets, order, int(spec["shape"]), spec.get("padding", 0)
-                )
+                inner = combined.values
+                shape = spec["shape"]
+                if isinstance(inner.type, (pa.ListType, pa.LargeListType)):
+                    # list-of-list (Array2D): per-step feature VECTORS of a
+                    # fixed width — ref impl/array_2d_column.py:22
+                    if not isinstance(shape, (list, tuple)) or len(shape) != 2:
+                        msg = (
+                            f"2-D list column '{name}' needs metadata shape [L, D], "
+                            f"got {shape!r}."
+                        )
+                        raise ValueError(msg)
+                    length, width = int(shape[0]), int(shape[1])
+                    inner_offsets = np.asarray(inner.offsets, np.int64)
+                    widths = np.diff(inner_offsets)
+                    if len(widths) and not (widths == width).all():
+                        observed = np.unique(widths[widths != width])[:3]
+                        msg = (
+                            f"2-D column '{name}' declares inner width {width} but "
+                            f"the data has widths {observed.tolist()}…"
+                        )
+                        raise ValueError(msg)
+                    tensor, mask = gather_pad_2d(
+                        np.asarray(inner.values),
+                        offsets,
+                        order,
+                        length,
+                        width,
+                        spec.get("padding", 0),
+                    )
+                else:
+                    if isinstance(shape, (list, tuple)):
+                        if len(shape) != 1:
+                            msg = (
+                                f"1-D list column '{name}' has metadata shape "
+                                f"{shape!r}; expected a scalar length or [L]."
+                            )
+                            raise ValueError(msg)
+                        shape = shape[0]
+                    tensor, mask = gather_pad(
+                        np.asarray(inner),  # keeps int vs float dtype
+                        offsets,
+                        order,
+                        int(shape),
+                        spec.get("padding", 0),
+                    )
                 out[name] = tensor
                 out[f"{name}_mask"] = mask
             else:

@@ -109,7 +109,11 @@ class _Node:
             **{tree.item_column: clusters}
         )
         self.recommender.fit(
-            tree._node_dataset(relabeled, self.clusterer.centers_frame(tree.item_column))
+            tree._node_dataset(
+                relabeled,
+                self.clusterer.centers_frame(tree.item_column),
+                user_features,
+            )
         )
 
     def predict(
@@ -127,15 +131,25 @@ class _Node:
         )
         if self.is_leaf:
             dataset = tree._node_dataset(
-                relabeled_log, self.clusterer.centers_frame(tree.item_column)
+                relabeled_log,
+                self.clusterer.centers_frame(tree.item_column),
+                tree._user_features,
             )
+            # the candidate pool restriction travels all the way to the leaf:
+            # relabel the surviving items to this leaf's cluster ids
             pred = self.recommender.predict(
-                dataset, k, queries=users, filter_seen_items=filter_seen_items
+                dataset,
+                k,
+                queries=users,
+                items=self.clusterer.predict(items[tree.item_column]),
+                filter_seen_items=filter_seen_items,
             )
             pred[tree.item_column] = self.clusterer.predict_items(pred[tree.item_column])
             return pred
         dataset = tree._node_dataset(
-            relabeled_log, self.clusterer.centers_frame(tree.item_column)
+            relabeled_log,
+            self.clusterer.centers_frame(tree.item_column),
+            tree._user_features,
         )
         routed = self.recommender.predict(
             dataset, 1, queries=users, filter_seen_items=False
@@ -182,6 +196,7 @@ class HierarchicalRecommender(BaseRecommender):
         self.recommender_class = recommender_class
         self.recommender_params = dict(recommender_params or {})
         self.root: Optional[_Node] = None
+        self._user_features: Optional[pd.DataFrame] = None
 
     def _make_cluster_model(self):
         if self.cluster_model is not None:
@@ -192,7 +207,12 @@ class HierarchicalRecommender(BaseRecommender):
 
         return KMeans(n_clusters=self.num_clusters, n_init=4, random_state=0)
 
-    def _node_dataset(self, log: pd.DataFrame, item_features: pd.DataFrame) -> Dataset:
+    def _node_dataset(
+        self,
+        log: pd.DataFrame,
+        item_features: pd.DataFrame,
+        query_features: Optional[pd.DataFrame] = None,
+    ) -> Dataset:
         features = [
             FeatureInfo(self.query_column, FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
             FeatureInfo(self.item_column, FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
@@ -212,10 +232,19 @@ class HierarchicalRecommender(BaseRecommender):
             for c in item_features.columns
             if c != self.item_column
         ]
+        if query_features is not None:
+            features += [
+                FeatureInfo(
+                    c, FeatureType.NUMERICAL, feature_source=FeatureSource.QUERY_FEATURES
+                )
+                for c in query_features.columns
+                if c != self.query_column and np.issubdtype(query_features[c].dtype, np.number)
+            ]
         return Dataset(
             feature_schema=FeatureSchema(features),
             interactions=log.reset_index(drop=True),
             item_features=item_features,
+            query_features=query_features,
             check_consistency=False,
         )
 
@@ -223,6 +252,7 @@ class HierarchicalRecommender(BaseRecommender):
         if dataset.item_features is None:
             msg = "HierarchicalRecommender needs dataset.item_features for clustering"
             raise ValueError(msg)
+        self._user_features = dataset.query_features
         self.root = _Node(self, level=0)
         self.root.procreate(dataset.item_features.copy(), self.item_column)
         self.root.fit(dataset.interactions, dataset.query_features)

@@ -146,13 +146,7 @@ class ItemKNN(BaseRecommender):
         known = item_positions >= 0
         wanted = np.asarray(items)[known]
         block = scores[:, item_positions[known]]
-        frame = pd.DataFrame(
-            {
-                self.query_column: np.repeat(q_index.to_numpy(), len(wanted)),
-                self.item_column: np.tile(wanted, len(q_index)),
-                "rating": block.reshape(-1),
-            }
-        )
+        frame = self._dense_block_frame(block, q_index.to_numpy(), wanted)
         return frame[frame["rating"] > 0] if self._drop_nonpositive_scores else frame
 
     def get_nearest_items(self, items, k: int) -> pd.DataFrame:

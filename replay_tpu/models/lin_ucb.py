@@ -1,9 +1,17 @@
-"""LinUCB contextual bandit (disjoint arms).
+"""LinUCB contextual bandit (disjoint and hybrid arms).
 
-Capability parity with replay/models/lin_ucb.py:97: each item is an arm with its
-own ridge regression over query feature vectors; the score is the point estimate
-plus an exploration bonus alpha * sqrt(xᵀ A⁻¹ x). All arms are solved as ONE
-batched linear system ([I, D, D] solve) instead of per-arm python loops."""
+Capability parity with replay/models/lin_ucb.py:97 (Li et al., arXiv 1003.0146):
+each item is an arm with its own ridge regression over query feature vectors;
+the score is the point estimate plus an exploration bonus alpha * sqrt(s).
+``is_hybrid=True`` adds the shared-coefficient term over the Kronecker features
+z = x ⊗ f_item (ref HybridArm:56 and the A_0/b_0 assembly at :242-288).
+
+Compute design: the reference loops per arm with scipy.sparse; here every
+per-arm quantity is one BATCHED einsum over [I, D, D] moments, and the hybrid
+shared system exploits the Kronecker structure analytically —
+B_i = S_i ⊗ f_iᵀ, so A_0 = I + Σ_i (S_i − S_i A_i⁻¹ S_i) ⊗ f_i f_iᵀ and the
+k×k system is assembled without ever materializing per-observation z vectors.
+"""
 
 from __future__ import annotations
 
@@ -19,33 +27,49 @@ from .base import BaseRecommender
 
 
 class LinUCB(BaseRecommender):
-    _init_arg_names = ["alpha", "reg"]
+    _init_arg_names = ["alpha", "reg", "is_hybrid"]
+    _search_space = {
+        "alpha": {"type": "uniform", "args": [-10.0, 10.0]},
+        "reg": {"type": "uniform", "args": [0.001, 10.0]},
+    }
 
-    def __init__(self, alpha: float = 1.0, reg: float = 1.0) -> None:
+    def __init__(self, alpha: float = 1.0, reg: float = 1.0, is_hybrid: bool = False) -> None:
         super().__init__()
         self.alpha = alpha
         self.reg = reg
+        self.is_hybrid = is_hybrid
         self.theta: Optional[np.ndarray] = None  # [I, D]
         self.a_inv: Optional[np.ndarray] = None  # [I, D, D]
         self._feature_columns: Optional[list] = None
+        # hybrid state
+        self._item_feature_columns: Optional[list] = None
+        self.beta: Optional[np.ndarray] = None  # [D, D_item]
+        self._s_data: Optional[np.ndarray] = None  # [I, D, D] unregularized moments
+        self._q: Optional[np.ndarray] = None  # [I, D, D] f A_0^{-1} f contraction
+        self._item_feats: Optional[np.ndarray] = None  # [I, D_item]
 
     def _features_of(self, dataset: Dataset, queries) -> np.ndarray:
         features = dataset.query_features.set_index(self.query_column)
         block = features.loc[np.asarray(queries), self._feature_columns]
         return block.to_numpy(np.float64)
 
+    @staticmethod
+    def _numeric_columns(frame: pd.DataFrame, id_column: str, side: str) -> list:
+        columns = [
+            c for c in frame.columns
+            if c != id_column and np.issubdtype(frame[c].dtype, np.number)
+        ]
+        if not columns:
+            msg = f"LinUCB found no numeric {side} feature columns."
+            raise ValueError(msg)
+        return columns
+
     def _fit(self, dataset: Dataset) -> None:
         if dataset.query_features is None:
             msg = "LinUCB needs query_features as the context."
             raise ValueError(msg)
         features = dataset.query_features
-        self._feature_columns = [
-            c for c in features.columns
-            if c != self.query_column and np.issubdtype(features[c].dtype, np.number)
-        ]
-        if not self._feature_columns:
-            msg = "LinUCB found no numeric query feature columns."
-            raise ValueError(msg)
+        self._feature_columns = self._numeric_columns(features, self.query_column, "query")
         interactions = dataset.interactions
         contexts = self._features_of(dataset, interactions[self.query_column])
         rewards = (
@@ -56,13 +80,52 @@ class LinUCB(BaseRecommender):
         i_index = pd.Index(self.fit_items)
         arms = i_index.get_indexer(interactions[self.item_column])
         n_items, dim = len(i_index), contexts.shape[1]
-        A = np.tile(np.eye(dim) * self.reg, (n_items, 1, 1))
+        s_data = np.zeros((n_items, dim, dim))
         b = np.zeros((n_items, dim))
         outer = contexts[:, :, None] * contexts[:, None, :]
-        np.add.at(A, arms, outer)
+        np.add.at(s_data, arms, outer)
         np.add.at(b, arms, contexts * rewards[:, None])
+        A = s_data + np.eye(dim) * self.reg
         self.a_inv = np.linalg.inv(A)
-        self.theta = np.einsum("idk,ik->id", self.a_inv, b)
+        if not self.is_hybrid:
+            self.theta = np.einsum("idk,ik->id", self.a_inv, b)
+            return
+
+        if dataset.item_features is None:
+            msg = "Hybrid LinUCB needs item_features for the shared term."
+            raise ValueError(msg)
+        item_frame = dataset.item_features
+        self._item_feature_columns = self._numeric_columns(
+            item_frame, self.item_column, "item"
+        )
+        F = (
+            item_frame.set_index(self.item_column)
+            .loc[i_index, self._item_feature_columns]
+            .to_numpy(np.float64)
+        )  # [I, D_item]
+        d_item = F.shape[1]
+        k = dim * d_item
+
+        # shared system, assembled through the Kronecker structure:
+        # delta_i = S_i - S_i A_i^{-1} S_i;  A_0 = I_k + Σ_i delta_i ⊗ f_i f_iᵀ
+        p = np.einsum("iab,ibc->iac", self.a_inv, s_data)  # A^{-1} S
+        delta = s_data - np.einsum("iab,ibc->iac", s_data, p)
+        a0 = np.eye(k).reshape(dim, d_item, dim, d_item) + np.einsum(
+            "iac,ib,ie->abce", delta, F, F, optimize=True
+        )
+        resid_b = b - np.einsum("iab,ibc,ic->ia", s_data, self.a_inv, b, optimize=True)
+        b0 = np.einsum("ia,ib->ab", resid_b, F)  # [D, D_item]
+        beta_flat = np.linalg.solve(a0.reshape(k, k), b0.reshape(k))
+        self.beta = beta_flat.reshape(dim, d_item)
+        a0_inv = np.linalg.inv(a0.reshape(k, k)).reshape(dim, d_item, dim, d_item)
+
+        # theta_i = A_i^{-1} (b_i - B_i beta)  with  B_i beta = S_i Beta f_i
+        shared_part = np.einsum("iac,cd,id->ia", s_data, self.beta, F, optimize=True)
+        self.theta = np.einsum("iab,ib->ia", self.a_inv, b - shared_part)
+        # Q_i[a, c] = f_iᵀ-contracted A_0^{-1}: Σ_{b,e} f_b A0inv[a,b,c,e] f_e
+        self._q = np.einsum("ib,abce,ie->iac", F, a0_inv, F, optimize=True)
+        self._s_data = s_data
+        self._item_feats = F
 
     def _predict_scores(self, dataset, queries, items) -> pd.DataFrame:
         if dataset is None or dataset.query_features is None:
@@ -74,26 +137,52 @@ class LinUCB(BaseRecommender):
         i_pos = i_index.get_indexer(np.asarray(items))
         known = i_pos >= 0
         warm_items = np.asarray(items)[known]
-        theta = self.theta[i_pos[known]]  # [K, D]
-        a_inv = self.a_inv[i_pos[known]]  # [K, D, D]
+        pos = i_pos[known]
+        theta = self.theta[pos]  # [K, D]
+        a_inv = self.a_inv[pos]  # [K, D, D]
         point = contexts @ theta.T  # [Q, K]
-        # bonus[q, k] = sqrt(x_q^T A_k^{-1} x_q)
-        bonus = np.sqrt(np.einsum("qd,kde,qe->qk", contexts, a_inv, contexts).clip(min=0))
-        scores = point + self.alpha * bonus
-        return pd.DataFrame(
-            {
-                self.query_column: np.repeat(queries, len(warm_items)),
-                self.item_column: np.tile(warm_items, len(queries)),
-                "rating": scores.reshape(-1),
-            }
-        )
+        # s[q, k] = x^T A_k^{-1} x (+ hybrid shared/cross terms)
+        s = np.einsum("qd,kde,qe->qk", contexts, a_inv, contexts, optimize=True)
+        if self.is_hybrid:
+            F = self._item_feats[pos]
+            q_mat = self._q[pos]
+            s_mat = self._s_data[pos]
+            point = point + np.einsum("qa,ab,kb->qk", contexts, self.beta, F, optimize=True)
+            # z A0^{-1} z
+            s = s + np.einsum("qa,kab,qb->qk", contexts, q_mat, contexts, optimize=True)
+            # cross term: -2 z A0^{-1} B^T A^{-1} x  (B^T A^{-1} x = (A^{-1}S)^T x ⊗ f)
+            p = np.einsum("kab,kbc->kac", a_inv, s_mat)  # A^{-1} S
+            s = s - 2.0 * np.einsum("qa,kab,kcb,qc->qk", contexts, q_mat, p, contexts, optimize=True)
+            # x A^{-1} B A0^{-1} B^T A^{-1} x  =  y S Q S y,  y = A^{-1} x
+            y = np.einsum("kde,qe->qkd", a_inv, contexts)
+            s = s + np.einsum("qkd,kdc,kce,kef,qkf->qk", y, s_mat, q_mat, s_mat, y, optimize=True)
+        scores = point + self.alpha * np.sqrt(s.clip(min=0))
+        return self._dense_block_frame(scores, queries, warm_items)
 
     def _save_model(self, target: Path) -> None:
-        np.savez_compressed(target / "linucb.npz", theta=self.theta, a_inv=self.a_inv)
+        arrays = {"theta": self.theta, "a_inv": self.a_inv}
+        if self.is_hybrid:
+            arrays.update(
+                beta=self.beta, s_data=self._s_data, q=self._q, item_feats=self._item_feats
+            )
+        np.savez_compressed(target / "linucb.npz", **arrays)
         (target / "feature_columns.txt").write_text("\n".join(self._feature_columns))
+        if self.is_hybrid:
+            (target / "item_feature_columns.txt").write_text(
+                "\n".join(self._item_feature_columns)
+            )
 
     def _load_model(self, source: Path) -> None:
         with np.load(source / "linucb.npz") as payload:
             self.theta = payload["theta"]
             self.a_inv = payload["a_inv"]
+            if self.is_hybrid:
+                self.beta = payload["beta"]
+                self._s_data = payload["s_data"]
+                self._q = payload["q"]
+                self._item_feats = payload["item_feats"]
         self._feature_columns = (source / "feature_columns.txt").read_text().splitlines()
+        if self.is_hybrid:
+            self._item_feature_columns = (
+                (source / "item_feature_columns.txt").read_text().splitlines()
+            )

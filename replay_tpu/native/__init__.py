@@ -78,7 +78,10 @@ def native_available() -> bool:
         _native = _load() or _build()
     if (
         _native is not None
-        and not hasattr(_native, "gather_pad_spans_i64")
+        and not all(
+            hasattr(_native, name)
+            for name in ("gather_pad_spans_i64", "gather_pad_2d_i64")
+        )
         and not _rebuild_tried
     ):
         # artifact from an older kernel source. Rebuild ONCE so future processes
@@ -143,6 +146,61 @@ def gather_pad(
         row_values = values[start:stop]
         out[b, max_len - len(row_values):] = row_values
         mask[b, max_len - len(row_values):] = 1
+    return out, mask.astype(bool)
+
+
+def gather_pad_2d(
+    values: np.ndarray,
+    offsets: np.ndarray,
+    indices: np.ndarray,
+    max_len: int,
+    width: int,
+    pad_value,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Gather ragged rows of fixed-width vectors into [batch, max_len, width].
+
+    The Array2D (list-of-list) column gather: ``values`` is the [total_steps,
+    width] matrix of inner vectors, ``offsets`` index STEPS per row. LEFT-padded
+    along the step axis with ``pad_value``; mask is per step. Same dtype rules
+    as :func:`gather_pad` (float64 reinterpret for floating columns).
+    """
+    values = np.asarray(values).reshape(-1, width)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    indices = np.ascontiguousarray(indices, np.int64)
+    batch = len(indices)
+    floating = np.issubdtype(values.dtype, np.floating)
+    mask = np.empty((batch, max_len), np.uint8)
+    if _native_has("gather_pad_2d_i64"):
+        if floating:
+            payload = np.ascontiguousarray(values, np.float64).view(np.int64)
+            pad_bits = np.float64(pad_value).view(np.int64)
+            out = np.empty((batch, max_len, width), np.int64)
+            _native.gather_pad_2d_i64(
+                payload, offsets, indices, out, mask, max_len, width, int(pad_bits)
+            )
+            return out.view(np.float64), mask.astype(bool)
+        payload = np.ascontiguousarray(values, np.int64)
+        out = np.empty((batch, max_len, width), np.int64)
+        _native.gather_pad_2d_i64(
+            payload, offsets, indices, out, mask, max_len, width, int(pad_value)
+        )
+        return out, mask.astype(bool)
+    # numpy fallback: same semantics + validation as the C kernel
+    n_rows = len(offsets) - 1
+    if ((indices < 0) | (indices >= n_rows)).any():
+        msg = "gather_pad_2d: row index out of range"
+        raise ValueError(msg)
+    out = np.full(
+        (batch, max_len, width), pad_value, np.float64 if floating else np.int64
+    )
+    mask[:] = 0
+    for b, row in enumerate(indices):
+        start, stop = offsets[row], offsets[row + 1]
+        if stop - start > max_len:
+            start = stop - max_len
+        steps = values[start:stop]
+        out[b, max_len - len(steps):] = steps
+        mask[b, max_len - len(steps):] = 1
     return out, mask.astype(bool)
 
 

@@ -136,11 +136,79 @@ static PyObject* gather_pad_spans_i64(PyObject* /*self*/, PyObject* args) {
     Py_RETURN_NONE;
 }
 
+// 2-D variant: each logical element is a fixed-width vector of `width` int64s
+// (the reference's Array2DColumn, data/nn/parquet/impl/array_2d_column.py:22 —
+// list-of-list columns whose inner lists all have the same length).
+//   values  : int64[total_steps * width]   inner vectors, row-major
+//   offsets : int64[n_rows + 1]            row i spans STEPS offsets[i]:offsets[i+1]
+//   out     : int64[batch, max_len, width] LEFT-padded with pad_value
+//   mask    : uint8[batch, max_len]        1 at real steps
+static PyObject* gather_pad_2d_i64(PyObject* /*self*/, PyObject* args) {
+    Py_buffer values, offsets, indices, out, mask;
+    long long max_len_ll, width_ll, pad_value_ll;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*LLL",
+                          &values, &offsets, &indices, &out, &mask,
+                          &max_len_ll, &width_ll, &pad_value_ll)) {
+        return nullptr;
+    }
+    const int64_t max_len = (int64_t)max_len_ll;
+    const int64_t width = (int64_t)width_ll;
+    const int64_t pad_value = (int64_t)pad_value_ll;
+    const int64_t* vals = (const int64_t*)values.buf;
+    const int64_t* offs = (const int64_t*)offsets.buf;
+    const int64_t* idx = (const int64_t*)indices.buf;
+    int64_t* out_buf = (int64_t*)out.buf;
+    uint8_t* mask_buf = (uint8_t*)mask.buf;
+    const int64_t batch = (int64_t)(indices.len / (Py_ssize_t)sizeof(int64_t));
+    const int64_t n_rows = (int64_t)(offsets.len / (Py_ssize_t)sizeof(int64_t)) - 1;
+    const int64_t total_steps =
+        (int64_t)(values.len / (Py_ssize_t)sizeof(int64_t)) / (width > 0 ? width : 1);
+
+    int bad = (width <= 0);
+    Py_BEGIN_ALLOW_THREADS
+    if (!bad) {
+        for (int64_t b = 0; b < batch; ++b) {
+            const int64_t row = idx[b];
+            if (row < 0 || row >= n_rows) { bad = 1; break; }
+            int64_t start = offs[row];
+            int64_t stop = offs[row + 1];
+            if (start < 0 || stop < start || stop > total_steps) { bad = 1; break; }
+            int64_t len = stop - start;
+            if (len > max_len) {           // recency window over STEPS
+                start = stop - max_len;
+                len = max_len;
+            }
+            const int64_t pad = max_len - len;
+            int64_t* out_row = out_buf + b * max_len * width;
+            uint8_t* mask_row = mask_buf + b * max_len;
+            for (int64_t j = 0; j < pad * width; ++j) out_row[j] = pad_value;
+            for (int64_t j = 0; j < pad; ++j) mask_row[j] = 0;
+            std::memcpy(out_row + pad * width, vals + start * width,
+                        (size_t)(len * width) * sizeof(int64_t));
+            std::memset(mask_row + pad, 1, (size_t)len);
+        }
+    }
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&values);
+    PyBuffer_Release(&offsets);
+    PyBuffer_Release(&indices);
+    PyBuffer_Release(&out);
+    PyBuffer_Release(&mask);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError, "gather_pad_2d_i64: index, offsets or width out of range");
+        return nullptr;
+    }
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef Methods[] = {
     {"gather_pad_i64", gather_pad_i64, METH_VARARGS,
      "Gather ragged int64 rows and left-pad into a fixed [batch, max_len] buffer."},
     {"gather_pad_spans_i64", gather_pad_spans_i64, METH_VARARGS,
      "Gather (row, start, stop) spans of a ragged int64 column, left-padded."},
+    {"gather_pad_2d_i64", gather_pad_2d_i64, METH_VARARGS,
+     "Gather ragged rows of fixed-width int64 vectors into [batch, max_len, width]."},
     {nullptr, nullptr, 0, nullptr},
 };
 

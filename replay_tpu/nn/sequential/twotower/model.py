@@ -43,10 +43,16 @@ class TwoTower(nn.Module):
     :param item_schema: optional non-sequential item-side features fused into the
         item tower; their tensors arrive at call time as ``item_feature_tensors``
         (see :class:`~replay_tpu.nn.sequential.twotower.reader.FeaturesReader`).
+    :param context_merger: optional flax module fusing the query tower's hidden
+        states with the raw input features — called as
+        ``merger(hidden [B, L, E], feature_tensors) -> [B, L, E]`` after the
+        final norm, in both training and inference (ref ContextMergerProto,
+        replay/nn/sequential/twotower/model.py:421,516,667-672,704-710).
     """
 
     schema: TensorSchema
     item_schema: Optional[TensorSchema] = None
+    context_merger: Optional[nn.Module] = None
     embedding_dim: int = 64
     num_blocks: int = 2
     num_heads: int = 1
@@ -108,7 +114,10 @@ class TwoTower(nn.Module):
             padding_mask, deterministic=deterministic, dtype=self.dtype
         )
         x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
-        return self.final_norm(x)
+        x = self.final_norm(x)
+        if self.context_merger is not None:
+            x = self.context_merger(x, feature_tensors)
+        return x
 
     # -- item tower --------------------------------------------------------- #
     def encode_items(

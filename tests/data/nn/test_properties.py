@@ -4,6 +4,7 @@ the fixed-shape batcher, and the native kernels."""
 
 import numpy as np
 import pandas as pd
+import pytest
 from hypothesis import given, settings, strategies as st
 
 from replay_tpu.data import FeatureHint, FeatureType
@@ -113,3 +114,47 @@ def test_gather_pad_matches_python_reference(row_lengths, max_len, data):
         np.testing.assert_array_equal(out[b, pad:], expected)
         assert (out[b, :pad] == -1).all()
         assert mask[b].sum() == len(expected)
+
+@settings(max_examples=40, deadline=None)
+@given(
+    row_lengths=st.lists(st.integers(min_value=0, max_value=9), min_size=1, max_size=8),
+    max_len=st.integers(min_value=1, max_value=6),
+    width=st.integers(min_value=1, max_value=4),
+    floating=st.booleans(),
+    data=st.data(),
+)
+def test_gather_pad_2d_matches_python_reference(row_lengths, max_len, width, floating, data):
+    from replay_tpu.native import gather_pad_2d
+
+    total = sum(row_lengths)
+    values = np.arange(total * width, dtype=np.float64 if floating else np.int64).reshape(
+        total, width
+    )
+    offsets = np.concatenate([[0], np.cumsum(row_lengths)]).astype(np.int64)
+    indices = np.asarray(
+        data.draw(
+            st.lists(
+                st.integers(min_value=0, max_value=len(row_lengths) - 1),
+                min_size=1, max_size=6,
+            )
+        ),
+        np.int64,
+    )
+    out, mask = gather_pad_2d(values, offsets, indices, max_len, width, -1)
+    assert out.shape == (len(indices), max_len, width)
+    assert out.dtype == values.dtype
+    for b, row in enumerate(indices):
+        expected = values[offsets[row]: offsets[row + 1]][-max_len:]
+        pad = max_len - len(expected)
+        np.testing.assert_array_equal(out[b, pad:], expected)
+        assert (out[b, :pad] == -1).all()
+        np.testing.assert_array_equal(mask[b], [False] * pad + [True] * len(expected))
+
+
+def test_gather_pad_2d_rejects_bad_rows():
+    from replay_tpu.native import gather_pad_2d
+
+    values = np.arange(6, dtype=np.int64).reshape(3, 2)
+    offsets = np.asarray([0, 1, 3], np.int64)
+    with pytest.raises(ValueError):
+        gather_pad_2d(values, offsets, np.asarray([5], np.int64), 4, 2, 0)

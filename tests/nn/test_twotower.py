@@ -190,3 +190,58 @@ def test_predict_uses_cached_catalog(trained, item_feature_tensors):
     trainer.predict_top_k(state, [dict(batch), dict(batch), dict(batch)], k=4)
     trainer._catalog_fn = original
     assert calls["n"] == 1  # one catalog encode for three batches
+
+
+class _GateMerger(__import__("flax").linen.Module):
+    """Context merger: gates the hidden state by a learned projection of the
+    last item id embedding-index parity (a minimal ContextMergerProto)."""
+
+    @__import__("flax").linen.compact
+    def __call__(self, hidden, feature_tensors):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        signal = (feature_tensors["item_id"] % 2).astype(hidden.dtype)[..., None]
+        gate = nn.Dense(hidden.shape[-1], name="gate")(signal)
+        return hidden * jax.nn.sigmoid(gate)
+
+
+def test_context_merger_changes_outputs_and_trains(schema):
+    """context_merger (ref model.py:431,516) fuses input features into the
+    query hidden states in BOTH training and inference paths."""
+    rng = np.random.default_rng(3)
+    batch = make_raw_batch(rng)
+    plain = TwoTower(schema=schema, embedding_dim=16, max_sequence_length=SEQ_LEN)
+    merged = TwoTower(
+        schema=schema, embedding_dim=16, max_sequence_length=SEQ_LEN,
+        context_merger=_GateMerger(),
+    )
+    feats = {"item_id": batch["item_id"]}
+    mask = batch["item_id_mask"]
+    # init through forward_inference so BOTH towers' params are created
+    p_plain = plain.init(jax.random.PRNGKey(0), feats, mask, method=TwoTower.forward_inference)
+    p_merged = merged.init(jax.random.PRNGKey(0), feats, mask, method=TwoTower.forward_inference)
+    # the merger registers its own parameters
+    assert "context_merger" in p_merged["params"]
+    out_plain = plain.apply(p_plain, feats, mask)
+    out_merged = merged.apply(p_merged, feats, mask)
+    assert out_plain.shape == out_merged.shape
+    assert not np.allclose(np.asarray(out_plain), np.asarray(out_merged))
+    # inference path goes through the merger too
+    scores = merged.apply(p_merged, feats, mask, method=TwoTower.forward_inference)
+    assert scores.shape == (BATCH, NUM_ITEMS)
+    # and it trains end-to-end through the shared Trainer
+    trainer = Trainer(
+        model=merged,
+        loss=CESampled(),
+        optimizer=OptimizerFactory(learning_rate=1e-2),
+    )
+    pipeline = Compose(make_default_twotower_transforms(schema)["train"])
+    state, losses = None, []
+    for i in range(4):
+        batch = pipeline(dict(make_raw_batch(np.random.default_rng(i))))
+        if state is None:
+            state = trainer.init_state(batch)
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
