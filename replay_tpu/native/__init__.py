@@ -137,6 +137,10 @@ def gather_pad(
     if ((indices < 0) | (indices >= n_rows)).any():
         msg = "gather_pad: row index out of range"
         raise ValueError(msg)
+    starts, stops = offsets[indices], offsets[indices + 1]
+    if ((starts < 0) | (stops < starts) | (stops > len(values))).any():
+        msg = "gather_pad: offsets out of range"
+        raise ValueError(msg)
     out = np.full((batch, max_len), pad_value, np.float64 if floating else np.int64)
     mask[:] = 0
     for b, row in enumerate(indices):
@@ -189,6 +193,10 @@ def gather_pad_2d(
     n_rows = len(offsets) - 1
     if ((indices < 0) | (indices >= n_rows)).any():
         msg = "gather_pad_2d: row index out of range"
+        raise ValueError(msg)
+    starts, stops = offsets[indices], offsets[indices + 1]
+    if ((starts < 0) | (stops < starts) | (stops > len(values))).any():
+        msg = "gather_pad_2d: offsets out of range"
         raise ValueError(msg)
     out = np.full(
         (batch, max_len, width), pad_value, np.float64 if floating else np.int64

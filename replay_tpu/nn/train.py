@@ -477,6 +477,8 @@ class Trainer:
         postprocessors: Sequence[Callable] = (),
         log_every: int = 100,
         checkpoint_manager=None,
+        checkpoint_every: Optional[int] = None,
+        resume: bool = False,
         monitor: Optional[str] = None,
         patience: Optional[int] = None,
         mode: str = "max",
@@ -497,6 +499,13 @@ class Trainer:
         ``set_epoch`` is called so shuffling advances per epoch), a zero- or
         one-arg callable returning an iterable (the arg is the epoch), or a plain
         one-shot iterator (materialized once if several epochs are requested).
+
+        ``checkpoint_every`` additionally saves MID-epoch every that many steps,
+        recording the data-iterator position (epoch + step within the epoch) in
+        the checkpoint metadata. ``resume=True`` restores the manager's latest
+        checkpoint and fast-forwards the (deterministic, epoch-seeded) batch
+        stream to that exact position, so a killed run continues with the same
+        loss curve as an uninterrupted one.
         """
         if checkpoint_manager is not None and not self.history:
             # resume: prior epoch records survive the restart (metric-history
@@ -523,9 +532,43 @@ class Trainer:
         if patience is not None and patience < 1:
             msg = "patience must be >= 1 (it counts consecutive non-improving epochs)"
             raise ValueError(msg)
+
+        start_epoch, skip_steps, pending_restore_step = 0, 0, None
+        if resume:
+            if checkpoint_manager is None:
+                msg = "resume=True needs a checkpoint_manager"
+                raise ValueError(msg)
+            latest = checkpoint_manager.latest_step()
+            if latest is not None:
+                meta = checkpoint_manager.metadata(latest)
+                if meta.get("mid_epoch"):
+                    start_epoch = int(meta["epoch"])
+                    skip_steps = int(meta["step_in_epoch"])
+                elif "epoch" in meta:
+                    start_epoch = int(meta["epoch"]) + 1
+                else:
+                    msg = (
+                        f"Checkpoint step {latest} carries no data-iterator "
+                        "position ('epoch' missing from its metadata — saved by "
+                        "an older fit or a manual save_checkpoint); resuming "
+                        "would silently retrain from epoch 0 on top of the "
+                        "restored weights. Restore explicitly via "
+                        "restore_checkpoint and pass state= instead."
+                    )
+                    raise ValueError(msg)
+                pending_restore_step = latest
+                logger.info(
+                    "resuming from step %d (epoch %d, fast-forward %d batches)",
+                    latest, start_epoch, skip_steps,
+                )
+
         best_value, best_state, stale_epochs = None, None, 0
-        for epoch in range(epochs):
-            epoch_loss, n_steps = None, 0
+        for epoch in range(start_epoch, epochs):
+            # n_steps = position in the epoch's batch stream (skipped batches
+            # included, keeping checkpoint_every aligned across resumes);
+            # measured_steps = batches that actually trained THIS process
+            epoch_loss, n_steps, measured_steps = None, 0, 0
+            skipped = 0
             epoch_batches = batches_for(epoch)
             if prefetch:
                 from replay_tpu.data.nn.prefetch import prefetch as _prefetch
@@ -534,15 +577,49 @@ class Trainer:
             for batch in epoch_batches:
                 if state is None:
                     state = self.init_state(batch)
+                    if pending_restore_step is not None:
+                        restored = checkpoint_manager.restore(
+                            state, step=pending_restore_step
+                        )
+                        state = _place_tree(
+                            restored, jax.tree.map(self._template_sharding, state)
+                        )
+                        pending_restore_step = None
+                if epoch == start_epoch and skipped < skip_steps:
+                    # fast-forward: the batch stream is deterministic per epoch,
+                    # so consuming without stepping lands on the exact position
+                    skipped += 1
+                    n_steps += 1
+                    continue
                 state, loss_value = self.train_step(state, batch)
                 # accumulate on device: float() here would sync every step
                 epoch_loss = loss_value if epoch_loss is None else epoch_loss + loss_value
                 n_steps += 1
+                measured_steps += 1
                 if log_every and n_steps % log_every == 0:
                     logger.info("epoch %d step %d loss %.4f", epoch, n_steps, float(loss_value))
+                if (
+                    checkpoint_every
+                    and checkpoint_manager is not None
+                    and n_steps % checkpoint_every == 0
+                ):
+                    checkpoint_manager.save(
+                        int(state.step),
+                        state,
+                        history=self.history,
+                        metadata={
+                            "mid_epoch": True, "epoch": epoch, "step_in_epoch": n_steps,
+                        },
+                    )
             record = {
                 "epoch": epoch,
-                "train_loss": float(epoch_loss) / n_steps if n_steps else 0.0,
+                # a resumed epoch averages only the steps THIS process ran;
+                # NaN when every batch was fast-forwarded (nothing measured)
+                "train_loss": (
+                    float(epoch_loss) / measured_steps
+                    if measured_steps
+                    else float("nan")
+                ),
             }
             if val_batches is not None:
                 # several validation streams (the reference's sequential
@@ -583,11 +660,14 @@ class Trainer:
                 else:
                     stale_epochs += 1
             if checkpoint_manager is not None and state is not None:
+                metadata = {"epoch": epoch}
+                if monitor:
+                    metadata.update({"best": improved, monitor: value})
                 checkpoint_manager.save(
                     int(state.step),
                     state,
                     history=self.history,
-                    metadata={"best": improved, monitor: value} if monitor else None,
+                    metadata=metadata,
                 )
                 if improved:
                     checkpoint_manager.mark_best(int(state.step))
@@ -823,17 +903,16 @@ class Trainer:
 
         template = self.init_state(example_batch)
         restored = restore_pytree(path, template)
-
-        def template_sharding(target_leaf):
-            # inherit the template's MESH sharding (params AND optimizer moments
-            # keep their vocab sharding); other leaves replicate over the mesh
-            sharding = getattr(target_leaf, "sharding", None)
-            if not isinstance(sharding, NamedSharding):
-                sharding = NamedSharding(self.mesh, P())
-            return sharding
-
-        shardings = jax.tree.map(template_sharding, template)
+        shardings = jax.tree.map(self._template_sharding, template)
         return _place_tree(restored, shardings)
+
+    def _template_sharding(self, target_leaf):
+        # inherit the template's MESH sharding (params AND optimizer moments
+        # keep their vocab sharding); other leaves replicate over the mesh
+        sharding = getattr(target_leaf, "sharding", None)
+        if not isinstance(sharding, NamedSharding):
+            sharding = NamedSharding(self.mesh, P())
+        return sharding
 
     def predict_dataframe(self, state, batches, k, **kwargs):
         """predict_top_k as a tidy (query_id, item_id, rating) pandas frame —

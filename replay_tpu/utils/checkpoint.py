@@ -160,6 +160,10 @@ class CheckpointManager:
         # the JSON sidecar exists for every backend
         return sorted(int(p.stem.split("_")[1]) for p in self.directory.glob("step_*.json"))
 
+    def metadata(self, step: int) -> dict:
+        """The JSON metadata saved alongside checkpoint ``step``."""
+        return load_metadata(str(self._step_path(step)))
+
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
         return steps[-1] if steps else None

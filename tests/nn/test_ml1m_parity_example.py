@@ -1,0 +1,26 @@
+"""The ml1m_parity harness runs its full pipeline on synthetic data in CI."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.jax
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def test_ml1m_parity_synthetic_pipeline():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO)] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "ml1m_parity.py"), "--epochs", "1"],
+        capture_output=True, text=True, timeout=600, check=False, cwd=str(REPO), env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "synthetic pipeline check OK" in proc.stdout
+    assert "reference 0.0712" in proc.stdout  # parity targets are reported

@@ -201,3 +201,99 @@ def test_trainer_save_checkpoint_backend_param(tmp_path):
         restored.params,
         state.params,
     )
+
+
+@pytest.mark.jax
+def test_mid_epoch_exact_resume(tmp_path):
+    """A run killed mid-epoch and resumed reproduces the uninterrupted run's
+    final parameters EXACTLY: the checkpoint records the data-iterator position
+    (epoch + step within epoch) and fit fast-forwards the deterministic
+    batch stream to it."""
+
+    def train_batches(epoch: int):
+        # deterministic per-epoch stream (the SequenceBatcher set_epoch contract)
+        return [make_batch(epoch * 100 + i) for i in range(7)]
+
+    # uninterrupted reference run: 2 epochs, mid-epoch checkpoints every 3 steps
+    trainer_a = make_trainer()
+    manager_a = CheckpointManager(str(tmp_path / "a"), max_to_keep=100)
+    state_a = trainer_a.fit(
+        train_batches, epochs=2, checkpoint_manager=manager_a, checkpoint_every=3,
+    )
+
+    # simulate the kill: keep only checkpoints up to mid-epoch-1-step-3
+    # (epoch 1 = second epoch; 7 steps/epoch -> global step 10)
+    manager_b = CheckpointManager(str(tmp_path / "b"), max_to_keep=100)
+    import shutil
+
+    for step in manager_a.all_steps():
+        if step <= 10:
+            for suffix in (".npz", ".json"):
+                src = (tmp_path / "a" / f"step_{step}").with_suffix(suffix)
+                if src.exists():
+                    shutil.copy(src, tmp_path / "b" / src.name)
+    assert manager_b.latest_step() == 10
+    from replay_tpu.utils.checkpoint import load_metadata
+
+    meta = load_metadata(str(tmp_path / "b" / "step_10"))
+    assert meta["mid_epoch"] and meta["epoch"] == 1 and meta["step_in_epoch"] == 3
+
+    # resume in a FRESH trainer: restores step 10, fast-forwards 3 batches of
+    # epoch 1, finishes the run
+    trainer_b = make_trainer()
+    state_b = trainer_b.fit(
+        train_batches, epochs=2, checkpoint_manager=manager_b,
+        checkpoint_every=3, resume=True,
+    )
+    assert int(state_b.step) == int(state_a.step)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        state_a.params,
+        state_b.params,
+    )
+    # optimizer state and rng resume exactly too
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        state_a.opt_state,
+        state_b.opt_state,
+    )
+    np.testing.assert_array_equal(np.asarray(state_a.rng), np.asarray(state_b.rng))
+
+
+@pytest.mark.jax
+def test_resume_from_epoch_end_checkpoint(tmp_path):
+    """Resume from an epoch-boundary checkpoint starts at the NEXT epoch."""
+
+    def train_batches(epoch: int):
+        return [make_batch(epoch * 10 + i) for i in range(3)]
+
+    trainer_a = make_trainer()
+    manager_a = CheckpointManager(str(tmp_path / "a"), max_to_keep=100)
+    state_a = trainer_a.fit(train_batches, epochs=3, checkpoint_manager=manager_a)
+
+    manager_b = CheckpointManager(str(tmp_path / "b"), max_to_keep=100)
+    import shutil
+
+    for step in manager_a.all_steps():
+        if step <= 6:  # epochs 0 and 1 complete
+            for suffix in (".npz", ".json"):
+                src = (tmp_path / "a" / f"step_{step}").with_suffix(suffix)
+                if src.exists():
+                    shutil.copy(src, tmp_path / "b" / src.name)
+    trainer_b = make_trainer()
+    state_b = trainer_b.fit(
+        train_batches, epochs=3, checkpoint_manager=manager_b, resume=True
+    )
+    assert int(state_b.step) == int(state_a.step)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        state_a.params,
+        state_b.params,
+    )
+
+
+@pytest.mark.jax
+def test_resume_requires_manager():
+    trainer = make_trainer()
+    with pytest.raises(ValueError, match="checkpoint_manager"):
+        trainer.fit([make_batch(0)], resume=True)
