@@ -119,7 +119,14 @@ class CompiledInference:
             raise ValueError(msg)
         args = [self._params, item_ids, padding_mask]
         if self._candidates_count:
-            args.append(np.asarray(candidates, np.int32))
+            candidates = np.asarray(candidates, np.int32)
+            if candidates.shape != (self._candidates_count,):
+                msg = (
+                    f"candidates shape {candidates.shape} != compiled "
+                    f"({self._candidates_count},)"
+                )
+                raise ValueError(msg)
+            args.append(candidates)
         else:
             args.append(None)
         logits = self._compiled[bucket](*args)

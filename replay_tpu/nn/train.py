@@ -534,9 +534,17 @@ class Trainer:
             raise ValueError(msg)
 
         start_epoch, skip_steps, pending_restore_step = 0, 0, None
+        resumed_best_step = None
         if resume:
             if checkpoint_manager is None:
                 msg = "resume=True needs a checkpoint_manager"
+                raise ValueError(msg)
+            if state is not None:
+                msg = (
+                    "resume=True restores the manager's latest checkpoint; "
+                    "passing state= as well is ambiguous (the explicit state "
+                    "would silently win). Drop one of the two."
+                )
                 raise ValueError(msg)
             latest = checkpoint_manager.latest_step()
             if latest is not None:
@@ -557,12 +565,32 @@ class Trainer:
                     )
                     raise ValueError(msg)
                 pending_restore_step = latest
+                resumed_best_step = checkpoint_manager.best_step()
                 logger.info(
                     "resuming from step %d (epoch %d, fast-forward %d batches)",
                     latest, start_epoch, skip_steps,
                 )
 
         best_value, best_state, stale_epochs = None, None, 0
+        if resume and monitor is not None:
+            # seed the monitored best from the restored history so a worse
+            # post-resume epoch cannot repoint best.json / win the return value
+            seen_values = [r[monitor] for r in self.history if monitor in r]
+            if seen_values:
+                best_value = max(seen_values) if mode == "max" else min(seen_values)
+
+        if pending_restore_step is not None and start_epoch >= epochs:
+            # run already complete: restore the checkpoint and return it instead
+            # of raising "received no batches"
+            first = next(iter(batches_for(0)), None)
+            if first is None:
+                msg = "fit() received no batches"
+                raise ValueError(msg)
+            template = self.init_state(first)
+            restored = checkpoint_manager.restore(template, step=pending_restore_step)
+            logger.info("resume: run already complete at step %d", pending_restore_step)
+            return _place_tree(restored, jax.tree.map(self._template_sharding, template))
+
         for epoch in range(start_epoch, epochs):
             # n_steps = position in the epoch's batch stream (skipped batches
             # included, keeping checkpoint_every aligned across resumes);
@@ -679,6 +707,13 @@ class Trainer:
         if state is None:
             msg = "fit() received no batches"
             raise ValueError(msg)
+        if best_state is None and resumed_best_step is not None and monitor is not None:
+            # no post-resume epoch beat the pre-kill best: return THAT state,
+            # exactly as the uninterrupted run would have
+            restored = checkpoint_manager.restore(state, step=resumed_best_step)
+            best_state = _place_tree(
+                restored, jax.tree.map(self._template_sharding, state)
+            )
         return best_state if best_state is not None else state
 
     # -- eval / predict ---------------------------------------------------- #

@@ -260,3 +260,57 @@ def test_file_uri_source(tmp_path):
     batches = list(batcher)
     assert len(batches) == 2
     assert batches[0]["item_id"].shape == (5, 3)
+
+
+class TestSlabEdges:
+    def test_empty_parquet_yields_nothing(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        path = str(tmp_path / "empty.parquet")
+        pq.write_table(
+            pa.table({"query_id": pa.array([], pa.int64()),
+                      "item_id": pa.array([], pa.list_(pa.int64()))}),
+            path,
+        )
+        batcher = ParquetBatcher(source=path, batch_size=4,
+                                 metadata={"item_id": {"shape": 3}})
+        assert list(batcher) == []
+
+    def test_total_rows_below_batch_size_pads_one_batch(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        path = str(tmp_path / "short.parquet")
+        pq.write_table(
+            pa.table({"query_id": [0, 1, 2], "item_id": [[1], [2, 3], [4]]}), path
+        )
+        batcher = ParquetBatcher(source=path, batch_size=8,
+                                 metadata={"item_id": {"shape": 2}})
+        batches = list(batcher)
+        assert len(batches) == 1
+        assert batches[0]["item_id"].shape == (8, 2)
+        np.testing.assert_array_equal(
+            batches[0]["valid"], [True] * 3 + [False] * 5
+        )
+
+    def test_short_final_slab_carries_into_padded_batch(self, tmp_path):
+        """Rows spanning slab boundaries re-chunk into exact batches with ONE
+        final padded batch (the reference compute_length contract)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        path = str(tmp_path / "carry.parquet")
+        n = 13  # slabs of 5 -> 5+5+3; batch 4 -> 3 full + 1 padded
+        pq.write_table(
+            pa.table({"query_id": np.arange(n), "item_id": [[i] for i in range(n)]}),
+            path,
+        )
+        batcher = ParquetBatcher(source=path, batch_size=4, partition_size=5,
+                                 metadata={"item_id": {"shape": 1}})
+        batches = list(batcher)
+        assert len(batches) == 4
+        assert sum(b["valid"].sum() for b in batches) == n
+        seen = np.concatenate([b["query_id"][b["valid"]] for b in batches])
+        np.testing.assert_array_equal(np.sort(seen), np.arange(n))
+        assert all(b["item_id"].shape == (4, 1) for b in batches)

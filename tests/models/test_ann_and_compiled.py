@@ -127,3 +127,49 @@ class TestCompiledInference:
         want = model.apply({"params": params}, {"item_id": ids}, mask,
                            method=SasRec.forward_inference)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6)
+
+class TestCompiledInferenceEdges:
+    def test_candidate_scoring_and_validation(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(
+            model, params, SEQ_LEN, batch_size=2, candidates_count=5
+        )
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, NUM_ITEMS, (2, SEQ_LEN)).astype(np.int32)
+        mask = np.ones((2, SEQ_LEN), bool)
+        candidates = np.asarray([1, 3, 5, 7, 9])
+        # float/list candidate inputs coerce to int32 and score correctly
+        got = compiled(ids, mask, candidates=[1.0, 3.0, 5.0, 7.0, 9.0])
+        assert got.shape == (2, 5)
+        want = model.apply(
+            {"params": params}, {"item_id": ids}, mask,
+            candidates_to_score=np.asarray(candidates, np.int32),
+            method=SasRec.forward_inference,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6)
+        # wrong candidate count is a clear error, not an XLA shape crash
+        with pytest.raises(ValueError, match="candidates shape"):
+            compiled(ids, mask, candidates=[1, 2, 3])
+        # compiled WITH candidates requires them
+        with pytest.raises(ValueError, match="none given"):
+            compiled(ids, mask)
+
+    def test_candidates_without_compiling_for_them(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(model, params, SEQ_LEN, batch_size=2)
+        with pytest.raises(ValueError, match="candidates_count"):
+            compiled(np.zeros((2, SEQ_LEN), np.int32), np.ones((2, SEQ_LEN), bool),
+                     candidates=[1, 2])
+
+    def test_one_query_mode(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(model, params, SEQ_LEN, mode="one_query")
+        out = compiled(np.zeros((1, SEQ_LEN), np.int32), np.ones((1, SEQ_LEN), bool))
+        assert out.shape == (1, NUM_ITEMS)
+        with pytest.raises(ValueError, match="largest compiled bucket"):
+            compiled(np.zeros((2, SEQ_LEN), np.int32), np.ones((2, SEQ_LEN), bool))
+
+    def test_unknown_mode_rejected(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        with pytest.raises(ValueError, match="mode"):
+            CompiledInference.compile(model, params, SEQ_LEN, mode="streaming")

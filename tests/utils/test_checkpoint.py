@@ -297,3 +297,82 @@ def test_resume_requires_manager():
     trainer = make_trainer()
     with pytest.raises(ValueError, match="checkpoint_manager"):
         trainer.fit([make_batch(0)], resume=True)
+
+
+@pytest.mark.jax
+def test_resume_preserves_monitored_best(tmp_path):
+    """A resumed run must not let a worse post-resume epoch steal best.json or
+    the returned state: best_value is seeded from the restored history and the
+    pre-kill best checkpoint wins when nothing beats it."""
+
+    def scrambled_batch(seed: int) -> dict:
+        # labels decoupled from inputs: unlearnable, so its loss stays HIGH
+        batch = make_batch(seed)
+        rng = np.random.default_rng(seed + 999)
+        batch["positive_labels"] = rng.integers(
+            0, NUM_ITEMS, batch["positive_labels"].shape
+        ).astype(np.int32)
+        return batch
+
+    def train_batches(epoch: int):
+        if epoch >= 2:  # the post-resume epoch is deliberately WORSE
+            return [scrambled_batch(epoch * 10 + i) for i in range(3)]
+        return [make_batch(epoch * 10 + i) for i in range(3)]
+
+    # run 2 learnable epochs with the monitored best recorded on disk
+    trainer_a = make_trainer()
+    manager = CheckpointManager(str(tmp_path / "run"), max_to_keep=100)
+    trainer_a.fit(
+        train_batches, epochs=2, checkpoint_manager=manager, monitor="train_loss",
+        mode="min",
+    )
+    best_before = manager.best_step()
+    best_loss_before = min(r["train_loss"] for r in trainer_a.history)
+
+    # resume into the scrambled epoch: its loss is worse, so the pre-kill best
+    # must survive both in best.json and as the returned state
+    trainer_b = make_trainer()
+    state_b = trainer_b.fit(
+        train_batches, epochs=3, checkpoint_manager=manager, monitor="train_loss",
+        mode="min", resume=True,
+    )
+    assert trainer_b.history[-1]["train_loss"] > best_loss_before
+    assert manager.best_step() == best_before
+    reference_best = manager.restore(state_b, step=best_before)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        reference_best.params,
+        state_b.params,
+    )
+
+
+@pytest.mark.jax
+def test_resume_with_explicit_state_rejected(tmp_path):
+    trainer = make_trainer()
+    manager = CheckpointManager(str(tmp_path / "m"))
+    state = trainer.init_state(make_batch(0))
+    with pytest.raises(ValueError, match="ambiguous"):
+        trainer.fit(
+            [make_batch(0)], state=state, checkpoint_manager=manager, resume=True
+        )
+
+
+@pytest.mark.jax
+def test_resume_already_complete_returns_checkpoint(tmp_path):
+    def train_batches(epoch: int):
+        return [make_batch(epoch * 10 + i) for i in range(3)]
+
+    trainer_a = make_trainer()
+    manager = CheckpointManager(str(tmp_path / "done"), max_to_keep=100)
+    state_a = trainer_a.fit(train_batches, epochs=2, checkpoint_manager=manager)
+
+    trainer_b = make_trainer()
+    state_b = trainer_b.fit(
+        train_batches, epochs=2, checkpoint_manager=manager, resume=True
+    )
+    assert int(state_b.step) == int(state_a.step)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        state_a.params,
+        state_b.params,
+    )
