@@ -1,3 +1,4 @@
+from .schema_builder import TensorSchemaBuilder
 from .iterator import SequenceBatcher, validation_batches
 from .module import DataModule
 from .parquet import ParquetBatcher, write_sequence_parquet
@@ -8,6 +9,7 @@ from .sequence_tokenizer import SequenceTokenizer
 from .sequential_dataset import SequentialDataset
 
 __all__ = [
+    "TensorSchemaBuilder",
     "DataModule",
     "ParquetBatcher",
     "Partitioning",

@@ -1,3 +1,4 @@
+from .ncis import NCISMetric, NCISPrecision
 from .base import Metric, MetricDuplicatesWarning
 from .beyond_accuracy import CategoricalDiversity, Coverage, Novelty, Surprisal, Unexpectedness
 from .builder import MetricsBuilder, metrics_to_df
@@ -6,6 +7,8 @@ from .offline_metrics import Experiment, OfflineMetrics
 from .ranking import MAP, MRR, NDCG, HitRate, Precision, Recall, RocAuc
 
 __all__ = [
+    "NCISPrecision",
+    "NCISMetric",
     "MAP",
     "MRR",
     "NDCG",

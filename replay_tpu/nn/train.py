@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import inspect
 import logging
+import math
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
@@ -281,6 +282,22 @@ class Trainer:
     negative_field: str = "negative_labels"
 
     def __post_init__(self) -> None:
+        if isinstance(self.loss, str):
+            from replay_tpu.nn import loss as loss_zoo
+
+            # only losses constructible with no arguments qualify as shorthands;
+            # parametrized ones (SCE, LogInCE, LogOutCE, sampled variants) need
+            # an explicit instance
+            by_name = {name.lower(): getattr(loss_zoo, name) for name in ("CE", "BCE")}
+            if self.loss.lower() not in by_name:
+                msg = (
+                    f"Unknown loss shorthand {self.loss!r}; use one of "
+                    f"{sorted(by_name)}, or pass a replay_tpu.nn.loss instance "
+                    "(losses with required parameters, e.g. SCE/LogInCE/LogOutCE, "
+                    "must be instantiated by the caller)"
+                )
+                raise ValueError(msg)
+            self.loss = by_name[self.loss.lower()]()
         if self.mesh is None:
             self.mesh = make_mesh()
         self._tx = self.optimizer.create()
@@ -575,20 +592,28 @@ class Trainer:
         if resume and monitor is not None:
             # seed the monitored best from the restored history so a worse
             # post-resume epoch cannot repoint best.json / win the return value
-            seen_values = [r[monitor] for r in self.history if monitor in r]
+            # NaN-guarded: a fully-fast-forwarded resumed epoch records
+            # train_loss=NaN, which would poison max()/min() and freeze `improved`
+            seen_values = [
+                r[monitor] for r in self.history if monitor in r and math.isfinite(r[monitor])
+            ]
             if seen_values:
                 best_value = max(seen_values) if mode == "max" else min(seen_values)
 
         if pending_restore_step is not None and start_epoch >= epochs:
             # run already complete: restore the checkpoint and return it instead
-            # of raising "received no batches"
+            # of raising "received no batches" — the monitored best when one is
+            # marked (what the uninterrupted fit returned), latest otherwise
             first = next(iter(batches_for(0)), None)
             if first is None:
                 msg = "fit() received no batches"
                 raise ValueError(msg)
             template = self.init_state(first)
-            restored = checkpoint_manager.restore(template, step=pending_restore_step)
-            logger.info("resume: run already complete at step %d", pending_restore_step)
+            restore_step = pending_restore_step
+            if monitor is not None and resumed_best_step is not None:
+                restore_step = resumed_best_step
+            restored = checkpoint_manager.restore(template, step=restore_step)
+            logger.info("resume: run already complete at step %d", restore_step)
             return _place_tree(restored, jax.tree.map(self._template_sharding, template))
 
         for epoch in range(start_epoch, epochs):
@@ -840,6 +865,8 @@ class Trainer:
         """
         import itertools
 
+        if isinstance(batches, Mapping):  # a single batch: iterating it would
+            batches = [batches]  # silently yield its string keys
         all_queries, all_items, all_scores = [], [], []
         iterator = iter(batches)
         try:

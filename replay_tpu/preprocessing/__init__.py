@@ -1,3 +1,5 @@
+from .padder import Padder
+from .sequence_generator import SequenceGenerator
 from .converter import CSRConverter
 from .discretizer import (
     Discretizer,
@@ -26,6 +28,8 @@ from .label_encoder import (
 from .sessionizer import Sessionizer
 
 __all__ = [
+    "SequenceGenerator",
+    "Padder",
     "CSRConverter",
     "ConsecutiveDuplicatesFilter",
     "Discretizer",

@@ -1,0 +1,110 @@
+"""Pad ragged list columns to a fixed width.
+
+Capability parity with the reference ``replay/experimental/preprocessing/padder.py:11``
+(``Padder``), pandas-native. Static shapes are the TPU contract — this is the
+host-side tool that turns ragged per-row lists into fixed-width lists before
+they are stacked into ``[B, L]`` arrays (see ``data/nn/iterator.py`` for the
+batching equivalent that also emits validity masks).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+from pandas.api.types import is_object_dtype
+
+PadValue = Union[str, float, int, None]
+
+
+class Padder:
+    """Cut and pad list-valued dataframe columns to ``array_size``.
+
+    >>> df = pd.DataFrame({"items": [[1], [1, 2, 3]]})
+    >>> Padder(pad_columns="items", array_size=2).transform(df)["items"].tolist()
+    [[1, 0], [2, 3]]
+    """
+
+    def __init__(
+        self,
+        pad_columns: Union[str, List[str]],
+        padding_side: str = "right",
+        padding_value: Union[PadValue, List[PadValue]] = 0,
+        array_size: Optional[int] = None,
+        cut_array: bool = True,
+        cut_side: str = "right",
+    ) -> None:
+        """
+        :param pad_columns: list-valued column name(s) to process.
+        :param padding_side: where fill values go, ``"right"`` or ``"left"``.
+        :param padding_value: fill value, one per column (a scalar is
+            broadcast to every column).
+        :param array_size: target width; ``None`` uses each column's max
+            list length.
+        :param cut_array: whether to truncate lists longer than the target.
+        :param cut_side: ``"right"`` keeps the tail (most recent items),
+            ``"left"`` keeps the head.
+        """
+        self.pad_columns = [pad_columns] if isinstance(pad_columns, str) else list(pad_columns)
+        if padding_side not in ("right", "left"):
+            msg = f"padding_side must be 'right' or 'left', got {padding_side}"
+            raise ValueError(msg)
+        if cut_side not in ("right", "left"):
+            msg = f"cut_side must be 'right' or 'left', got {cut_side}"
+            raise ValueError(msg)
+        values: List[PadValue]
+        if isinstance(padding_value, (str, bytes)) or not isinstance(padding_value, Sequence):
+            values = [padding_value]
+        else:
+            values = list(padding_value)
+        if len(values) == 1 and len(self.pad_columns) > 1:
+            values = values * len(self.pad_columns)
+        if len(values) != len(self.pad_columns):
+            msg = "pad_columns and padding_value must have the same length"
+            raise ValueError(msg)
+        self.padding_value = values
+        if array_size is not None and (not isinstance(array_size, int) or array_size < 1):
+            msg = f"array_size must be a positive integer, got {array_size}"
+            raise ValueError(msg)
+        self.array_size = array_size
+        self.padding_side = padding_side
+        self.cut_array = cut_array
+        self.cut_side = cut_side
+
+    @staticmethod
+    def _as_list(sample) -> list:
+        """Cell -> python list; tuples/ndarrays (e.g. parquet round-trips)
+        count as sequences, None/NaN/scalars as empty."""
+        if isinstance(sample, list):
+            return sample
+        if isinstance(sample, (tuple, np.ndarray)):
+            return list(sample)
+        return []
+
+    def _pad_one(self, sample, width: int, fill) -> list:
+        sample = self._as_list(sample)
+        if self.cut_array and len(sample) > width:
+            sample = sample[-width:] if self.cut_side == "right" else sample[:width]
+        missing = width - len(sample)
+        if missing <= 0:
+            return sample
+        pad = [fill] * missing
+        return sample + pad if self.padding_side == "right" else pad + sample
+
+    def transform(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        """Return a copy of ``interactions`` with the pad columns widened."""
+        out = interactions.copy()
+        for col, fill in zip(self.pad_columns, self.padding_value):
+            if col not in out.columns:
+                msg = f"Column {col} not in DataFrame columns."
+                raise ValueError(msg)
+            if not is_object_dtype(out[col]):
+                msg = f"Column {col} should hold python lists (object dtype)."
+                raise ValueError(msg)
+            width = self.array_size
+            if width is None:
+                lengths = out[col].map(lambda x: len(self._as_list(x)))
+                width = int(lengths.max()) if len(lengths) else 0
+            out[col] = [self._pad_one(sample, width, fill) for sample in out[col]]
+        return out

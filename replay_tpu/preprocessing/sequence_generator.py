@@ -1,0 +1,117 @@
+"""Expand an interaction log into next-item prediction examples.
+
+Capability parity with the reference
+``replay/experimental/preprocessing/sequence_generator.py:13`` (``SequenceGenerator``),
+pandas-native. Every interaction becomes one training example whose input is
+the (up to ``len_window``) preceding interactions of the same group and whose
+label is the interaction itself; group-initial rows (empty history) are
+dropped.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import pandas as pd
+
+
+class SequenceGenerator:
+    """Build ``(history list | next item)`` examples per group.
+
+    >>> log = pd.DataFrame({
+    ...     "user_id": [1, 1, 1],
+    ...     "item_id": [3, 7, 10],
+    ...     "timestamp": [1, 2, 3],
+    ... })
+    >>> SequenceGenerator("user_id", orderby_column="timestamp",
+    ...                   transform_columns="item_id").transform(log)[
+    ...     ["user_id", "item_id_list", "label_item_id"]].values.tolist()
+    [[1, [3], 7], [1, [3, 7], 10]]
+    """
+
+    def __init__(
+        self,
+        groupby_column: Union[str, List[str]],
+        orderby_column: Optional[Union[str, List[str]]] = None,
+        transform_columns: Optional[Union[str, List[str]]] = None,
+        len_window: int = 50,
+        sequence_prefix: Optional[str] = None,
+        sequence_suffix: Optional[str] = "_list",
+        label_prefix: Optional[str] = "label_",
+        label_suffix: Optional[str] = None,
+        get_list_len: bool = False,
+        list_len_column: str = "list_len",
+    ) -> None:
+        """
+        :param groupby_column: grouping key(s) — usually the user column.
+        :param orderby_column: sort key(s) defining sequence order; ``None``
+            keeps the frame's order within each group.
+        :param transform_columns: columns to expand into history lists;
+            ``None`` processes every non-grouping column.
+        :param len_window: maximum history length kept per example.
+        :param sequence_prefix: prefix for generated history columns.
+        :param sequence_suffix: suffix for generated history columns.
+        :param label_prefix: prefix for generated label columns.
+        :param label_suffix: suffix for generated label columns.
+        :param get_list_len: also emit the history length per example.
+        :param list_len_column: name of the length column.
+        """
+        if len_window < 1:
+            msg = f"len_window must be positive, got {len_window}"
+            raise ValueError(msg)
+        self.groupby_column = [groupby_column] if isinstance(groupby_column, str) else list(groupby_column)
+        if orderby_column is None:
+            self.orderby_column = None
+        else:
+            self.orderby_column = [orderby_column] if isinstance(orderby_column, str) else list(orderby_column)
+        self.transform_columns = (
+            [transform_columns] if isinstance(transform_columns, str) else transform_columns
+        )
+        self.len_window = len_window
+        self.sequence_prefix = sequence_prefix or ""
+        self.sequence_suffix = sequence_suffix or ""
+        self.label_prefix = label_prefix or ""
+        self.label_suffix = label_suffix or ""
+        self.get_list_len = get_list_len
+        self.list_len_column = list_len_column
+
+    def _seq_name(self, col: str) -> str:
+        return f"{self.sequence_prefix}{col}{self.sequence_suffix}"
+
+    def _label_name(self, col: str) -> str:
+        return f"{self.label_prefix}{col}{self.label_suffix}"
+
+    def transform(self, interactions: pd.DataFrame) -> pd.DataFrame:
+        """Return the example frame (group keys, history lists, labels)."""
+        transform_columns = self.transform_columns
+        if transform_columns is None:
+            transform_columns = [c for c in interactions.columns if c not in self.groupby_column]
+
+        ordered = interactions.sort_values(
+            by=self.orderby_column if self.orderby_column is not None else self.groupby_column,
+            kind="stable",
+        )
+
+        rows: dict = {col: [] for col in self.groupby_column}
+        for col in transform_columns:
+            rows[self._seq_name(col)] = []
+            rows[self._label_name(col)] = []
+        if self.get_list_len:
+            rows[self.list_len_column] = []
+
+        for keys, group in ordered.groupby(self.groupby_column, sort=False):
+            if not isinstance(keys, tuple):
+                keys = (keys,)
+            histories = {col: group[col].tolist() for col in transform_columns}
+            n = len(group)
+            for i in range(1, n):  # row 0 has no history and is dropped
+                lo = max(0, i - self.len_window)
+                for key_col, key in zip(self.groupby_column, keys):
+                    rows[key_col].append(key)
+                for col in transform_columns:
+                    values = histories[col]
+                    rows[self._seq_name(col)].append(values[lo:i])
+                    rows[self._label_name(col)].append(values[i])
+                if self.get_list_len:
+                    rows[self.list_len_column].append(i - lo)
+        return pd.DataFrame(rows)

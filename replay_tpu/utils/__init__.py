@@ -1,3 +1,5 @@
+from .distributions import item_distribution
+from .time import get_item_recency, smoothe_time
 from .checkpoint import CheckpointManager, load_metadata, restore_pytree, save_pytree
 from .profiling import StepTimer, trace
 from .session import State, get_default_mesh, setup_logging
@@ -15,6 +17,9 @@ from .types import (
 )
 
 __all__ = [
+    "smoothe_time",
+    "get_item_recency",
+    "item_distribution",
     "OPTUNA_AVAILABLE",
     "PANDAS_AVAILABLE",
     "POLARS_AVAILABLE",

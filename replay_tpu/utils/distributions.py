@@ -1,0 +1,51 @@
+"""Item-popularity distribution diagnostics.
+
+Capability parity with the reference ``replay/utils/distributions.py:11-33``
+(``item_distribution``), pandas-native: per-item distinct-user counts in the
+historical log joined against per-item counts in the top-k recommendations.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+
+def item_distribution(
+    log: pd.DataFrame,
+    recommendations: pd.DataFrame,
+    k: int,
+    query_column: str = "query_id",
+    item_column: str = "item_id",
+    rating_column: str = "rating",
+) -> pd.DataFrame:
+    """Compare item exposure in history vs. a model's top-k recommendations.
+
+    :param log: historical interactions (popularity source).
+    :param recommendations: scored recommendations; the top ``k`` rows per
+        query by ``rating_column`` are kept before counting.
+    :param k: recommendation list length.
+    :return: one row per item with ``user_count`` (distinct users in the log)
+        and ``rec_count`` (appearances in the truncated recommendations),
+        sorted by ``[user_count, item_column]``; items present on only one
+        side get a zero count on the other.
+    """
+    hist = (
+        log.groupby(item_column)[query_column]
+        .nunique()
+        .rename("user_count")
+        .reset_index()
+    )
+    top = recommendations.sort_values(
+        by=[rating_column], ascending=False, kind="stable"
+    ).groupby(query_column, sort=False)
+    top_recs = top.head(k)
+    rec = (
+        top_recs.groupby(item_column)[query_column]
+        .nunique()
+        .rename("rec_count")
+        .reset_index()
+    )
+    res = hist.merge(rec, on=item_column, how="outer").fillna(0)
+    res["user_count"] = res["user_count"].astype("int64")
+    res["rec_count"] = res["rec_count"].astype("int64")
+    return res.sort_values(["user_count", item_column], kind="stable").reset_index(drop=True)

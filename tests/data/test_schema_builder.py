@@ -1,0 +1,49 @@
+"""TensorSchemaBuilder fluent construction."""
+
+import pytest
+
+from replay_tpu.data.nn import TensorFeatureSource, TensorSchemaBuilder
+from replay_tpu.data.schema import FeatureHint, FeatureSource, FeatureType
+
+
+class TestTensorSchemaBuilder:
+    def test_builds_all_feature_kinds(self):
+        schema = (
+            TensorSchemaBuilder()
+            .categorical(
+                "item_id",
+                cardinality=100,
+                is_seq=True,
+                feature_source=TensorFeatureSource(FeatureSource.INTERACTIONS, "item_id"),
+                feature_hint=FeatureHint.ITEM_ID,
+                embedding_dim=32,
+            )
+            .categorical_list("genres", cardinality=20, is_seq=True)
+            .numerical("age", tensor_dim=1)
+            .numerical_list("ctx", tensor_dim=4, is_seq=True)
+            .build()
+        )
+        assert [f.name for f in schema.all_features] == ["item_id", "genres", "age", "ctx"]
+        item = schema["item_id"]
+        assert item.feature_type == FeatureType.CATEGORICAL
+        assert item.cardinality == 100
+        assert item.embedding_dim == 32
+        assert item.feature_hint == FeatureHint.ITEM_ID
+        assert schema["genres"].feature_type == FeatureType.CATEGORICAL_LIST
+        assert schema["age"].feature_type == FeatureType.NUMERICAL
+        assert schema["age"].tensor_dim == 1
+        assert schema["ctx"].feature_type == FeatureType.NUMERICAL_LIST
+
+    def test_same_name_overwrites(self):
+        schema = (
+            TensorSchemaBuilder()
+            .categorical("x", cardinality=5)
+            .categorical("x", cardinality=9)
+            .build()
+        )
+        assert len(schema.all_features) == 1
+        assert schema["x"].cardinality == 9
+
+    def test_chaining_returns_builder(self):
+        builder = TensorSchemaBuilder()
+        assert builder.categorical("a", cardinality=2) is builder

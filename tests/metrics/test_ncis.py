@@ -1,0 +1,107 @@
+"""NCIS-weighted metrics (counterfactual evaluation)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.metrics import NCISPrecision, Precision
+
+
+def frame(rows, columns=("query_id", "item_id", "rating")):
+    return pd.DataFrame(rows, columns=list(columns))
+
+
+@pytest.fixture
+def recs():
+    return frame([(1, "a", 3.0), (1, "b", 2.0), (1, "c", 1.0)])
+
+
+@pytest.fixture
+def gt():
+    return frame([(1, "a", 1.0), (1, "c", 1.0)])
+
+
+class TestNCISPrecision:
+    def test_hand_computed_weights(self, recs, gt):
+        prev = frame([(1, "a", 1.0), (1, "b", 0.5)])
+        # weights: a -> 3/1 = 3, b -> 2/0.5 = 4, c missing -> threshold 10
+        # precision@3 = (3*1 + 4*0 + 10*1) / (3 + 4 + 10)
+        res = NCISPrecision(topk=3, prev_policy_weights=prev, threshold=10.0)(recs, gt)
+        assert res["NCISPrecision@3"] == pytest.approx(13.0 / 17.0)
+
+    def test_clipping(self, recs, gt):
+        prev = frame([(1, "a", 300.0), (1, "b", 2.0), (1, "c", 0.001)])
+        # ratios: 0.01 -> clip to 1/2; 1.0; 1000 -> clip to 2
+        res = NCISPrecision(topk=3, prev_policy_weights=prev, threshold=2.0)(recs, gt)
+        assert res["NCISPrecision@3"] == pytest.approx((0.5 * 1 + 1.0 * 0 + 2.0 * 1) / 3.5)
+
+    def test_uniform_weights_match_plain_precision(self, recs, gt):
+        # identical policies -> every weight is 1 -> plain precision
+        prev = frame([(1, "a", 3.0), (1, "b", 2.0), (1, "c", 1.0)])
+        ncis = NCISPrecision(topk=[1, 2, 3], prev_policy_weights=prev)(recs, gt)
+        plain = Precision(topk=[1, 2, 3])(recs, gt)
+        for k in (1, 2, 3):
+            assert ncis[f"NCISPrecision@{k}"] == pytest.approx(plain[f"Precision@{k}"])
+
+    def test_sigmoid_activation(self, recs, gt):
+        prev = frame([(1, "a", 3.0), (1, "b", 2.0), (1, "c", 1.0)])
+        res = NCISPrecision(
+            topk=3, prev_policy_weights=prev, activation="sigmoid"
+        )(recs, gt)
+        # same scores both sides -> sigmoid ratio 1 -> plain precision
+        assert res["NCISPrecision@3"] == pytest.approx(2.0 / 3.0)
+
+    def test_softmax_activation(self, recs, gt):
+        prev = frame([(1, "a", 1.0), (1, "b", 1.0), (1, "c", 1.0)])
+        res = NCISPrecision(
+            topk=3, prev_policy_weights=prev, activation="softmax", threshold=100.0
+        )(recs, gt)
+        cur = np.exp([3.0, 2.0, 1.0])
+        cur = cur / cur.sum()
+        w = cur / (1.0 / 3.0)
+        expected = (w[0] + w[2]) / w.sum()
+        assert res["NCISPrecision@3"] == pytest.approx(expected)
+
+    def test_softmax_ignores_missing_pairs(self, recs, gt):
+        # items b, c unlogged: their filler zeros must NOT deflate item a's
+        # logged propensity (softmax over logged entries only); a's weight is
+        # softmax(cur)[a] / 1.0, b and c get the max-surprise threshold
+        prev = frame([(1, "a", 5.0)])
+        res = NCISPrecision(
+            topk=3, prev_policy_weights=prev, activation="softmax", threshold=10.0
+        )(recs, gt)
+        cur = np.exp([3.0, 2.0, 1.0])
+        cur = cur / cur.sum()
+        w = np.clip([cur[0] / 1.0, 10.0, 10.0], 0.1, 10.0)
+        expected = (w[0] + w[2]) / w.sum()
+        assert res["NCISPrecision@3"] == pytest.approx(expected)
+
+    def test_user_without_recs_scores_zero(self, recs):
+        prev = frame([(1, "a", 1.0)])
+        gt2 = frame([(1, "a", 1.0), (2, "z", 1.0)])
+        res = NCISPrecision(topk=1, prev_policy_weights=prev)(recs, gt2)
+        # user 1: hit at rank 1 -> 1.0; user 2 has no recs -> 0.0
+        assert res["NCISPrecision@1"] == pytest.approx(0.5)
+
+    def test_bad_threshold(self):
+        with pytest.raises(ValueError, match="threshold"):
+            NCISPrecision(topk=1, prev_policy_weights=frame([]), threshold=0.0)
+
+    def test_bad_activation(self):
+        with pytest.raises(ValueError, match="activation"):
+            NCISPrecision(topk=1, prev_policy_weights=frame([]), activation="relu")
+
+    def test_per_user_mode(self, recs, gt):
+        from replay_tpu.metrics import PerUser
+
+        prev = frame([(1, "a", 3.0), (1, "b", 2.0), (1, "c", 1.0)])
+        gt2 = pd.concat([gt, frame([(2, "z", 1.0)])])
+        res = NCISPrecision(topk=3, prev_policy_weights=prev, mode=PerUser())(recs, gt2)
+        per_user = res["NCISPrecision-PerUser@3"]
+        assert per_user[1] == pytest.approx(2.0 / 3.0)
+        assert per_user[2] == 0.0
+
+    def test_dict_recs_rejected(self, gt):
+        metric = NCISPrecision(topk=1, prev_policy_weights=frame([]))
+        with pytest.raises(TypeError, match="DataFrame"):
+            metric({1: ["a"]}, gt)

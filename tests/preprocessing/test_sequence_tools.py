@@ -1,0 +1,162 @@
+"""Padder and SequenceGenerator (experimental preprocessing parity)."""
+
+import pandas as pd
+import pytest
+
+from replay_tpu.preprocessing import Padder, SequenceGenerator
+
+
+@pytest.fixture
+def ragged():
+    return pd.DataFrame(
+        {
+            "user_id": [1, 2, 3],
+            "timestamp": [[1], [4, 7, 12, 126], [1, 2, 3, 4, 5, 6, 7]],
+            "item_id": [["a"], ["d", "e", "m", "g"], ["a", "b", "c", "d", "a", "f", "e"]],
+        }
+    )
+
+
+class TestPadder:
+    def test_pad_cut_right(self, ragged):
+        out = Padder(
+            pad_columns=["item_id", "timestamp"],
+            padding_side="right",
+            padding_value=["[PAD]", 0],
+            array_size=5,
+            cut_array=True,
+            cut_side="right",
+        ).transform(ragged)
+        assert out["timestamp"].tolist() == [
+            [1, 0, 0, 0, 0],
+            [4, 7, 12, 126, 0],
+            [3, 4, 5, 6, 7],
+        ]
+        assert out["item_id"].tolist()[0] == ["a", "[PAD]", "[PAD]", "[PAD]", "[PAD]"]
+        assert out["item_id"].tolist()[2] == ["c", "d", "a", "f", "e"]
+
+    def test_left_padding_left_cut(self, ragged):
+        out = Padder(
+            pad_columns="timestamp", padding_side="left", array_size=3, cut_side="left"
+        ).transform(ragged)
+        assert out["timestamp"].tolist() == [[0, 0, 1], [4, 7, 12], [1, 2, 3]]
+
+    def test_no_cut_keeps_long_rows(self, ragged):
+        out = Padder(pad_columns="timestamp", array_size=3, cut_array=False).transform(ragged)
+        assert out["timestamp"].tolist()[2] == [1, 2, 3, 4, 5, 6, 7]
+
+    def test_default_width_is_max_length(self, ragged):
+        out = Padder(pad_columns="timestamp").transform(ragged)
+        assert all(len(row) == 7 for row in out["timestamp"])
+
+    def test_non_list_becomes_padding(self):
+        df = pd.DataFrame({"x": [[1, 2], None]})
+        out = Padder(pad_columns="x", array_size=2).transform(df)
+        assert out["x"].tolist() == [[1, 2], [0, 0]]
+
+    def test_ndarray_and_tuple_cells(self):
+        # parquet round-trips hand back np.ndarray cells; tuples also count
+        import numpy as np
+
+        df = pd.DataFrame({"x": [np.array([1, 2, 3]), (4,)]})
+        out = Padder(pad_columns="x", array_size=2).transform(df)
+        assert out["x"].tolist() == [[2, 3], [4, 0]]
+        widest = Padder(pad_columns="x").transform(df)  # max-length path
+        assert widest["x"].tolist() == [[1, 2, 3], [4, 0, 0]]
+
+    def test_scalar_value_broadcast(self, ragged):
+        padder = Padder(pad_columns=["item_id", "timestamp"], padding_value=0)
+        assert padder.padding_value == [0, 0]
+
+    def test_mismatched_values_raise(self):
+        with pytest.raises(ValueError, match="same length"):
+            Padder(pad_columns=["a", "b", "c"], padding_value=[0, 1])
+
+    def test_missing_column_raises(self, ragged):
+        with pytest.raises(ValueError, match="not in DataFrame"):
+            Padder(pad_columns="nope").transform(ragged)
+
+    def test_non_list_column_raises(self, ragged):
+        with pytest.raises(ValueError, match="object dtype"):
+            Padder(pad_columns="user_id").transform(ragged)
+
+    def test_bad_sides_raise(self):
+        with pytest.raises(ValueError, match="padding_side"):
+            Padder(pad_columns="x", padding_side="middle")
+        with pytest.raises(ValueError, match="cut_side"):
+            Padder(pad_columns="x", cut_side="middle")
+
+    def test_input_not_mutated(self, ragged):
+        before = ragged.copy(deep=True)
+        Padder(pad_columns="timestamp", array_size=2).transform(ragged)
+        assert ragged["timestamp"].tolist() == before["timestamp"].tolist()
+
+
+class TestSequenceGenerator:
+    @pytest.fixture
+    def log(self):
+        return pd.DataFrame(
+            {
+                "user_id": [1, 1, 1, 2, 2, 2, 3, 3, 3, 3],
+                "item_id": [3, 7, 10, 5, 8, 11, 4, 9, 2, 5],
+                "timestamp": [1, 2, 3, 3, 2, 1, 3, 12, 1, 4],
+            }
+        )
+
+    def test_reference_example(self, log):
+        # expected rows are the reference doctest
+        # (replay/experimental/preprocessing/sequence_generator.py:31-63)
+        out = SequenceGenerator(
+            groupby_column="user_id", transform_columns=["item_id", "timestamp"]
+        ).transform(log)
+        assert out["user_id"].tolist() == [1, 1, 2, 2, 3, 3, 3]
+        assert out["item_id_list"].tolist() == [
+            [3], [3, 7], [5], [5, 8], [4], [4, 9], [4, 9, 2],
+        ]
+        assert out["label_item_id"].tolist() == [7, 10, 8, 11, 9, 2, 5]
+        assert out["timestamp_list"].tolist() == [
+            [1], [1, 2], [3], [3, 2], [3], [3, 12], [3, 12, 1],
+        ]
+
+    def test_orderby(self, log):
+        out = SequenceGenerator(
+            groupby_column="user_id",
+            orderby_column="timestamp",
+            transform_columns="item_id",
+        ).transform(log)
+        user3 = out[out["user_id"] == 3]
+        assert user3["item_id_list"].tolist() == [[2], [2, 4], [2, 4, 5]]
+        assert user3["label_item_id"].tolist() == [4, 5, 9]
+
+    def test_window_caps_history(self, log):
+        out = SequenceGenerator(
+            groupby_column="user_id", transform_columns="item_id", len_window=2
+        ).transform(log)
+        assert max(len(s) for s in out["item_id_list"]) == 2
+        user3 = out[out["user_id"] == 3]
+        assert user3["item_id_list"].tolist() == [[4], [4, 9], [9, 2]]
+
+    def test_list_len_column(self, log):
+        out = SequenceGenerator(
+            groupby_column="user_id", transform_columns="item_id", get_list_len=True
+        ).transform(log)
+        assert out["list_len"].tolist() == [len(s) for s in out["item_id_list"]]
+
+    def test_affixes(self, log):
+        out = SequenceGenerator(
+            groupby_column="user_id",
+            transform_columns="item_id",
+            sequence_prefix="hist_",
+            sequence_suffix="",
+            label_prefix="",
+            label_suffix="_next",
+        ).transform(log)
+        assert "hist_item_id" in out.columns and "item_id_next" in out.columns
+
+    def test_default_transform_columns(self, log):
+        out = SequenceGenerator(groupby_column="user_id").transform(log)
+        assert "item_id_list" in out.columns and "timestamp_list" in out.columns
+
+    def test_bad_window_raises(self):
+        with pytest.raises(ValueError, match="len_window"):
+            SequenceGenerator("user_id", len_window=0)

@@ -376,3 +376,45 @@ def test_resume_already_complete_returns_checkpoint(tmp_path):
         state_a.params,
         state_b.params,
     )
+
+
+@pytest.mark.jax
+def test_resume_already_complete_returns_monitored_best(tmp_path):
+    """When the finished run tracked a monitor, re-running with resume=True must
+    hand back the BEST checkpoint (what the original fit returned), not the
+    latest one."""
+
+    def scrambled_batch(seed: int) -> dict:
+        batch = make_batch(seed)
+        rng = np.random.default_rng(seed + 999)
+        batch["positive_labels"] = rng.integers(
+            0, NUM_ITEMS, batch["positive_labels"].shape
+        ).astype(np.int32)
+        return batch
+
+    def train_batches(epoch: int):
+        if epoch >= 2:  # the final epoch is deliberately worse
+            return [scrambled_batch(epoch * 10 + i) for i in range(3)]
+        return [make_batch(epoch * 10 + i) for i in range(3)]
+
+    trainer_a = make_trainer()
+    manager = CheckpointManager(str(tmp_path / "done_best"), max_to_keep=100)
+    state_a = trainer_a.fit(
+        train_batches, epochs=3, checkpoint_manager=manager, monitor="train_loss",
+        mode="min",
+    )
+    best_step = manager.best_step()
+    assert best_step is not None and best_step != manager.latest_step()
+    assert int(state_a.step) == best_step  # fit returned the best, not latest
+
+    trainer_b = make_trainer()
+    state_b = trainer_b.fit(
+        train_batches, epochs=3, checkpoint_manager=manager, monitor="train_loss",
+        mode="min", resume=True,
+    )
+    assert int(state_b.step) == best_step
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        state_a.params,
+        state_b.params,
+    )

@@ -1,0 +1,136 @@
+"""Time smoothing (utils/time.py) and item_distribution (utils/distributions.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.utils import get_item_recency, item_distribution, smoothe_time
+
+
+@pytest.fixture
+def five_row_log():
+    return pd.DataFrame(
+        {
+            "item_id": [1, 1, 2, 3, 3],
+            "timestamp": ["2099-03-19", "2099-03-20", "2099-03-22", "2099-03-27", "2099-03-25"],
+            "rating": [1.0, 1.0, 1.0, 1.0, 1.0],
+        }
+    )
+
+
+class TestSmootheTime:
+    # expected values are the reference's doctest outputs
+    # (replay/utils/time.py:147-231) — behavior parity fixtures.
+    def test_power(self, five_row_log):
+        out = smoothe_time(five_row_log, kind="power").sort_values("timestamp")
+        assert out["rating"].round(4).tolist() == [0.639, 0.6546, 0.6941, 0.7994, 1.0]
+
+    def test_exp(self, five_row_log):
+        out = smoothe_time(five_row_log, kind="exp").sort_values("timestamp")
+        assert out["rating"].round(4).tolist() == [0.8312, 0.8507, 0.8909, 0.9548, 1.0]
+
+    def test_linear(self, five_row_log):
+        out = smoothe_time(five_row_log, kind="linear").sort_values("timestamp")
+        assert out["rating"].round(4).tolist() == [0.8667, 0.8833, 0.9167, 0.9667, 1.0]
+
+    def test_scales_existing_rating(self):
+        df = pd.DataFrame(
+            {
+                "item_id": [1, 2, 3],
+                "timestamp": ["2099-03-19", "2099-03-20", "2099-03-22"],
+                "rating": [10.0, 3.0, 0.1],
+            }
+        )
+        out = smoothe_time(df)
+        assert out["rating"].round(4).tolist() == [9.3303, 2.8645, 0.1]
+
+    def test_limit_floor(self):
+        df = pd.DataFrame(
+            {
+                "item_id": [1, 2],
+                "timestamp": ["2000-01-01", "2099-01-01"],
+                "rating": [1.0, 1.0],
+            }
+        )
+        out = smoothe_time(df, decay=2, limit=0.25, kind="exp")
+        assert out["rating"].tolist() == [0.25, 1.0]
+
+    def test_numeric_timestamps(self):
+        df = pd.DataFrame(
+            {"item_id": [1, 2], "timestamp": [0, 86400 * 30], "rating": [1.0, 1.0]}
+        )
+        out = smoothe_time(df, decay=30, kind="exp")
+        assert out["rating"].round(6).tolist() == [0.5, 1.0]
+
+    def test_input_not_mutated(self, five_row_log):
+        before = five_row_log.copy()
+        smoothe_time(five_row_log)
+        pd.testing.assert_frame_equal(five_row_log, before)
+
+    def test_bad_kind_raises(self, five_row_log):
+        with pytest.raises(ValueError, match="kind"):
+            smoothe_time(five_row_log, kind="log")
+
+    def test_bad_decay_raises(self, five_row_log):
+        with pytest.raises(ValueError, match="decay"):
+            smoothe_time(five_row_log, decay=1.0)
+
+
+class TestGetItemRecency:
+    def test_power(self, five_row_log):
+        out = get_item_recency(five_row_log, kind="power").sort_values("item_id")
+        # reference doctest: item means 03-19 12:00 / 03-22 / 03-26
+        assert out["rating"].round(4).tolist() == [0.6632, 0.7204, 1.0]
+
+    def test_one_row_per_item(self, five_row_log):
+        out = get_item_recency(five_row_log)
+        assert sorted(out["item_id"].tolist()) == [1, 2, 3]
+
+    def test_ratings_ignored(self, five_row_log):
+        loud = five_row_log.assign(rating=[100.0, 1.0, 5.0, 0.1, 2.0])
+        pd.testing.assert_frame_equal(
+            get_item_recency(five_row_log), get_item_recency(loud)
+        )
+
+    def test_numeric_timestamps_stay_numeric(self):
+        df = pd.DataFrame(
+            {"item_id": [1, 2], "timestamp": [0, 86400 * 30], "rating": [1.0, 1.0]}
+        )
+        out = get_item_recency(df, decay=30, kind="exp")
+        assert pd.api.types.is_numeric_dtype(out["timestamp"])
+        assert out["timestamp"].tolist() == [0.0, 86400.0 * 30]
+        assert out["rating"].round(6).tolist() == [0.5, 1.0]
+
+
+class TestItemDistribution:
+    def test_counts(self):
+        log = pd.DataFrame(
+            {
+                "query_id": [1, 1, 2, 3, 3, 3],
+                "item_id": [10, 11, 10, 10, 11, 12],
+                "rating": [1.0] * 6,
+            }
+        )
+        recs = pd.DataFrame(
+            {
+                "query_id": [1, 1, 1, 2, 2],
+                "item_id": [10, 11, 13, 11, 13],
+                "rating": [3.0, 2.0, 1.0, 9.0, 8.0],
+            }
+        )
+        out = item_distribution(log, recs, k=2)
+        by_item = out.set_index("item_id")
+        # item 13 never in log; item 12 never recommended; k=2 truncates
+        # user 1's third rec (item 13 at rank 3).
+        assert by_item.loc[10, "user_count"] == 3 and by_item.loc[10, "rec_count"] == 1
+        assert by_item.loc[11, "user_count"] == 2 and by_item.loc[11, "rec_count"] == 2
+        assert by_item.loc[12, "user_count"] == 1 and by_item.loc[12, "rec_count"] == 0
+        assert by_item.loc[13, "user_count"] == 0 and by_item.loc[13, "rec_count"] == 1
+
+    def test_sorted_by_popularity(self):
+        log = pd.DataFrame(
+            {"query_id": [1, 2, 3, 1], "item_id": [5, 5, 5, 6], "rating": [1.0] * 4}
+        )
+        recs = pd.DataFrame({"query_id": [1], "item_id": [5], "rating": [1.0]})
+        out = item_distribution(log, recs, k=1)
+        assert out["user_count"].is_monotonic_increasing
