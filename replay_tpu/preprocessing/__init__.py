@@ -1,3 +1,4 @@
+from .data_preparator import DataPreparator
 from .padder import Padder
 from .sequence_generator import SequenceGenerator
 from .converter import CSRConverter
@@ -28,6 +29,7 @@ from .label_encoder import (
 from .sessionizer import Sessionizer
 
 __all__ = [
+    "DataPreparator",
     "SequenceGenerator",
     "Padder",
     "CSRConverter",

@@ -1,0 +1,162 @@
+"""Raw-data intake: files or frames → the canonical interaction-log layout.
+
+Capability parity with the reference
+``replay/experimental/preprocessing/data_preparator.py:406`` (``DataPreparator``),
+pandas-native. One call reads a file (csv/parquet/json) or takes a frame,
+validates a ``columns_mapping``, renames to the canonical column names
+(``query_id/item_id/timestamp/rating`` here — the reference's
+``user_id/…/relevance``), fills absent log columns with defaults, and coerces
+timestamp/rating dtypes. A mapping holding both ``query_id`` and ``item_id``
+marks an interactions log; a single one marks a query/item feature frame
+(no column generation or coercion beyond the rename).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import pandas as pd
+
+LOG_COLUMNS = ("query_id", "item_id", "timestamp", "rating")
+
+logger = logging.getLogger("replay_tpu")
+
+
+class DataPreparator:
+    """Normalize arbitrary raw frames/files into the library format.
+
+    >>> raw = pd.DataFrame({"user": [2, 2, 1], "movie": [1, 2, 3], "rel": [5, 4, 3]})
+    >>> out = DataPreparator().transform(
+    ...     columns_mapping={"query_id": "user", "item_id": "movie", "rating": "rel"},
+    ...     data=raw,
+    ... )
+    >>> sorted(out.columns)
+    ['item_id', 'query_id', 'rating', 'timestamp']
+    """
+
+    DEFAULT_RATING = 1.0
+    DEFAULT_TIMESTAMP = "2099-01-01"
+
+    @staticmethod
+    def read_as_pandas_df(
+        data: Optional[pd.DataFrame] = None,
+        path: Optional[str] = None,
+        format_type: Optional[str] = None,
+        **reader_kwargs,
+    ) -> pd.DataFrame:
+        """Read ``path`` as ``format_type`` (csv/parquet/json) or pass ``data`` through."""
+        if data is not None:
+            if hasattr(data, "to_pandas"):  # pragma: no cover - polars
+                return data.to_pandas()
+            if hasattr(data, "toPandas"):  # pragma: no cover - spark
+                return data.toPandas()
+            return data
+        if path:
+            readers = {
+                "csv": pd.read_csv,
+                "parquet": pd.read_parquet,
+                "json": pd.read_json,
+            }
+            if format_type is None:
+                suffix = str(path).rsplit(".", 1)[-1].lower()
+                if suffix not in readers:
+                    msg = (
+                        f"format_type not given and extension {suffix!r} of {path!r} "
+                        f"is not one of {sorted(readers)}"
+                    )
+                    raise ValueError(msg)
+                format_type = suffix
+            if format_type not in readers:
+                msg = f"Invalid value of format_type='{format_type}'"
+                raise ValueError(msg)
+            return readers[format_type](path, **reader_kwargs)
+        msg = "Either data or path parameters must not be None"
+        raise ValueError(msg)
+
+    def check_df(self, dataframe: pd.DataFrame, columns_mapping: Dict[str, str]) -> None:
+        """Validate emptiness + mapping presence; log nulls and absent log columns."""
+        if len(dataframe) == 0:
+            msg = "DataFrame is empty"
+            raise ValueError(msg)
+        unknown = set(columns_mapping) - set(LOG_COLUMNS)
+        if unknown:
+            msg = f"Unknown columns_mapping keys {sorted(unknown)}; valid keys: {list(LOG_COLUMNS)}"
+            raise ValueError(msg)
+        for column in columns_mapping.values():
+            if column not in dataframe.columns:
+                msg = f"Column `{column}` stated in mapping is absent in dataframe"
+                raise ValueError(msg)
+        for column in columns_mapping.values():
+            if dataframe[column].isna().any():
+                logger.info(
+                    "Column `%s` has NULL values. Handle NULL values before "
+                    "the next data preprocessing/model training steps",
+                    column,
+                )
+        if "query_id" in columns_mapping and "item_id" in columns_mapping:
+            absent = set(LOG_COLUMNS) - set(columns_mapping)
+            if absent:
+                logger.info(
+                    "Columns %s are absent and will be generated with default values",
+                    sorted(absent),
+                )
+            rating_col = columns_mapping.get("rating")
+            if rating_col is not None and not pd.api.types.is_numeric_dtype(
+                dataframe[rating_col]
+            ):
+                logger.info(
+                    "Rating column `%s` should be numeric, but it is %s",
+                    rating_col,
+                    dataframe[rating_col].dtype,
+                )
+
+    @classmethod
+    def add_absent_log_cols(
+        cls,
+        dataframe: pd.DataFrame,
+        columns_mapping: Dict[str, str],
+        default_rating: float = DEFAULT_RATING,
+        default_ts: str = DEFAULT_TIMESTAMP,
+    ) -> pd.DataFrame:
+        """Add defaulted ``rating`` / ``timestamp`` columns when unmapped."""
+        out = dataframe
+        absent = set(LOG_COLUMNS) - set(columns_mapping)
+        if "rating" in absent:
+            out = out.assign(rating=float(default_rating))
+        if "timestamp" in absent:
+            out = out.assign(timestamp=pd.Timestamp(default_ts))
+        return out
+
+    @staticmethod
+    def _rename(df: pd.DataFrame, mapping: Dict[str, str]) -> pd.DataFrame:
+        renames = {in_col: out_col for out_col, in_col in mapping.items() if in_col in df.columns}
+        return df.rename(columns=renames)
+
+    def transform(
+        self,
+        columns_mapping: Dict[str, str],
+        data: Optional[pd.DataFrame] = None,
+        path: Optional[str] = None,
+        format_type: Optional[str] = None,
+        date_format: Optional[str] = None,
+        reader_kwargs: Optional[dict] = None,
+    ) -> pd.DataFrame:
+        """Read → check → rename → (logs only) fill defaults + coerce dtypes."""
+        dataframe = self.read_as_pandas_df(
+            data=data, path=path, format_type=format_type, **(reader_kwargs or {})
+        )
+        self.check_df(dataframe, columns_mapping)
+        dataframe = self._rename(dataframe, columns_mapping)
+        is_log = "query_id" in columns_mapping and "item_id" in columns_mapping
+        if is_log:
+            dataframe = self.add_absent_log_cols(dataframe, columns_mapping)
+            if not pd.api.types.is_datetime64_any_dtype(dataframe["timestamp"]):
+                if pd.api.types.is_numeric_dtype(dataframe["timestamp"]):
+                    pass  # numeric epochs are first-class here (TPU-side ints)
+                else:
+                    dataframe = dataframe.assign(
+                        timestamp=pd.to_datetime(dataframe["timestamp"], format=date_format)
+                    )
+            dataframe = dataframe.assign(rating=dataframe["rating"].astype(float))
+        return dataframe.reset_index(drop=True)

@@ -1,3 +1,13 @@
+from .model_handler import (
+    load,
+    load_encoder,
+    load_from_replay,
+    load_splitter,
+    save,
+    save_encoder,
+    save_splitter,
+    save_to_replay,
+)
 from .distributions import item_distribution
 from .time import get_item_recency, smoothe_time
 from .checkpoint import CheckpointManager, load_metadata, restore_pytree, save_pytree
@@ -17,6 +27,14 @@ from .types import (
 )
 
 __all__ = [
+    "load_from_replay",
+    "save_to_replay",
+    "load_splitter",
+    "save_splitter",
+    "load_encoder",
+    "save_encoder",
+    "load",
+    "save",
     "smoothe_time",
     "get_item_recency",
     "item_distribution",

@@ -1,0 +1,145 @@
+"""Generic persistence entry points for `.replay` artifacts.
+
+Capability parity with the reference ``replay/utils/model_handler.py:42-170``
+(``save``/``load``, ``save_encoder``/``load_encoder``,
+``save_splitter``/``load_splitter``) and ``replay/utils/common.py:62-84``
+(``save_to_replay``/``load_from_replay``): a caller can persist any framework
+object and restore it WITHOUT knowing its concrete class — the class name is
+read back from the artifact's ``init_args.json`` and resolved against the
+package namespaces.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import TYPE_CHECKING, Optional
+
+from .serde import json_default
+
+if TYPE_CHECKING:  # pragma: no cover
+    from replay_tpu.data.dataset_label_encoder import DatasetLabelEncoder
+    from replay_tpu.splitters import Splitter
+
+
+def _artifact_dir(path) -> Path:
+    return Path(path).with_suffix(".replay")
+
+
+def _check_overwrite(target: Path, overwrite: bool) -> None:
+    if target.exists() and not overwrite:
+        msg = f"Artifact {target} already exists; pass overwrite=True to replace it"
+        raise FileExistsError(msg)
+
+
+def _resolve_class(class_name: str):
+    """Look the class up across the public model-bearing namespaces."""
+    import importlib
+
+    for module_name in (
+        "replay_tpu.models",
+        "replay_tpu.scenarios",
+        "replay_tpu.experimental",
+        "replay_tpu.splitters",
+        "replay_tpu.preprocessing",
+    ):
+        module = importlib.import_module(module_name)
+        cls = getattr(module, class_name, None)
+        if cls is not None:
+            return cls
+    msg = f"Cannot resolve class {class_name!r} in replay_tpu namespaces"
+    raise ValueError(msg)
+
+
+def save(obj, path, overwrite: bool = False) -> None:
+    """Persist any object exposing the ``.save(path)`` convention."""
+    if not hasattr(obj, "save"):
+        msg = f"{type(obj).__name__} has no .save() — nothing to persist"
+        raise TypeError(msg)
+    _check_overwrite(_artifact_dir(path), overwrite)
+    obj.save(str(path))
+
+
+def load(path, model_type: Optional[type] = None):
+    """Restore an object saved with :func:`save` / its class ``.save``.
+
+    The concrete class is read from the artifact unless ``model_type`` pins it.
+    """
+    source = _artifact_dir(path)
+    args = json.loads((source / "init_args.json").read_text())
+    cls = model_type if model_type is not None else _resolve_class(args["_class_name"])
+    return cls.load(str(path))
+
+
+# reference common.py aliases: any SavableObject roundtrips through these
+save_to_replay = save
+load_from_replay = load
+
+
+def save_splitter(splitter: "Splitter", path, overwrite: bool = False) -> None:
+    """Persist a splitter's init args (splitters are stateless beyond them)."""
+    import datetime
+
+    target = _artifact_dir(path)
+    _check_overwrite(target, overwrite)
+
+    def encode(value):
+        if isinstance(value, datetime.datetime):
+            # round-trip through the splitter's own str-threshold path, which
+            # parses with time_column_format (isoformat's 'T' would not)
+            fmt = getattr(splitter, "time_column_format", None)
+            return value.strftime(fmt) if fmt else value.isoformat()
+        return value
+
+    payload = {
+        "_class_name": type(splitter).__name__,
+        **{name: encode(getattr(splitter, name)) for name in splitter._init_arg_names},
+    }
+    # serialize BEFORE mkdir: a failure must not leave an empty artifact dir
+    # that trips the overwrite guard on retry
+    serialized = json.dumps(payload, default=json_default)
+    target.mkdir(parents=True, exist_ok=True)
+    (target / "init_args.json").write_text(serialized)
+
+
+def load_splitter(path) -> "Splitter":
+    source = _artifact_dir(path)
+    args = json.loads((source / "init_args.json").read_text())
+    cls = _resolve_class(args.pop("_class_name"))
+    return cls(**args)
+
+
+def save_encoder(encoder: "DatasetLabelEncoder", path, overwrite: bool = False) -> None:
+    """Persist a fitted DatasetLabelEncoder (options + per-column rules)."""
+    target = _artifact_dir(path)
+    _check_overwrite(target, overwrite)
+    payload = {
+        "_class_name": "DatasetLabelEncoder",
+        "handle_unknown_rule": encoder._handle_unknown,
+        "default_value_rule": encoder._default_value,
+        "query_column_name": getattr(encoder, "_query_column_name", None),
+        "item_column_name": getattr(encoder, "_item_column_name", None),
+        "rules": [rule._as_dict() for rule in encoder._encoding_rules.values()],
+    }
+    serialized = json.dumps(payload, default=json_default)
+    target.mkdir(parents=True, exist_ok=True)
+    (target / "init_args.json").write_text(serialized)
+
+
+def load_encoder(path) -> "DatasetLabelEncoder":
+    from replay_tpu.data.dataset_label_encoder import DatasetLabelEncoder
+    from replay_tpu.preprocessing.label_encoder import LabelEncodingRule
+
+    source = _artifact_dir(path)
+    payload = json.loads((source / "init_args.json").read_text())
+    encoder = DatasetLabelEncoder(
+        handle_unknown_rule=payload["handle_unknown_rule"],
+        default_value_rule=payload["default_value_rule"],
+    )
+    if payload["query_column_name"] is not None:
+        encoder._query_column_name = payload["query_column_name"]
+    if payload["item_column_name"] is not None:
+        encoder._item_column_name = payload["item_column_name"]
+    rules = [LabelEncodingRule._from_dict(spec) for spec in payload["rules"]]
+    encoder._encoding_rules = {rule.column: rule for rule in rules}
+    return encoder

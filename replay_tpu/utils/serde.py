@@ -16,10 +16,17 @@ def to_plain(value):
 
 
 def json_default(value):
-    """``json.dumps(default=...)`` hook: convert numpy, REJECT anything else with
-    a clear diagnostic (the hook is only invoked for non-serializable objects, so
-    returning the value unchanged would yield a confusing circular-ref error)."""
+    """``json.dumps(default=...)`` hook: convert numpy and datetimes, REJECT
+    anything else with a clear diagnostic (the hook is only invoked for
+    non-serializable objects, so returning the value unchanged would yield a
+    confusing circular-ref error)."""
+    import datetime
+
     if isinstance(value, (np.generic, np.ndarray)):
         return to_plain(value)
+    if isinstance(value, (datetime.datetime, datetime.date)):
+        # isoformat string: every consumer that accepts datetime (e.g.
+        # TimeSplitter.time_threshold) documents str as equally valid
+        return value.isoformat()
     msg = f"Cannot serialize {type(value).__name__} value in a .replay artifact"
     raise TypeError(msg)

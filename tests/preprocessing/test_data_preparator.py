@@ -1,0 +1,111 @@
+"""DataPreparator: raw intake → canonical log layout."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.preprocessing import DataPreparator
+
+
+@pytest.fixture
+def raw_log():
+    return pd.DataFrame(
+        {"user": [2, 2, 2, 1], "movie": [1, 2, 3, 3], "rel": [5, 5, 5, 5]}
+    )
+
+
+class TestTransform:
+    def test_log_rename_and_defaults(self, raw_log):
+        out = DataPreparator().transform(
+            columns_mapping={"query_id": "user", "item_id": "movie", "rating": "rel"},
+            data=raw_log,
+        )
+        assert sorted(out.columns) == ["item_id", "query_id", "rating", "timestamp"]
+        assert out["rating"].dtype == float and out["rating"].iloc[0] == 5.0
+        assert (out["timestamp"] == pd.Timestamp("2099-01-01")).all()
+
+    def test_feature_frame_only_renames(self):
+        features = pd.DataFrame(
+            {"user": ["u1", "u2"], "f0": ["a", "b"], "ts": ["2019-01-01", "2019-01-01"]}
+        )
+        out = DataPreparator().transform(columns_mapping={"query_id": "user"}, data=features)
+        assert sorted(out.columns) == ["f0", "query_id", "ts"]
+        # untouched: not an interactions log, so no datetime coercion
+        assert not pd.api.types.is_datetime64_any_dtype(out["ts"])
+
+    def test_string_timestamps_parsed(self):
+        raw = pd.DataFrame(
+            {"u": [1, 2], "i": [1, 2], "t": ["2020-05-01", "2020-05-02"]}
+        )
+        out = DataPreparator().transform(
+            columns_mapping={"query_id": "u", "item_id": "i", "timestamp": "t"}, data=raw
+        )
+        assert pd.api.types.is_datetime64_any_dtype(out["timestamp"])
+        assert out["rating"].tolist() == [1.0, 1.0]  # defaulted
+
+    def test_numeric_timestamps_kept(self):
+        raw = pd.DataFrame({"u": [1], "i": [1], "t": [1234567]})
+        out = DataPreparator().transform(
+            columns_mapping={"query_id": "u", "item_id": "i", "timestamp": "t"}, data=raw
+        )
+        assert out["timestamp"].tolist() == [1234567]
+
+    def test_csv_roundtrip(self, raw_log, tmp_path):
+        path = tmp_path / "log.csv"
+        raw_log.to_csv(path, index=False)
+        out = DataPreparator().transform(
+            columns_mapping={"query_id": "user", "item_id": "movie", "rating": "rel"},
+            path=str(path),
+            format_type="csv",
+        )
+        assert len(out) == 4 and "query_id" in out.columns
+
+    def test_parquet_roundtrip(self, raw_log, tmp_path):
+        path = tmp_path / "log.parquet"
+        raw_log.to_parquet(path)
+        out = DataPreparator().transform(
+            columns_mapping={"query_id": "user", "item_id": "movie"},
+            path=str(path),
+            format_type="parquet",
+        )
+        assert out["rating"].tolist() == [1.0] * 4
+
+
+class TestValidation:
+    def test_empty_frame_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            DataPreparator().transform(
+                columns_mapping={"query_id": "u"}, data=pd.DataFrame({"u": []})
+            )
+
+    def test_missing_mapped_column(self, raw_log):
+        with pytest.raises(ValueError, match="absent in dataframe"):
+            DataPreparator().transform(
+                columns_mapping={"query_id": "nope"}, data=raw_log
+            )
+
+    def test_unknown_mapping_key(self, raw_log):
+        with pytest.raises(ValueError, match="Unknown columns_mapping"):
+            DataPreparator().transform(
+                columns_mapping={"user_idx": "user"}, data=raw_log
+            )
+
+    def test_no_input_rejected(self):
+        with pytest.raises(ValueError, match="data or path"):
+            DataPreparator().transform(columns_mapping={"query_id": "u"})
+
+    def test_bad_format_type(self, tmp_path):
+        with pytest.raises(ValueError, match="format_type"):
+            DataPreparator().read_as_pandas_df(path=str(tmp_path / "x"), format_type="xml")
+
+    def test_format_inferred_from_extension(self, raw_log, tmp_path):
+        path = tmp_path / "log.csv"
+        raw_log.to_csv(path, index=False)
+        out = DataPreparator().transform(
+            columns_mapping={"query_id": "user", "item_id": "movie"}, path=str(path)
+        )
+        assert len(out) == 4
+
+    def test_uninferrable_extension_names_the_problem(self, tmp_path):
+        with pytest.raises(ValueError, match="format_type not given"):
+            DataPreparator().read_as_pandas_df(path=str(tmp_path / "x.xml"))

@@ -1,0 +1,143 @@
+"""Generic .replay persistence dispatchers (utils/model_handler.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data.dataset import Dataset
+from replay_tpu.data.dataset_label_encoder import DatasetLabelEncoder
+from replay_tpu.data.schema import FeatureHint, FeatureInfo, FeatureSchema, FeatureType
+from replay_tpu.models import PopRec
+from replay_tpu.splitters import LastNSplitter, RatioSplitter
+from replay_tpu.utils import (
+    load,
+    load_encoder,
+    load_from_replay,
+    load_splitter,
+    save,
+    save_encoder,
+    save_splitter,
+    save_to_replay,
+)
+
+
+@pytest.fixture
+def log():
+    return pd.DataFrame(
+        {
+            "query_id": ["u1", "u1", "u2", "u3", "u3", "u3"],
+            "item_id": ["a", "b", "a", "a", "b", "c"],
+            "rating": [1.0, 1.0, 1.0, 1.0, 1.0, 1.0],
+            "timestamp": range(6),
+        }
+    )
+
+
+@pytest.fixture
+def dataset(log):
+    schema = FeatureSchema(
+        [
+            FeatureInfo("query_id", FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+            FeatureInfo("item_id", FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+            FeatureInfo("rating", FeatureType.NUMERICAL, FeatureHint.RATING),
+            FeatureInfo("timestamp", FeatureType.NUMERICAL, FeatureHint.TIMESTAMP),
+        ]
+    )
+    return Dataset(feature_schema=schema, interactions=log)
+
+
+class TestGenericSaveLoad:
+    def test_model_roundtrip_without_knowing_class(self, dataset, tmp_path):
+        encoded = DatasetLabelEncoder().fit_transform(dataset)
+        model = PopRec().fit(encoded)
+        save(model, tmp_path / "pop")
+        restored = load(tmp_path / "pop")  # no model_type given
+        assert type(restored).__name__ == "PopRec"
+        orig = model.predict(encoded, k=2)
+        back = restored.predict(encoded, k=2)
+        pd.testing.assert_frame_equal(
+            orig.reset_index(drop=True), back.reset_index(drop=True)
+        )
+
+    def test_overwrite_guard(self, dataset, tmp_path):
+        encoded = DatasetLabelEncoder().fit_transform(dataset)
+        model = PopRec().fit(encoded)
+        save(model, tmp_path / "pop")
+        with pytest.raises(FileExistsError, match="overwrite=True"):
+            save(model, tmp_path / "pop")
+        save(model, tmp_path / "pop", overwrite=True)  # no raise
+
+    def test_save_requires_save_method(self, tmp_path):
+        with pytest.raises(TypeError, match="no .save"):
+            save(object(), tmp_path / "x")
+
+    def test_common_aliases(self):
+        assert save_to_replay is save and load_from_replay is load
+
+    def test_unknown_class_rejected(self, tmp_path):
+        import json
+
+        target = (tmp_path / "weird").with_suffix(".replay")
+        target.mkdir()
+        (target / "init_args.json").write_text(json.dumps({"_class_name": "NotAModel"}))
+        with pytest.raises(ValueError, match="NotAModel"):
+            load(tmp_path / "weird")
+
+
+class TestSplitterRoundtrip:
+    def test_ratio(self, tmp_path, log):
+        splitter = RatioSplitter(test_size=0.5, divide_column="query_id")
+        save_splitter(splitter, tmp_path / "sp")
+        restored = load_splitter(tmp_path / "sp")
+        assert isinstance(restored, RatioSplitter)
+        train_a, test_a = splitter.split(log)
+        train_b, test_b = restored.split(log)
+        pd.testing.assert_frame_equal(train_a, train_b)
+        pd.testing.assert_frame_equal(test_a, test_b)
+
+    def test_last_n(self, tmp_path):
+        splitter = LastNSplitter(N=2, divide_column="query_id")
+        save_splitter(splitter, tmp_path / "sp2")
+        restored = load_splitter(tmp_path / "sp2")
+        assert isinstance(restored, LastNSplitter) and restored.N == 2
+
+    def test_overwrite_guard(self, tmp_path):
+        splitter = LastNSplitter(N=1)
+        save_splitter(splitter, tmp_path / "sp3")
+        with pytest.raises(FileExistsError):
+            save_splitter(splitter, tmp_path / "sp3")
+
+    def test_datetime_threshold(self, tmp_path, log):
+        from datetime import datetime
+
+        from replay_tpu.splitters import TimeSplitter
+
+        splitter = TimeSplitter(time_threshold=datetime(1970, 1, 1, 0, 0, 3))
+        save_splitter(splitter, tmp_path / "ts")
+        restored = load_splitter(tmp_path / "ts")
+        ts_log = log.assign(timestamp=pd.to_datetime(log["timestamp"], unit="s"))
+        train_a, test_a = splitter.split(ts_log)
+        train_b, test_b = restored.split(ts_log)
+        pd.testing.assert_frame_equal(train_a, train_b)
+        pd.testing.assert_frame_equal(test_a, test_b)
+
+    def test_failed_save_leaves_no_artifact(self, tmp_path):
+        splitter = LastNSplitter(N=1)
+        splitter.N = object()  # unserializable init arg
+        with pytest.raises(TypeError):
+            save_splitter(splitter, tmp_path / "broken")
+        assert not (tmp_path / "broken.replay").exists()
+        splitter.N = 1
+        save_splitter(splitter, tmp_path / "broken")  # retry must succeed
+
+
+class TestEncoderRoundtrip:
+    def test_fitted_encoder(self, dataset, tmp_path, log):
+        encoder = DatasetLabelEncoder().fit(dataset)
+        save_encoder(encoder, tmp_path / "enc")
+        restored = load_encoder(tmp_path / "enc")
+        out_a = encoder.transform(dataset).interactions
+        out_b = restored.transform(dataset).interactions
+        pd.testing.assert_frame_equal(out_a, out_b)
+        assert restored.query_id_encoder.mapping == encoder.query_id_encoder.mapping
+        assert restored.item_id_encoder.mapping == encoder.item_id_encoder.mapping
