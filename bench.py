@@ -126,10 +126,11 @@ def main() -> None:
     from replay_tpu.data import FeatureHint, FeatureType
     from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
     from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
-    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.loss import CE, CEFused
     from replay_tpu.nn.sequential.sasrec import SasRec
 
     on_cpu = jax.default_backend() == "cpu"
+    use_flash = os.environ.get("REPLAY_TPU_BENCH_FLASH") == "1" and not on_cpu
     schema = TensorSchema(
         TensorFeatureInfo(
             "item_id",
@@ -148,13 +149,16 @@ def main() -> None:
         max_sequence_length=SEQ_LEN,
         dropout_rate=0.0,
         # REPLAY_TPU_BENCH_FLASH=1 A/Bs the pallas fused attention (TPU only)
-        use_flash=os.environ.get("REPLAY_TPU_BENCH_FLASH") == "1" and not on_cpu,
+        use_flash=use_flash,
         # f32 on CPU: a bf16 number there measures emulation, not the framework
         dtype=jnp.float32 if on_cpu else jnp.bfloat16,
     )
+    # REPLAY_TPU_BENCH_FUSED_CE=1 A/Bs the pallas fused-logsumexp head
+    # (ops/fused_ce.py): same math, no [B, L, I] logits in HBM
+    use_fused_ce = os.environ.get("REPLAY_TPU_BENCH_FUSED_CE") == "1" and not on_cpu
     trainer = Trainer(
         model=model,
-        loss=CE(),
+        loss=CEFused() if use_fused_ce else CE(),
         optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
         mesh=make_mesh(),
     )
@@ -194,6 +198,10 @@ def main() -> None:
         analysis = trainer._train_step.lower(state, trainer._put_batch(batch)).compile().cost_analysis()
         if analysis and "flops" in analysis:
             step_flops = float(analysis["flops"])
+            if use_fused_ce:
+                # the pallas custom call is opaque to the cost model: add the
+                # analytic head FLOPs it replaced (fwd 2NEI + bwd 2*2NEI)
+                step_flops += 6.0 * BATCH * SEQ_LEN * EMBEDDING_DIM * NUM_ITEMS
     except Exception:  # cost analysis is best-effort across backends
         pass
 
@@ -233,6 +241,10 @@ def main() -> None:
         "step_ms": round(elapsed / steps * 1000, 2),
         "dispatch_step_ms": round(dispatch_step_ms, 2),
         "scan_k": scan_k,
+        # which head variants produced this number — a fused A/B run must be
+        # distinguishable from the baseline in the sidecar's best-run history
+        "fused_ce": use_fused_ce,
+        "flash_attention": use_flash,
     }
     device_kind = jax.devices()[0].device_kind
     record["device_kind"] = device_kind

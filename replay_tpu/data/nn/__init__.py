@@ -1,4 +1,5 @@
 from .schema_builder import TensorSchemaBuilder
+from .utils import ensure_pandas, groupby_sequences
 from .iterator import SequenceBatcher, validation_batches
 from .module import DataModule
 from .parquet import ParquetBatcher, write_sequence_parquet
@@ -9,6 +10,8 @@ from .sequence_tokenizer import SequenceTokenizer
 from .sequential_dataset import SequentialDataset
 
 __all__ = [
+    "ensure_pandas",
+    "groupby_sequences",
     "TensorSchemaBuilder",
     "DataModule",
     "ParquetBatcher",

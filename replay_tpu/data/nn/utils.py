@@ -1,0 +1,64 @@
+"""Host-side sequence helpers.
+
+Capability parity with the reference ``replay/data/nn/utils.py:12-87``
+(``groupby_sequences``, ``ensure_pandas``), pandas-native (polars/spark frames
+are accepted as input adapters and converted at the boundary, per the README
+design stance).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pandas as pd
+
+
+def ensure_pandas(df, allow_collect_to_master: bool = False) -> pd.DataFrame:
+    """Convert an optional-engine frame to pandas (no-op for pandas input)."""
+    if isinstance(df, pd.DataFrame):
+        return df
+    if hasattr(df, "to_pandas"):  # pragma: no cover - polars
+        return df.to_pandas()
+    if hasattr(df, "toPandas"):  # pragma: no cover - spark
+        if not allow_collect_to_master:
+            msg = (
+                "Collecting a Spark frame to the master node requires "
+                "allow_collect_to_master=True"
+            )
+            raise ValueError(msg)
+        return df.toPandas()
+    msg = f"Unsupported dataframe type: {type(df)}"
+    raise TypeError(msg)
+
+
+def groupby_sequences(
+    events, groupby_col: str, sort_col: Optional[str] = None
+) -> pd.DataFrame:
+    """Collapse an interaction log into one row per ``groupby_col`` value with
+    every other column aggregated into an in-order list.
+
+    >>> log = pd.DataFrame({"user": [1, 1, 2], "item": [5, 6, 7], "ts": [2, 1, 3]})
+    >>> groupby_sequences(log, "user", sort_col="ts")["item"].tolist()
+    [[6, 5], [7]]
+    """
+    events = ensure_pandas(events)
+    value_cols = [c for c in events.columns if c != groupby_col]
+    if sort_col is not None:
+        # sort by sort_col first, with the remaining sortable (non-list)
+        # columns as tie-breakers — the reference's ordering contract
+        from collections.abc import Iterable
+
+        # the reference excludes every Iterable-valued column (strings and
+        # arrays included) from the tie-breaker keys (data/nn/utils.py:25-28)
+        sortable = [
+            c
+            for c in value_cols
+            if len(events) == 0 or not isinstance(events.iloc[0][c], Iterable)
+        ]
+        keys = [sort_col] + [c for c in sortable if c != sort_col]
+        events = events.sort_values(keys, kind="stable")
+    return (
+        events.groupby(groupby_col, sort=True)
+        .agg({c: list for c in value_cols})
+        .reset_index()
+    )

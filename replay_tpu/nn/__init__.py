@@ -9,6 +9,7 @@ from .embedding import (
     SequenceEmbedding,
 )
 from .ffn import PointWiseFeedForward, SwiGLU, SwiGLUEncoder
+from .utils import create_activation
 from .head import EmbeddingTyingHead
 from .mask import (
     DefaultAttentionMask,
@@ -26,6 +27,7 @@ from .train import (
 )
 
 __all__ = [
+    "create_activation",
     "CategoricalEmbedding",
     "CategoricalListEmbedding",
     "ConcatAggregator",

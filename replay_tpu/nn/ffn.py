@@ -15,17 +15,28 @@ import jax.numpy as jnp
 
 
 class PointWiseFeedForward(nn.Module):
-    """ReLU MLP applied per position with residual connection."""
+    """MLP applied per position with residual connection.
+
+    ``activation`` matches the reference signature and default (ffn.py:22,
+    gelu — also what the reference BERT4Rec block uses,
+    models/nn/sequential/bert4rec/model.py:519).
+    """
 
     hidden_dim: int
     dropout_rate: float = 0.0
+    activation: str = "gelu"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        from replay_tpu.nn.utils import create_activation
+
+        # reference order (ffn.py:48-52): dense -> activation -> dropout.
+        # relu commutes with dropout's scaling but gelu does not, so the
+        # order is part of the parity contract.
         h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="inner")(x)
+        h = create_activation(self.activation)(h)
         h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
-        h = nn.relu(h)
         h = nn.Dense(x.shape[-1], dtype=self.dtype, name="outer")(h)
         h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
         return x + h

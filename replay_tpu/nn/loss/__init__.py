@@ -1,6 +1,6 @@
 from .base import LossBase, broadcast_negatives, mask_negative_logits, masked_mean
 from .bce import BCE, BCESampled
-from .ce import CE, CESampled, CESampledWeighted, CEWeighted
+from .ce import CE, CEFused, CESampled, CESampledWeighted, CEWeighted
 from .login_ce import LogInCE, LogInCESampled
 from .logout_ce import LogOutCE, LogOutCEWeighted
 from .sce import SCE, ScalableCrossEntropyLoss, SCEParams

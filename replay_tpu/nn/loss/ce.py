@@ -41,6 +41,64 @@ class CE(LossBase):
         return jnp.ones_like(labels, dtype=dtype)
 
 
+class CEFused(CE):
+    """CE with the pallas fused-logsumexp head (TPU).
+
+    Bitwise-equivalent math to :class:`CE` up to f32-vs-bf16 softmax precision
+    (the fused path accumulates in f32 inside VMEM), but the ``[B, L, I]``
+    logits tensor never reaches HBM — the dominant train-step traffic at
+    full-catalog scales. Falls back to interpreter mode off-TPU; prefer it via
+    ``Trainer(loss=CEFused())`` when ``jax.default_backend() == "tpu"``.
+    """
+
+    needs_item_embeddings = True
+
+    def __init__(
+        self, tile: int = 256, item_tile: Optional[int] = None, interpret: bool = None
+    ) -> None:
+        super().__init__()
+        self.tile = tile
+        self.item_tile = item_tile
+        self.interpret = interpret
+        self.item_embeddings_callback = None
+
+    def __call__(
+        self,
+        model_embeddings,
+        feature_tensors,
+        positive_labels,
+        negative_labels,
+        padding_mask,
+        target_padding_mask,
+    ) -> jnp.ndarray:
+        from replay_tpu.ops.fused_ce import fused_lse
+
+        if positive_labels.shape[-1] != 1:
+            msg = "Multi-positive labels are not supported by the CE loss"
+            raise NotImplementedError(msg)
+        if self.item_embeddings_callback is None:
+            msg = "CEFused requires the trainer to bind item_embeddings_callback."
+            raise AttributeError(msg)
+        table = self.item_embeddings_callback()  # [I, E]
+        num_items = table.shape[0]
+        interpret = (
+            jax.default_backend() != "tpu" if self.interpret is None else self.interpret
+        )
+        hidden = model_embeddings.reshape(-1, model_embeddings.shape[-1])
+        labels = jnp.clip(positive_labels[..., 0], 0, num_items - 1)
+        lse = fused_lse(hidden, table, self.tile, self.item_tile, interpret).reshape(
+            labels.shape
+        )
+        label_logit = jnp.sum(
+            model_embeddings.astype(jnp.float32) * table[labels].astype(jnp.float32),
+            axis=-1,
+        )
+        nll = lse - label_logit
+        weights = self._label_weights(labels, nll.dtype)
+        mask = target_padding_mask[..., 0].astype(nll.dtype) * weights
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 class CEWeighted(CE):
     """CE with per-class weights (reference: torch CrossEntropyLoss(weight=...))."""
 

@@ -44,6 +44,7 @@ class Bert4RecBody(nn.Module):
     max_sequence_length: int = 50
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
+    activation: str = "gelu"
     num_passes_over_block: int = 1
     remat: bool = False
     use_flash: bool = False
@@ -72,6 +73,7 @@ class Bert4RecBody(nn.Module):
             num_heads=self.num_heads,
             hidden_dim=self.hidden_dim or self.embedding_dim * 4,
             dropout_rate=self.dropout_rate,
+            activation=self.activation,
             remat=self.remat,
             use_flash=self.use_flash,
             dtype=self.dtype,
@@ -123,6 +125,7 @@ class Bert4Rec(nn.Module):
     max_sequence_length: int = 50
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
+    activation: str = "gelu"
     num_passes_over_block: int = 1
     remat: bool = False
     use_flash: bool = False
@@ -138,6 +141,7 @@ class Bert4Rec(nn.Module):
             max_sequence_length=self.max_sequence_length,
             hidden_dim=self.hidden_dim,
             dropout_rate=self.dropout_rate,
+            activation=self.activation,
             num_passes_over_block=self.num_passes_over_block,
             remat=self.remat,
             use_flash=self.use_flash,

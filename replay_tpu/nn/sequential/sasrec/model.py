@@ -40,6 +40,7 @@ class SasRecBody(nn.Module):
     max_sequence_length: int = 50
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
+    activation: str = "relu"  # reference SASRec construction pins relu (model.py:246)
     encoder_type: str = "sasrec"
     remat: bool = False
     use_flash: bool = False
@@ -67,7 +68,7 @@ class SasRecBody(nn.Module):
             msg = f"Unknown encoder_type: {self.encoder_type}"
             raise ValueError(msg)
         encoder_kwargs = (
-            {"remat": self.remat, "use_flash": self.use_flash}
+            {"remat": self.remat, "use_flash": self.use_flash, "activation": self.activation}
             if self.encoder_type == "sasrec"
             else {}
         )
@@ -107,6 +108,7 @@ class SasRec(nn.Module):
     max_sequence_length: int = 50
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
+    activation: str = "relu"  # reference SASRec construction pins relu (model.py:246)
     encoder_type: str = "sasrec"
     remat: bool = False
     use_flash: bool = False
@@ -122,6 +124,7 @@ class SasRec(nn.Module):
             max_sequence_length=self.max_sequence_length,
             hidden_dim=self.hidden_dim,
             dropout_rate=self.dropout_rate,
+            activation=self.activation,
             encoder_type=self.encoder_type,
             remat=self.remat,
             use_flash=self.use_flash,

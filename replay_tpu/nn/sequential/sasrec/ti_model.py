@@ -45,6 +45,7 @@ class TiSasRec(nn.Module):
     time_span: int = 256
     hidden_dim: Optional[int] = None
     dropout_rate: float = 0.0
+    activation: str = "relu"  # matches SasRec's pinned construction default
     excluded_features: tuple = ()
     timestamps_name: str = "timestamp"
     dtype: Any = jnp.float32
@@ -88,6 +89,7 @@ class TiSasRec(nn.Module):
             PointWiseFeedForward(
                 hidden_dim=self.hidden_dim or self.embedding_dim * 4,
                 dropout_rate=self.dropout_rate,
+                activation=self.activation,
                 dtype=self.dtype,
                 name=f"ffn_{i}",
             )

@@ -23,6 +23,7 @@ class _SasRecBlock(nn.Module):
     num_heads: int
     hidden_dim: int
     dropout_rate: float = 0.0
+    activation: str = "gelu"
     use_flash: bool = False
     dtype: Any = jnp.float32
 
@@ -41,6 +42,7 @@ class _SasRecBlock(nn.Module):
         x = PointWiseFeedForward(
             hidden_dim=self.hidden_dim,
             dropout_rate=self.dropout_rate,
+            activation=self.activation,
             dtype=self.dtype,
             name="ffn",
         )(h, deterministic=deterministic)
@@ -58,6 +60,7 @@ class SasRecTransformerLayer(nn.Module):
     num_heads: int
     hidden_dim: int
     dropout_rate: float = 0.0
+    activation: str = "gelu"
     remat: bool = False
     use_flash: bool = False
     dtype: Any = jnp.float32
@@ -79,6 +82,7 @@ class SasRecTransformerLayer(nn.Module):
                 num_heads=self.num_heads,
                 hidden_dim=self.hidden_dim,
                 dropout_rate=self.dropout_rate,
+                activation=self.activation,
                 use_flash=self.use_flash,
                 dtype=self.dtype,
                 name=f"block_{i}",

@@ -1,0 +1,28 @@
+"""Small nn helpers.
+
+Capability parity with the reference ``replay/nn/utils.py:18-29``
+(``create_activation``): resolve an activation by name. JAX activations are
+plain functions rather than modules, so this returns a callable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_ACTIVATIONS = {
+    "relu": nn.relu,
+    "gelu": nn.gelu,
+    "sigmoid": nn.sigmoid,
+    "silu": nn.silu,
+}
+
+
+def create_activation(name: str) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Activation function by name (``relu`` / ``gelu`` / ``sigmoid`` / ``silu``)."""
+    if name not in _ACTIVATIONS:
+        msg = f"Expected activation one of {sorted(_ACTIVATIONS)}, got {name!r}"
+        raise ValueError(msg)
+    return _ACTIVATIONS[name]

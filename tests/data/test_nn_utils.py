@@ -1,0 +1,74 @@
+"""groupby_sequences / ensure_pandas / create_activation parity helpers."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data.nn import ensure_pandas, groupby_sequences
+
+
+class TestGroupbySequences:
+    def test_orders_within_group(self):
+        log = pd.DataFrame(
+            {"user": [1, 1, 2, 1], "item": [5, 6, 7, 8], "ts": [2, 1, 3, 0]}
+        )
+        out = groupby_sequences(log, "user", sort_col="ts")
+        assert out["user"].tolist() == [1, 2]
+        assert out["item"].tolist() == [[8, 6, 5], [7]]
+        assert out["ts"].tolist() == [[0, 1, 2], [3]]
+
+    def test_without_sort_keeps_frame_order(self):
+        log = pd.DataFrame({"user": [2, 1, 2], "item": ["a", "b", "c"]})
+        out = groupby_sequences(log, "user")
+        assert out[out["user"] == 2]["item"].iloc[0] == ["a", "c"]
+
+    def test_ndarray_columns_survive(self):
+        # array-valued cells must be excluded from tie-breaker sort keys
+        # (unhashable/unsortable), like every other Iterable
+        log = pd.DataFrame(
+            {"user": [1, 1], "emb": [np.array([1, 2]), np.array([3, 4])], "ts": [1, 0]}
+        )
+        out = groupby_sequences(log, "user", sort_col="ts")
+        assert [a.tolist() for a in out["emb"].iloc[0]] == [[3, 4], [1, 2]]
+
+    def test_string_columns_are_not_tiebreakers(self):
+        # equal sort_col values keep frame order; string columns must not
+        # reorder them (the reference excludes every Iterable from the keys)
+        log = pd.DataFrame({"user": [1, 1], "name": ["b", "a"], "ts": [0, 0]})
+        out = groupby_sequences(log, "user", sort_col="ts")
+        assert out["name"].iloc[0] == ["b", "a"]
+
+    def test_list_columns_survive(self):
+        log = pd.DataFrame(
+            {"user": [1, 1], "tags": [["x"], ["y", "z"]], "ts": [1, 0]}
+        )
+        out = groupby_sequences(log, "user", sort_col="ts")
+        assert out["tags"].iloc[0] == [["y", "z"], ["x"]]
+
+
+class TestEnsurePandas:
+    def test_pandas_passthrough(self):
+        df = pd.DataFrame({"a": [1]})
+        assert ensure_pandas(df) is df
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(TypeError, match="Unsupported dataframe"):
+            ensure_pandas([1, 2, 3])
+
+
+class TestCreateActivation:
+    def test_known_names(self):
+        import jax.numpy as jnp
+
+        from replay_tpu.nn import create_activation
+
+        x = jnp.asarray([-1.0, 0.0, 1.0])
+        assert np.asarray(create_activation("relu")(x)).tolist() == [0.0, 0.0, 1.0]
+        assert float(create_activation("sigmoid")(x)[1]) == pytest.approx(0.5)
+        assert callable(create_activation("gelu")) and callable(create_activation("silu"))
+
+    def test_unknown_rejected(self):
+        from replay_tpu.nn import create_activation
+
+        with pytest.raises(ValueError, match="activation"):
+            create_activation("tanh")
