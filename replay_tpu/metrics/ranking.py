@@ -3,13 +3,30 @@
 Capability parity with the reference set (replay/metrics/hitrate.py … rocauc.py):
 HitRate, Precision, Recall, MAP, MRR, NDCG, RocAuc — same metric definitions,
 computed very differently: instead of a per-user python loop, every metric is
-derived from ONE [users, max_k] hit matrix built with vectorized pandas joins
+derived from TWO [users, max_k] hit matrices built with vectorized pandas joins
 (explode + merge), so the dataframe battery scales to ML-20M-sized rec lists.
 (The device-side MetricsBuilder in replay_tpu.metrics.builder shares the same
 hit-matrix formulation.)
+
+Duplicate semantics match the reference exactly (replay/metrics/base_metric.py
+warns but still scores; per-metric loops at e.g. replay/metrics/ndcg.py:82-93,
+precision.py:62-69): recommendation lists are truncated to k WITHOUT dedup, so
+
+- NDCG / MAP / RocAuc score every occurrence of a relevant item position-wise
+  (``hits_occ``),
+- Precision / Recall / HitRate intersect ``set(pred[:k])`` with the ground-truth
+  set, i.e. count DISTINCT relevant items inside the window (``hits_first``),
+- NDCG's IDCG and MAP's normalizer use the RAW ground-truth list length
+  ``min(k, len(ground_truth))`` while Recall divides by the deduplicated
+  ground-truth count — faithfully mirroring the reference formulas.
+
+On duplicate-free inputs (the contract of every top-k producer in this
+framework) the two matrices coincide.
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import numpy as np
 import pandas as pd
@@ -17,27 +34,24 @@ import pandas as pd
 from .base import Metric, MetricsReturnType
 
 
-class RankingMetric(Metric):
-    """Shared vectorized evaluation: subclasses map the hit matrix to values.
+class _HitData(NamedTuple):
+    """Per-user hit matrices and list-length vectors (all truncated to max_k)."""
 
-    Intentional divergence from the reference on DUPLICATED recommendation
-    lists: recommendations are treated as an ordered SET — a duplicate item
-    keeps its first rank only — so precision/MAP/recall stay bounded by 1.
-    The reference counts each occurrence of a duplicated relevant item
-    (replay/metrics/base_metric.py warns but still scores per occurrence),
-    so metric values differ on such inputs; on duplicate-free lists (the
-    contract of every top-k producer in this framework) the two definitions
-    coincide. See PARITY.md §metrics.
-    """
+    hits_occ: np.ndarray  # [U, max_k] bool: pred[i] in gt_set (every occurrence)
+    hits_first: np.ndarray  # [U, max_k] bool: hit AND first occurrence of the item
+    gt_set: np.ndarray  # [U] distinct ground-truth items
+    gt_raw: np.ndarray  # [U] raw ground-truth list length (reference NDCG/MAP denominators)
+    pred_len: np.ndarray  # [U] raw recommendation length, capped at max_k
+
+
+class RankingMetric(Metric):
+    """Shared vectorized evaluation: subclasses map the hit matrices to values."""
 
     def _evaluate(self, ground_truth: dict, recs: dict, *extra) -> MetricsReturnType:
         users = list(ground_truth.keys())
         max_k = max(self.topk)
-        hits, gt_count, pred_len = _hit_matrix(users, ground_truth, recs, max_k)
-        per_k = {
-            k: self._from_hits(k, hits[:, :k], gt_count, np.minimum(pred_len, k))
-            for k in self.topk
-        }
+        data = _hit_matrix(users, ground_truth, recs, max_k)
+        per_k = {k: self._from_hits(k, _truncate(data, k)) for k in self.topk}
         if self._mode.__name__ == "PerUser":
             return {
                 f"{self.__name__}@{k}": dict(zip(users, per_k[k])) for k in self.topk
@@ -46,40 +60,60 @@ class RankingMetric(Metric):
             f"{self.__name__}@{k}": float(self._mode.cpu(per_k[k])) for k in self.topk
         }
 
-    def _from_hits(
-        self, k: int, hits: np.ndarray, gt_count: np.ndarray, pred_len: np.ndarray
-    ) -> np.ndarray:
-        """[U] metric values from the boolean hit matrix restricted to top-k."""
+    def _from_hits(self, k: int, data: _HitData) -> np.ndarray:
+        """[U] metric values from the hit matrices restricted to top-k."""
         raise NotImplementedError
 
 
-def _hit_matrix(users, ground_truth: dict, recs: dict, max_k: int):
-    """(hits [U, max_k] bool, gt_count [U], pred_len [U]) via exploded joins."""
+def _truncate(data: _HitData, k: int) -> _HitData:
+    return _HitData(
+        hits_occ=data.hits_occ[:, :k],
+        hits_first=data.hits_first[:, :k],
+        gt_set=data.gt_set,
+        gt_raw=data.gt_raw,
+        pred_len=np.minimum(data.pred_len, k),
+    )
+
+
+def _hit_matrix(users, ground_truth: dict, recs: dict, max_k: int) -> _HitData:
+    """Build both hit matrices via exploded joins (no per-user python loop)."""
     n = len(users)
-    hits = np.zeros((n, max_k), dtype=bool)
-    gt_count = np.zeros(n, dtype=np.int64)
+    hits_occ = np.zeros((n, max_k), dtype=bool)
+    hits_first = np.zeros((n, max_k), dtype=bool)
+    gt_set = np.zeros(n, dtype=np.int64)
+    gt_raw = np.zeros(n, dtype=np.int64)
     pred_len = np.zeros(n, dtype=np.int64)
     if not n:
-        return hits, gt_count, pred_len
-    # ordered-set semantics: duplicate rec items keep their FIRST rank only and
-    # ground truth is a set — recall stays <= 1 even on duplicated inputs (the
-    # base class warns separately on duplicates)
-    rec_lists = pd.Series([list(dict.fromkeys(recs.get(u) or []))[:max_k] for u in users])
-    gt_lists = pd.Series([list(dict.fromkeys(ground_truth.get(u) or [])) for u in users])
-    gt_count[:] = gt_lists.map(len).to_numpy()
+        return _HitData(hits_occ, hits_first, gt_set, gt_raw, pred_len)
+    rec_lists = pd.Series([list(recs.get(u) or [])[:max_k] for u in users])
+    gt_lists = pd.Series([list(ground_truth.get(u) or []) for u in users])
+    gt_raw[:] = gt_lists.map(len).to_numpy()
+    gt_set[:] = gt_lists.map(lambda xs: len(set(xs))).to_numpy()
     pred_len[:] = rec_lists.map(len).to_numpy()
 
-    long = rec_lists.explode().dropna().rename("item").reset_index()
+    # explode only non-empty lists (an empty list explodes to a spurious NaN
+    # row); None/NaN ITEMS inside a list are kept so they occupy their rank as
+    # ordinary misses, exactly like the reference's positional loop
+    long = rec_lists[rec_lists.map(len) > 0].explode().rename("item").reset_index()
     if long.empty:
-        return hits, gt_count, pred_len
+        return _HitData(hits_occ, hits_first, gt_set, gt_raw, pred_len)
     long["rank"] = long.groupby("index").cumcount()
+    first_occ = ~long.duplicated(subset=["index", "item"], keep="first")
     gt_long = (
-        gt_lists.explode().dropna().rename("item").reset_index().drop_duplicates()
+        gt_lists[gt_lists.map(len) > 0]
+        .explode()
+        .rename("item")
+        .reset_index()
+        .drop_duplicates()
     )
     merged = long.merge(gt_long.assign(__hit=True), on=["index", "item"], how="left")
-    hit_rows = merged[merged["__hit"].notna()]
-    hits[hit_rows["index"].to_numpy(), hit_rows["rank"].to_numpy()] = True
-    return hits, gt_count, pred_len
+    hit_rows = merged["__hit"].notna().to_numpy()
+    rows = long["index"].to_numpy()[hit_rows]
+    ranks = long["rank"].to_numpy()[hit_rows]
+    hits_occ[rows, ranks] = True
+    first_hit = hit_rows & first_occ.to_numpy()
+    hits_first[long["index"].to_numpy()[first_hit], long["rank"].to_numpy()[first_hit]] = True
+    return _HitData(hits_occ, hits_first, gt_set, gt_raw, pred_len)
 
 
 def _safe_div(numerator: np.ndarray, denominator: np.ndarray) -> np.ndarray:
@@ -89,51 +123,64 @@ def _safe_div(numerator: np.ndarray, denominator: np.ndarray) -> np.ndarray:
 class HitRate(RankingMetric):
     """1 if any of the top-k recommendations is relevant."""
 
-    def _from_hits(self, k, hits, gt_count, pred_len):
-        return hits.any(axis=1).astype(np.float64)
+    def _from_hits(self, k, data):
+        return data.hits_occ.any(axis=1).astype(np.float64)
 
 
 class Precision(RankingMetric):
-    """Fraction of the top-k recommendations that are relevant."""
+    """Fraction of the top-k recommendations that are relevant.
 
-    def _from_hits(self, k, hits, gt_count, pred_len):
-        present = (gt_count > 0) & (pred_len > 0)
-        return np.where(present, hits.sum(axis=1) / k, 0.0)
+    Distinct relevant items in the window over k — ``len(set(pred[:k]) & gt) / k``
+    as in the reference (replay/metrics/precision.py:62-69).
+    """
+
+    def _from_hits(self, k, data):
+        present = (data.gt_set > 0) & (data.pred_len > 0)
+        return np.where(present, data.hits_first.sum(axis=1) / k, 0.0)
 
 
 class Recall(RankingMetric):
     """Fraction of the relevant items captured in the top-k recommendations."""
 
-    def _from_hits(self, k, hits, gt_count, pred_len):
-        return _safe_div(hits.sum(axis=1), gt_count)
+    def _from_hits(self, k, data):
+        return _safe_div(data.hits_first.sum(axis=1), data.gt_set)
 
 
 class MAP(RankingMetric):
-    """Mean average precision at k."""
+    """Mean average precision at k.
 
-    def _from_hits(self, k, hits, gt_count, pred_len):
-        h = hits.astype(np.float64)
+    Occurrence semantics: the true-positive counter advances at EVERY position
+    whose item is relevant, and the normalizer is ``min(k, len(ground_truth))``
+    over the raw list (replay/metrics/map.py:64-78).
+    """
+
+    def _from_hits(self, k, data):
+        h = data.hits_occ.astype(np.float64)
         precision_at_rank = np.cumsum(h, axis=1) / (np.arange(k) + 1.0)[None, :]
         ap = (h * precision_at_rank).sum(axis=1)
-        return _safe_div(ap, np.minimum(gt_count, k))
+        return _safe_div(ap, np.minimum(data.gt_raw, k))
 
 
 class MRR(RankingMetric):
     """Reciprocal rank of the first relevant recommendation."""
 
-    def _from_hits(self, k, hits, gt_count, pred_len):
-        first = hits.argmax(axis=1)
-        return np.where(hits.any(axis=1), 1.0 / (first + 1.0), 0.0)
+    def _from_hits(self, k, data):
+        first = data.hits_occ.argmax(axis=1)
+        return np.where(data.hits_occ.any(axis=1), 1.0 / (first + 1.0), 0.0)
 
 
 class NDCG(RankingMetric):
-    """Normalized discounted cumulative gain at k."""
+    """Normalized discounted cumulative gain at k.
 
-    def _from_hits(self, k, hits, gt_count, pred_len):
+    DCG sums the discount at every relevant position (occurrences included);
+    IDCG truncates the RAW ground-truth length at k (replay/metrics/ndcg.py:82-93).
+    """
+
+    def _from_hits(self, k, data):
         discounts = 1.0 / np.log2(np.arange(k) + 2.0)
-        dcg = (hits * discounts[None, :]).sum(axis=1)
+        dcg = (data.hits_occ * discounts[None, :]).sum(axis=1)
         ideal_table = np.concatenate([[0.0], np.cumsum(discounts)])
-        idcg = ideal_table[np.clip(gt_count, 0, k)]
+        idcg = ideal_table[np.clip(data.gt_raw, 0, k)]
         return _safe_div(dcg, idcg)
 
 
@@ -141,13 +188,16 @@ class RocAuc(RankingMetric):
     """AUC of relevant-vs-irrelevant ordering within the top-k list.
 
     Concordance formulation: every (relevant, irrelevant) pair where the relevant
-    item ranks higher counts as concordant; AUC = concordant / (pos × neg). A
-    list with no irrelevant items scores 1, with no relevant items 0 — the same
-    boundary convention as the reference.
+    item ranks higher counts as concordant; AUC = concordant / (pos × neg), with
+    positions (not distinct items) as the pair universe — algebraically identical
+    to the reference's ``1 - fp_cum / (fp_cur * (length - fp_cur))``
+    (replay/metrics/rocauc.py:75-95). A list with no irrelevant items scores 1,
+    with no relevant items 0 — the same boundary convention as the reference.
     """
 
-    def _from_hits(self, k, hits, gt_count, pred_len):
-        in_list = np.arange(k)[None, :] < pred_len[:, None]
+    def _from_hits(self, k, data):
+        hits = data.hits_occ
+        in_list = np.arange(k)[None, :] < data.pred_len[:, None]
         negatives = in_list & ~hits
         # negatives ranked strictly above each position
         neg_above = np.cumsum(negatives, axis=1) - negatives
@@ -156,4 +206,4 @@ class RocAuc(RankingMetric):
         concordant = (hits * (neg_total[:, None] - neg_above)).sum(axis=1)
         auc = _safe_div(concordant, pos_total * neg_total)
         auc = np.where((pos_total > 0) & (neg_total == 0), 1.0, auc)
-        return np.where(pred_len == 0, 0.0, auc)
+        return np.where(data.pred_len == 0, 0.0, auc)
