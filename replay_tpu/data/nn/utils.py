@@ -49,12 +49,19 @@ def groupby_sequences(
         from collections.abc import Iterable
 
         # the reference excludes every Iterable-valued column (strings and
-        # arrays included) from the tie-breaker keys (data/nn/utils.py:25-28)
-        sortable = [
-            c
-            for c in value_cols
-            if len(events) == 0 or not isinstance(events.iloc[0][c], Iterable)
-        ]
+        # arrays included) from the tie-breaker keys (data/nn/utils.py:25-28);
+        # inference uses the first NON-NULL value so a NaN in row 0 of a list
+        # column cannot promote it to a (TypeError-raising) sort key
+        def _holds_iterables(col: pd.Series) -> bool:
+            # positional first non-null (label-based first_valid_index is
+            # ambiguous under duplicated index labels); notna is a bool array,
+            # not the object-copy a dropna() would make
+            mask = col.notna().to_numpy()
+            if not mask.any():
+                return False
+            return isinstance(col.iloc[int(mask.argmax())], Iterable)
+
+        sortable = [c for c in value_cols if not _holds_iterables(events[c])]
         keys = [sort_col] + [c for c in sortable if c != sort_col]
         events = events.sort_values(keys, kind="stable")
     return (

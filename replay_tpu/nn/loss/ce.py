@@ -49,9 +49,17 @@ class CEFused(CE):
     logits tensor never reaches HBM — the dominant train-step traffic at
     full-catalog scales. Falls back to interpreter mode off-TPU; prefer it via
     ``Trainer(loss=CEFused())`` when ``jax.default_backend() == "tpu"``.
+
+    Contract: the loss reconstructs logits as ``hidden · get_item_weights()ᵀ``,
+    so it matches :class:`CE` only for models whose ``get_logits`` is a
+    BIAS-FREE tying head over that same table (SasRec/TiSasRec/Bert4Rec). Such
+    models declare ``logits_via_item_weights = True``; the trainer refuses to
+    bind CEFused to a model without that declaration (a model adding an item
+    bias or scale would otherwise silently train with a different loss).
     """
 
     needs_item_embeddings = True
+    requires_tying_head = True
 
     def __init__(
         self, tile: int = 256, item_tile: Optional[int] = None, interpret: bool = None

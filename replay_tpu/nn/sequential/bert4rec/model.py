@@ -118,6 +118,9 @@ class Bert4RecBody(nn.Module):
 class Bert4Rec(nn.Module):
     """BERT4Rec with an embedding-tying head."""
 
+    # bias-free head contract: get_logits(h) == h . get_item_weights()^T
+    logits_via_item_weights = True
+
     schema: TensorSchema
     embedding_dim: int = 64
     num_blocks: int = 2

@@ -101,6 +101,10 @@ class SasRecBody(nn.Module):
 class SasRec(nn.Module):
     """SASRec with an embedding-tying head."""
 
+    # bias-free head contract: get_logits(h) == h . get_item_weights()^T
+    # (no annotation: a plain class attr, not a dataclass field) — see CEFused
+    logits_via_item_weights = True
+
     schema: TensorSchema
     embedding_dim: int = 64
     num_blocks: int = 2

@@ -37,6 +37,9 @@ class TiSasRec(nn.Module):
     ``time_span``.
     """
 
+    # bias-free head contract: get_logits(h) == h . get_item_weights()^T
+    logits_via_item_weights = True
+
     schema: TensorSchema
     embedding_dim: int = 64
     num_blocks: int = 2
@@ -149,6 +152,10 @@ class TiSasRec(nn.Module):
         if candidates_to_score.ndim == 1:
             return self.head(hidden, embedded)
         return jnp.einsum("...e,...ke->...k", hidden, embedded)
+
+    def get_item_weights(self) -> jnp.ndarray:
+        """Item-embedding table [num_items, E] (SCE/CEFused table access)."""
+        return self.embedder.get_item_weights()
 
     def forward_inference(
         self,

@@ -375,6 +375,17 @@ class Trainer:
                 f"{type(model).__name__} defines no get_item_weights() method."
             )
             raise ValueError(msg)
+        if getattr(loss, "requires_tying_head", False) and not getattr(
+            model, "logits_via_item_weights", False
+        ):
+            msg = (
+                f"{type(loss).__name__} reconstructs logits as "
+                "hidden . get_item_weights()^T, which only matches get_logits for "
+                "bias-free tying-head models (declared via "
+                f"logits_via_item_weights=True); {type(model).__name__} makes no "
+                "such declaration."
+            )
+            raise ValueError(msg)
         label_f, tmask_f, neg_f = self.label_field, self.target_mask_field, self.negative_field
         pad_f = self.padding_mask_field
 

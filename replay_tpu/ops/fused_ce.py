@@ -87,9 +87,11 @@ def _dh_kernel(num_items_ref, h_ref, w_ref, g_ref, lse_ref, dh_ref):
 
     logits = _masked_logits(num_items_ref, h_ref, w_ref, w_ref.shape[0])
     weighted = jnp.exp(logits - lse_ref[...]) * g_ref[...].astype(jnp.float32)
+    # f32 accumulation across catalog tiles (dh_ref is f32; the caller casts to
+    # hidden.dtype once after the kernel, mirroring the dW path)
     contrib = jnp.dot(
         weighted, w_ref[...].astype(jnp.float32), preferred_element_type=jnp.float32
-    ).astype(dh_ref.dtype)
+    )
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
@@ -227,7 +229,7 @@ def _fused_lse_bwd(tile, item_tile, interpret, residuals, grad_lse):
             ],
             out_specs=pl.BlockSpec((tile, embed), lambda i, j, *_: (i, 0)),
         ),
-        out_shape=jax.ShapeDtypeStruct((n_pad, embed), hidden.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_pad, embed), jnp.float32),
         interpret=interpret,
     )(scalar, hidden_p, table_p, g, lse_p)
 
@@ -248,7 +250,7 @@ def _fused_lse_bwd(tile, item_tile, interpret, residuals, grad_lse):
         interpret=interpret,
     )(scalar, hidden_p, table_p, g, lse_p)
 
-    return dh[:n], dw[:num_items].astype(table.dtype)
+    return dh[:n].astype(hidden.dtype), dw[:num_items].astype(table.dtype)
 
 
 fused_lse.defvjp(_fused_lse_fwd, _fused_lse_bwd)

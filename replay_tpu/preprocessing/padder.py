@@ -75,12 +75,20 @@ class Padder:
     @staticmethod
     def _as_list(sample) -> list:
         """Cell -> python list; tuples/ndarrays (e.g. parquet round-trips)
-        count as sequences, None/NaN/scalars as empty."""
+        count as sequences, None/NaN as empty. A non-null SCALAR cell is an
+        error: silently mapping it to [] would turn a column of scalars into
+        pure padding rows with no signal that the input was malformed."""
         if isinstance(sample, list):
             return sample
         if isinstance(sample, (tuple, np.ndarray)):
             return list(sample)
-        return []
+        if sample is None or (not isinstance(sample, (str, bytes)) and pd.isna(sample)):
+            return []
+        msg = (
+            "Padder pad-column cells must be lists/tuples/ndarrays or null, "
+            f"got {type(sample).__name__}: {sample!r}"
+        )
+        raise ValueError(msg)
 
     def _pad_one(self, sample, width: int, fill) -> list:
         sample = self._as_list(sample)

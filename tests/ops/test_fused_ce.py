@@ -124,3 +124,27 @@ def test_cefused_trains_identically_to_ce():
     plain, fused = run(CE()), run(CEFused(tile=8))
     np.testing.assert_allclose(fused, plain, rtol=1e-4)
     assert fused[-1] < fused[0]  # and it actually learns
+
+
+def test_cefused_refuses_non_tying_head_model():
+    """A model without the bias-free-head declaration cannot bind CEFused —
+    it would silently train with a different loss than CE (advisor r3)."""
+    import flax.linen as nn
+
+    from replay_tpu.nn import Trainer
+    from replay_tpu.nn.loss import CEFused
+
+    class BiasedHead(nn.Module):
+        # exposes get_item_weights but get_logits is NOT plain h . W^T
+        def __call__(self, feature_tensors, padding_mask):
+            return jnp.zeros((1, 4, 8))
+
+        def get_logits(self, hidden, candidates_to_score=None):
+            return jnp.zeros((1, 4, 10))
+
+        def get_item_weights(self):
+            return jnp.zeros((10, 8))
+
+    trainer = Trainer(model=BiasedHead(), loss=CEFused())
+    with pytest.raises(ValueError, match="logits_via_item_weights"):
+        trainer._build_train_step()
