@@ -170,13 +170,39 @@ def run(log: pd.DataFrame, epochs: int = EPOCHS, synthetic: bool = False) -> dic
         print(f"  {key}: {test_metrics.get(key, float('nan')):.4f}  (reference {target})")
 
     if synthetic:
-        # no dataset in the image: assert the PIPELINE, not the quality
+        # no dataset in the image: assert the PIPELINE and LEARNABILITY (not
+        # the absolute ML-1M numbers, which need real data)
         for key in REFERENCE_VAL:
             assert key in val_metrics, f"missing validation metric {key}"
         for key in REFERENCE_TEST:
             assert key in test_metrics, f"missing test metric {key}"
         assert np.isfinite(list(val_metrics.values())).all()
-        print("\nsynthetic pipeline check OK (quality asserted only on real ML-1M)")
+        # popularity baseline over the SAME split: a silent learning
+        # regression (model stuck at a popularity-like solution or worse)
+        # cannot pass this gate
+        top10 = train_events["item_id"].value_counts().index[:10].to_numpy()
+        discounts = 1.0 / np.log2(np.arange(10) + 2.0)
+        top_discounts = discounts[: len(top10)]  # catalogs under 10 items
+        gt_by_user = validation_gt.groupby("user_id")["item_id"].apply(set)
+        pop_ndcg = float(
+            np.mean(
+                [
+                    (np.isin(top10, list(gt)) * top_discounts).sum()
+                    / discounts[: min(len(gt), 10)].sum()
+                    for gt in gt_by_user
+                ]
+            )
+        )
+        model_ndcg = val_metrics["ndcg@10"]
+        assert model_ndcg > 2.0 * max(pop_ndcg, 0.01), (
+            f"learnability failed: model ndcg@10 {model_ndcg:.4f} vs "
+            f"popularity {pop_ndcg:.4f}"
+        )
+        print(
+            f"\nsynthetic pipeline + learnability OK (model ndcg@10 "
+            f"{model_ndcg:.4f} vs popularity {pop_ndcg:.4f}; quality parity "
+            f"asserted on real ML-1M)"
+        )
     return {"validation": val_metrics, "test": test_metrics}
 
 

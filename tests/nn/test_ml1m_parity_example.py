@@ -22,5 +22,5 @@ def test_ml1m_parity_synthetic_pipeline():
         capture_output=True, text=True, timeout=600, check=False, cwd=str(REPO), env=env,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "synthetic pipeline check OK" in proc.stdout
+    assert "synthetic pipeline + learnability OK" in proc.stdout
     assert "reference 0.0712" in proc.stdout  # parity targets are reported
