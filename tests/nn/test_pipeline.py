@@ -103,6 +103,7 @@ def pipeline_run():
 
 
 @pytest.mark.jax
+@pytest.mark.smoke
 def test_loss_decreases(pipeline_run):
     losses = pipeline_run["losses"]
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.85

@@ -85,6 +85,7 @@ def trained(schema, pipelines):
 
 
 @pytest.mark.jax
+@pytest.mark.smoke
 def test_loss_decreases(trained):
     _, _, losses = trained
     assert np.mean(losses[-6:]) < np.mean(losses[:6]) * 0.8

@@ -80,6 +80,7 @@ def test_single_row_and_tiny_catalog():
     np.testing.assert_allclose(np.asarray(got), np.asarray(jax.nn.logsumexp(h @ w.T, -1)), rtol=1e-5)
 
 
+@pytest.mark.smoke
 def test_cefused_trains_identically_to_ce():
     """CEFused through the Trainer matches CE step losses (shared seed)."""
     from replay_tpu.data import FeatureHint, FeatureType

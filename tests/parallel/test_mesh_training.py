@@ -72,6 +72,7 @@ def run_training(mesh: Mesh, steps: int = 3, shard_vocab: bool = False):
 
 
 @pytest.mark.jax
+@pytest.mark.smoke
 def test_data_parallel_matches_single_device():
     """DP over 8 devices must be numerically equivalent to 1 device: the XLA
     gradient all-reduce replaces DDP without changing the math."""

@@ -24,6 +24,7 @@ def qkv():
 
 
 @pytest.mark.jax
+@pytest.mark.smoke
 @pytest.mark.parametrize("causal", [False, True], ids=["bidirectional", "causal"])
 def test_matches_full_attention(mesh, qkv, causal):
     q, k, v = qkv
