@@ -201,13 +201,23 @@ class BaseRecommender(OptimizeMixin):
         return self.fit(dataset).predict(dataset, k, queries, items, filter_seen_items)
 
     def predict_pairs(self, pairs: pd.DataFrame, dataset: Optional[Dataset] = None) -> pd.DataFrame:
-        """Score the given (query, item) pairs (ref base_rec.py:795)."""
+        """Score the given (query, item) pairs (ref base_rec.py:795).
+
+        Pairs the model cannot score — cold items, and cold queries for models
+        without ``can_predict_cold_queries`` — are DROPPED from the result, the
+        reference's warm-only contract (tests/models/test_all_models.py:55-79).
+        """
         self._check_fitted()
         self._predict_k = None  # no candidate pruning: every pair must be scored
+        # only the key columns participate: a pre-existing 'rating' (e.g. pairs
+        # sliced straight from an interactions frame) must not collide with the
+        # score column in the merge
+        pairs = pairs[[self.query_column, self.item_column]]
         queries = np.sort(pairs[self.query_column].unique())
         items = np.asarray(pairs[self.item_column].unique())
         scores = self._predict_scores(dataset, queries, items)
-        return pairs.merge(scores, on=[self.query_column, self.item_column], how="left")
+        merged = pairs.merge(scores, on=[self.query_column, self.item_column], how="left")
+        return merged.dropna(subset=["rating"]).reset_index(drop=True)
 
     # -- non-personalized helper -------------------------------------------- #
     def _broadcast_item_scores(

@@ -116,6 +116,9 @@ class CatPopRec(BaseRecommender):
     popularity so the model still honors the common contract.
     """
 
+    # category popularity is query-independent: cold queries score fine
+    can_predict_cold_queries = True
+
     _init_arg_names = ["category_column"]
 
     def __init__(self, category_column: str = "category") -> None:

@@ -25,6 +25,9 @@ from .base import BaseRecommender
 
 
 class Word2VecRec(ANNMixin, BaseRecommender):
+    # a cold query has an empty history -> zero query vector -> uniform scores;
+    # the reference keeps such queries rather than dropping them (word2vec.py:51)
+    can_predict_cold_queries = True
     _ann_metric = "cosine"  # predict ranks by cosine; the index must match
     _init_arg_names = [
         "rank", "window_size", "num_negatives", "num_iterations", "learning_rate",
