@@ -76,6 +76,21 @@ def _backend_healthy(timeout: float = 180.0) -> bool:
 PROBE_TIMEOUT = float(os.environ.get("REPLAY_TPU_BENCH_PROBE_TIMEOUT", "120"))
 
 
+def _git_rev():
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=10,
+            check=False,
+        )
+        rev = out.stdout.decode().strip()
+        return rev if out.returncode == 0 and rev else None
+    except OSError:
+        return None
+
+
 def _load_sidecar():
     try:
         with open(SIDECAR_PATH) as fh:
@@ -108,6 +123,17 @@ def main() -> None:
             if sidecar is not None:
                 # real-silicon evidence from earlier in the round beats a live CPU number
                 sidecar["source"] = "sidecar"
+                head = _git_rev()
+                captured_rev = sidecar.get("git_rev")
+                if head and captured_rev and head != captured_rev:
+                    # the sidecar certifies code at captured_rev, NOT this tree
+                    sidecar["stale"] = True
+                    print(
+                        "bench: STALE sidecar — captured at rev %s, HEAD is %s; "
+                        "this record does not certify the current tree"
+                        % (captured_rev[:12], head[:12]),
+                        file=sys.stderr,
+                    )
                 print(
                     "bench: default backend unavailable; reporting persisted TPU run",
                     file=sys.stderr,
@@ -256,6 +282,9 @@ def main() -> None:
             record["mfu"] = round(tflops / peak, 4)
     if record["backend"] == "tpu":
         record["captured_unix"] = int(time.time())
+        rev = _git_rev()
+        if rev:
+            record["git_rev"] = rev
         # best healthy run wins: tunnel/host contention makes step time vary
         # run-to-run, and the sidecar exists to preserve the best evidence
         existing = _load_sidecar()

@@ -204,11 +204,16 @@ def _place_tree(tree: Any, shardings: Any) -> Any:
     process-local data (params/state are replicated; all hosts compute the same
     values from the same seed)."""
     if jax.process_count() > 1:
-        return jax.tree.map(
-            lambda x, s: jax.make_array_from_process_local_data(s, np.asarray(x)),
-            tree,
-            shardings,
-        )
+
+        def place(x, s):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # already a global array (e.g. a multi-host orbax restore that
+                # targeted these same shardings) — cannot be host-fetched, and
+                # needs no re-placement when the sharding already matches
+                return x if x.sharding == s else jax.device_put(x, s)
+            return jax.make_array_from_process_local_data(s, np.asarray(x))
+
+        return jax.tree.map(place, tree, shardings)
     return jax.tree.map(jax.device_put, tree, shardings)
 
 

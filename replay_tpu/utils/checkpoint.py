@@ -62,7 +62,13 @@ def save_pytree(
         raise ValueError(msg)
     # reserved keys win over caller metadata: restore routes on "backend"
     meta = {**(metadata or {}), "num_leaves": len(leaves), "backend": backend}
-    target.with_suffix(".json").write_text(json.dumps(meta))
+    if jax.process_index() == 0:  # one writer for the shared-fs sidecar
+        target.with_suffix(".json").write_text(json.dumps(meta))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # nobody returns (and possibly restores) before the sidecar is on disk
+        multihost_utils.sync_global_devices(f"save_pytree:{target.name}")
 
 
 def restore_pytree(path: str, template: Any) -> Any:
@@ -94,7 +100,15 @@ def restore_pytree(path: str, template: Any) -> Any:
         restored = checkpointer.restore(
             (target.parent / (target.name + ".orbax")).absolute(), abstract
         )
-        leaves = [np.asarray(leaf) for leaf in jax.tree.leaves(restored)]
+        # multi-host restore yields GLOBAL arrays whose remote shards this
+        # process cannot address — keep those as live jax.Arrays (they already
+        # carry the template's shardings); only host-fetch what is local
+        leaves = [
+            leaf
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+            else np.asarray(leaf)
+            for leaf in jax.tree.leaves(restored)
+        ]
     else:
         with np.load(str(target.with_suffix(".npz"))) as payload:
             leaves = [payload[f"leaf_{i}"] for i in range(len(payload.files))]
@@ -179,6 +193,8 @@ class CheckpointManager:
             str(self._step_path(step)), state, {"step": step, **(metadata or {})},
             backend=self.backend,
         )
+        if jax.process_index() != 0:
+            return  # save_pytree already barriered; one process rotates/records
         if history is not None:
             (self.directory / "history.json").write_text(json.dumps(history))
         protected = self.best_step()

@@ -5,6 +5,8 @@ the fixed-shape batcher, and the native kernels."""
 import numpy as np
 import pandas as pd
 import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from replay_tpu.data import FeatureHint, FeatureType

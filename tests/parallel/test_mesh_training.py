@@ -20,7 +20,10 @@ from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
 from replay_tpu.nn.loss import CE
 from replay_tpu.nn.sequential.sasrec import SasRec
 
-NUM_ITEMS = 16
+# 15 items -> a 16-row table (cardinality + padding row) that divides evenly
+# over a model axis of 2 or 4; an odd row count would silently skip vocab
+# sharding (run_training asserts it actually happened)
+NUM_ITEMS = 15
 SEQ_LEN = 6
 BATCH = 8
 
@@ -64,6 +67,16 @@ def run_training(mesh: Mesh, steps: int = 3, shard_vocab: bool = False):
         seed=0,
     )
     state = trainer.init_state(make_train_batch(0))
+    if shard_vocab:
+        # guard against the silent-degradation mode: a table whose row count
+        # does not divide the model axis stays replicated and the comparison
+        # below proves nothing
+        specs = [
+            str(leaf.sharding.spec)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]
+            if "embedding_" in jax.tree_util.keystr(path)
+        ]
+        assert any("model" in spec for spec in specs), specs
     losses = []
     for step in range(steps):
         state, loss_value = trainer.train_step(state, make_train_batch(step))
