@@ -87,7 +87,7 @@ def _git_rev():
         )
         rev = out.stdout.decode().strip()
         return rev if out.returncode == 0 and rev else None
-    except OSError:
+    except (OSError, subprocess.SubprocessError):
         return None
 
 

@@ -1,0 +1,352 @@
+"""North-star benchmark suite: every BASELINE.json config on the live backend.
+
+The one-row headline lives in ``bench.py`` (the driver contract). This suite
+produces the full measurement batch the round-4 verdict asked for:
+
+- ``sasrec_ref``       — notebook-09 config (B512 L50 d64, 3706 items), CE.
+- ``sasrec_ref_fused`` — same with the pallas fused-logsumexp head (A/B).
+- ``sasrec_27k``       — ML-20M-scale catalog (27k items, d128), CE.
+- ``sasrec_27k_fused`` — fused head at 27k (where tile-wise logsumexp earns it).
+- ``sasrec_100k``      — 100k-item catalog; plain CE materializes a [25600,
+  100k] logits tensor (~5 GB bf16 + backward) and may legitimately OOM — that
+  outcome is recorded, it is the fused head's reason to exist.
+- ``sasrec_100k_fused``
+- ``bert4rec``         — notebook-10 config (L100 d300 h4, MLM masking).
+- ``twotower``         — notebook-15 config (d64 L50, in-batch negatives), at
+  B512 (the notebook's B32 is a CPU-host artifact; recorded in the row).
+- ``pipeline_e2e``     — parquet on disk → ParquetBatcher → transforms →
+  prefetch → chunked ``train_steps``: the production input path, measured
+  end-to-end against the device-resident number (ref thread-tuning note,
+  replay/data/nn/parquet/parquet_dataset.py:49-52).
+
+Usage (default env, i.e. the TPU tunnel):
+    python bench_suite.py [--rows row1,row2] [--quick] [--out BENCH_SUITE.json]
+
+``--quick`` shrinks every row to toy shapes on CPU — a script-correctness
+smoke, not a measurement.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from bench import _git_rev, _peak_tflops
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+# --------------------------------------------------------------------------- #
+# shared measurement core
+# --------------------------------------------------------------------------- #
+def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=None):
+    """Warm up, then time K-step scan chunks with device-resident inputs.
+
+    Returns the record dict (never raises: an OOM/compile failure becomes a
+    ``{"error": ...}`` row — for the 100k plain-CE case that IS the result).
+    """
+    import jax
+
+    try:
+        state = trainer.init_state(batch)
+        for _ in range(2):
+            state, loss_value = trainer.train_step(state, batch)
+        jax.block_until_ready(loss_value)
+
+        t0 = time.perf_counter()
+        state, loss_value = trainer.train_step(state, batch)
+        jax.block_until_ready(loss_value)
+        dispatch_step = time.perf_counter() - t0
+
+        step_flops = None
+        try:
+            analysis = (
+                trainer._train_step.lower(state, trainer._put_batch(batch))
+                .compile()
+                .cost_analysis()
+            )
+            if analysis and "flops" in analysis:
+                step_flops = float(analysis["flops"]) + extra_flops_per_step
+        except Exception:
+            pass
+
+        chunk = [batch] * scan_k
+        state, _ = trainer.train_steps(state, chunk)  # compile + warm
+        stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *chunk)
+        placed = trainer._put_stacked(stacked)
+        jax.block_until_ready(placed)
+        scan_fn = trainer._train_scan
+        t0 = time.perf_counter()
+        state, losses = scan_fn(state, placed)
+        jax.block_until_ready(losses)
+        chunk_time = time.perf_counter() - t0
+        n_chunks = max(2, min(12, int(15.0 / max(chunk_time, 1e-6))))
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            state, losses = scan_fn(state, placed)
+        jax.block_until_ready(losses)
+        elapsed = time.perf_counter() - t0
+        steps = n_chunks * scan_k
+
+        batch_size = np.asarray(batch["padding_mask"]).shape[0]
+        record = {
+            "row": label,
+            "samples_per_sec": round(steps * batch_size / elapsed, 1),
+            "step_ms": round(elapsed / steps * 1000, 3),
+            "dispatch_step_ms": round(dispatch_step * 1000, 3),
+            "scan_k": scan_k,
+            "final_loss": round(float(np.asarray(losses)[-1]), 4),
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            **(meta or {}),
+        }
+        if step_flops:
+            tflops = step_flops * steps / elapsed / 1e12
+            record["tflops_per_sec"] = round(tflops, 3)
+            peak = _peak_tflops(record["device_kind"])
+            if peak and record["backend"] != "cpu":
+                record["mfu"] = round(tflops / peak, 4)
+        return record
+    except Exception as exc:  # OOM / compile failure is a result, not a crash
+        return {"row": label, "error": f"{type(exc).__name__}: {str(exc)[:400]}",
+                **(meta or {})}
+
+
+def item_schema(num_items, dim):
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+
+    return TensorSchema(
+        TensorFeatureInfo(
+            "item_id", FeatureType.CATEGORICAL, is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+            embedding_dim=dim,
+        )
+    )
+
+
+def sasrec_batch(num_items, batch, seq_len, seed=0):
+    rng = np.random.default_rng(seed)
+    items = rng.integers(0, num_items, size=(batch, seq_len + 1)).astype(np.int32)
+    mask = np.ones((batch, seq_len), dtype=bool)
+    return {
+        "feature_tensors": {"item_id": items[:, :-1]},
+        "padding_mask": mask,
+        "positive_labels": items[:, 1:, None],
+        "target_padding_mask": mask[:, :, None],
+    }
+
+
+# --------------------------------------------------------------------------- #
+# rows
+# --------------------------------------------------------------------------- #
+def run_sasrec(num_items, dim, batch, seq_len, blocks, heads, fused, label, dtype):
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE, CEFused
+    from replay_tpu.nn.sequential.sasrec import SasRec
+
+    model = SasRec(
+        schema=item_schema(num_items, dim), embedding_dim=dim, num_blocks=blocks,
+        num_heads=heads, max_sequence_length=seq_len, dropout_rate=0.0, dtype=dtype,
+    )
+    trainer = Trainer(
+        model=model, loss=CEFused() if fused else CE(),
+        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3), mesh=make_mesh(),
+    )
+    extra = 6.0 * batch * seq_len * dim * num_items if fused else 0.0
+    return measure(
+        trainer, sasrec_batch(num_items, batch, seq_len), label,
+        extra_flops_per_step=extra,
+        meta={"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
+              "loss": "CEFused" if fused else "CE"},
+    )
+
+
+def run_bert4rec(num_items, dim, batch, seq_len, heads, dtype):
+    import jax
+
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.bert4rec import Bert4Rec
+    from replay_tpu.nn.transform import Compose
+    from replay_tpu.nn.transform.template import make_default_bert4rec_transforms
+
+    schema = item_schema(num_items, dim)
+    model = Bert4Rec(schema=schema, embedding_dim=dim, num_blocks=2, num_heads=heads,
+                     max_sequence_length=seq_len, dropout_rate=0.0, dtype=dtype)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
+                      mesh=make_mesh())
+    rng = np.random.default_rng(0)
+    items = rng.integers(0, num_items, size=(batch, seq_len)).astype(np.int32)
+    raw = {"item_id": items, "item_id_mask": np.ones((batch, seq_len), bool)}
+    pipeline = Compose(make_default_bert4rec_transforms(schema, mask_prob=0.2)["train"])
+    mlm_batch = pipeline(raw, jax.random.PRNGKey(0))
+    # notebook-10 parity point: L=100, hidden 300, heads 4, blocks 2
+    return measure(trainer, mlm_batch, "bert4rec",
+                   meta={"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
+                         "config": "10_bert4rec_example.ipynb (hidden 300, h4, bl2)"})
+
+
+def run_twotower(num_items, dim, batch, seq_len, dtype):
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CESampled
+    from replay_tpu.nn.sequential.twotower import TwoTower
+    from replay_tpu.nn.transform import Compose
+    from replay_tpu.nn.transform.template import make_default_twotower_transforms
+
+    schema = item_schema(num_items, dim)
+    model = TwoTower(schema=schema, embedding_dim=dim, num_blocks=2, num_heads=2,
+                     max_sequence_length=seq_len, dropout_rate=0.0, dtype=dtype)
+    trainer = Trainer(model=model, loss=CESampled(),
+                      optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
+                      mesh=make_mesh())
+    rng = np.random.default_rng(0)
+    items = rng.integers(0, num_items, size=(batch, seq_len + 1)).astype(np.int32)
+    raw = {"item_id": items, "item_id_mask": np.ones((batch, seq_len + 1), bool)}
+    tt_batch = Compose(make_default_twotower_transforms(schema)["train"])(raw)
+    return measure(trainer, tt_batch, "twotower",
+                   meta={"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
+                         "config": "15_twotower_example.ipynb (in-batch negatives; "
+                                   "B512 vs the notebook's CPU-host B32)"})
+
+
+def run_pipeline_e2e(num_items, dim, batch, seq_len, quick, dtype):
+    """parquet → ParquetBatcher → transforms → prefetch → chunked train_steps."""
+    import jax
+
+    from replay_tpu.data.nn import ParquetBatcher, prefetch
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.nn.transform import Compose
+    from replay_tpu.nn.transform.template import make_default_sasrec_transforms
+
+    schema = item_schema(num_items, dim)
+    num_rows = batch * (8 if quick else 64)
+    rng = np.random.default_rng(0)
+
+    with tempfile.TemporaryDirectory(prefix="bench_e2e_") as tmp:
+        path = os.path.join(tmp, "seqs.parquet")
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        lengths = rng.integers(max(2, seq_len // 3), seq_len + 2, size=num_rows)
+        table = pa.table({
+            "query_id": pa.array(np.arange(num_rows)),
+            "item_id": pa.array(
+                [rng.integers(0, num_items, n).tolist() for n in lengths]
+            ),
+        })
+        pq.write_table(table, path)
+
+        model = SasRec(schema=schema, embedding_dim=dim, num_blocks=2, num_heads=1,
+                       max_sequence_length=seq_len, dropout_rate=0.0, dtype=dtype)
+        trainer = Trainer(model=model, loss=CE(),
+                          optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
+                          mesh=make_mesh())
+        pipeline = Compose(make_default_sasrec_transforms(schema)["train"])
+        scan_k = 4 if quick else 8
+
+        def batches(epoch):
+            batcher = ParquetBatcher(
+                path, batch_size=batch, shuffle=True, seed=0,
+                metadata={"item_id": {"shape": seq_len + 1, "padding": num_items}},
+            )
+            batcher.set_epoch(epoch)
+            for raw in batcher:
+                yield pipeline({"item_id": raw["item_id"],
+                                "item_id_mask": raw["item_id_mask"]})
+
+        def chunks(epoch):
+            buf = []
+            for b in batches(epoch):
+                buf.append(b)
+                if len(buf) == scan_k:
+                    yield buf
+                    buf = []
+
+        state = None
+        for chunk in prefetch(chunks(0), depth=2):  # warmup epoch: compile
+            if state is None:
+                state = trainer.init_state(chunk[0])
+            state, losses = trainer.train_steps(state, chunk)
+        jax.block_until_ready(losses)
+
+        steps = 0
+        t0 = time.perf_counter()
+        for chunk in prefetch(chunks(1), depth=2):
+            state, losses = trainer.train_steps(state, chunk)
+            steps += len(chunk)
+        jax.block_until_ready(losses)
+        elapsed = time.perf_counter() - t0
+
+        return {
+            "row": "pipeline_e2e",
+            "samples_per_sec": round(steps * batch / elapsed, 1),
+            "step_ms": round(elapsed / max(steps, 1) * 1000, 3),
+            "scan_k": scan_k,
+            "rows_on_disk": num_rows,
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "num_items": num_items, "d": dim, "B": batch, "L": seq_len,
+            "note": "parquet->ParquetBatcher->transforms->prefetch->train_steps, "
+                    "host time included",
+        }
+
+
+# --------------------------------------------------------------------------- #
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--rows", default="all")
+    parser.add_argument("--quick", action="store_true", help="toy shapes (CPU smoke)")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+    import jax
+
+    on_cpu = jax.default_backend() == "cpu"
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+
+    q = args.quick
+    B, L = (8, 8) if q else (512, 50)
+    rows = {
+        "sasrec_ref": lambda: run_sasrec(3706 if not q else 50, 64, B, L, 2, 1, False, "sasrec_ref", dtype),
+        "sasrec_ref_fused": lambda: run_sasrec(3706 if not q else 50, 64, B, L, 2, 1, True, "sasrec_ref_fused", dtype),
+        "sasrec_27k": lambda: run_sasrec(27278 if not q else 96, 128 if not q else 16, B, L, 2, 2, False, "sasrec_27k", dtype),
+        "sasrec_27k_fused": lambda: run_sasrec(27278 if not q else 96, 128 if not q else 16, B, L, 2, 2, True, "sasrec_27k_fused", dtype),
+        "sasrec_100k": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, False, "sasrec_100k", dtype),
+        "sasrec_100k_fused": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, True, "sasrec_100k_fused", dtype),
+        "bert4rec": lambda: run_bert4rec(27278 if not q else 96, 300 if not q else 16, B, 100 if not q else L, 4 if not q else 2, dtype),
+        "twotower": lambda: run_twotower(27278 if not q else 96, 64 if not q else 16, B, L, dtype),
+        "pipeline_e2e": lambda: run_pipeline_e2e(3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
+    }
+    selected = list(rows) if args.rows == "all" else args.rows.split(",")
+    unknown = [name for name in selected if name not in rows]
+    if unknown:
+        parser.error(f"unknown rows: {unknown}; choose from {list(rows)}")
+
+    results = []
+    for name in selected:
+        print(f"--- {name} ...", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        record = rows[name]()
+        record["wall_s"] = round(time.perf_counter() - t0, 1)
+        record["git_rev"] = _git_rev()
+        record["captured_unix"] = int(time.time())
+        results.append(record)
+        print(json.dumps(record), flush=True)
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,11 @@ MAX_SEQ_LEN = 50
 BATCH_SIZE = 128
 EPOCHS = 5
 LEARNING_RATE = 1e-3
+# notebook 09 trains through LightningModule.configure_optimizers, whose
+# default factory is Adam betas=(0.9, 0.98) — NOT torch's (0.9, 0.999)
+# (replay/models/nn/optimizer_utils/optimizer_factory.py:35, nn/lightning/module.py:98);
+# both frameworks here use the notebook's effective settings
+ADAM_BETAS = (0.9, 0.98)
 TOP_K = 10
 
 NUM_USERS = 1000
@@ -223,14 +228,21 @@ def train_jax(epoch_batches, eval_batches, num_items, seed=0):
             embedding_dim=EMBEDDING_DIM,
         )
     )
+    from replay_tpu.nn import xavier_normal_embed_init
+
     model = SasRec(
         schema=schema, embedding_dim=EMBEDDING_DIM, num_blocks=NUM_BLOCKS,
         num_heads=NUM_HEADS, dropout_rate=DROPOUT,
         max_sequence_length=MAX_SEQ_LEN,
+        # match the reference embedders' xavier-normal init (std sqrt(2/(V+D)))
+        # so neither side gets an init-scale head start
+        embedding_init=xavier_normal_embed_init(),
     )
     trainer = Trainer(
         model=model, loss=CE(),
-        optimizer=OptimizerFactory(name="adam", learning_rate=LEARNING_RATE),
+        optimizer=OptimizerFactory(
+            name="adam", learning_rate=LEARNING_RATE, betas=ADAM_BETAS
+        ),
         seed=seed,
     )
     state = trainer.init_state(epoch_batches[0][0])
@@ -362,7 +374,7 @@ def train_torch(epoch_batches, eval_batches, num_items, reference_path, seed=0):
         schema=schema, embedding_dim=EMBEDDING_DIM, num_heads=NUM_HEADS,
         num_blocks=NUM_BLOCKS, max_sequence_length=MAX_SEQ_LEN, dropout=DROPOUT,
     )
-    optimizer = torch.optim.Adam(model.parameters(), lr=LEARNING_RATE)
+    optimizer = torch.optim.Adam(model.parameters(), lr=LEARNING_RATE, betas=ADAM_BETAS)
 
     def to_torch(batch):
         feature_tensors = {
@@ -442,8 +454,9 @@ def write_report(path, jax_curve, torch_curve, baseline, verdict, epochs):
         "",
         f"Config: d={EMBEDDING_DIM}, blocks={NUM_BLOCKS}, heads={NUM_HEADS}, "
         f"dropout={DROPOUT}, L={MAX_SEQ_LEN}, batch={BATCH_SIZE}, "
-        f"adam lr={LEARNING_RATE}, {epochs} epochs, "
-        f"{NUM_USERS} users x {NUM_ITEMS} items.",
+        f"adam lr={LEARNING_RATE} betas={ADAM_BETAS} (notebook 09's Lightning "
+        "defaults, both frameworks), "
+        f"{epochs} epochs, {NUM_USERS} users x {NUM_ITEMS} items.",
         "",
         "| epoch | jax ndcg@10 | torch ndcg@10 | jax recall@10 | torch recall@10 | jax loss | torch loss |",
         "|---|---|---|---|---|---|---|",

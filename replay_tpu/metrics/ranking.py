@@ -174,6 +174,11 @@ class NDCG(RankingMetric):
 
     DCG sums the discount at every relevant position (occurrences included);
     IDCG truncates the RAW ground-truth length at k (replay/metrics/ndcg.py:82-93).
+
+    >>> recs = {1: [10, 11, 12]}          # ranked recommendations per query
+    >>> ground_truth = {1: [11, 40]}      # relevant items per query
+    >>> round(NDCG(2)(recs, ground_truth)["NDCG@2"], 4)
+    0.3869
     """
 
     def _from_hits(self, k, data):

@@ -4,16 +4,17 @@ Capability parity with replay/models/optimization/optuna_mixin.py:17,168 (the
 ``optimize`` entry point: per-model declarative search spaces, an objective that
 fits + predicts + scores a metric per trial, user-overridable ``param_borders``).
 
-Backend: optuna's TPE when installed (``OPTUNA_AVAILABLE``); otherwise a seeded
-random-search sampler with the same trial loop — the API and results schema are
-identical, so code written against ``optimize`` runs in this image (optuna is not
-baked in) and speeds up transparently where optuna exists.
+Samplers: a native numpy **TPE** (Tree-structured Parzen Estimator, the same
+algorithm family as the reference's ``optuna.samplers.TPESampler``) is the
+default and runs everywhere; ``sampler="random"`` gives seeded random search;
+``sampler="optuna"`` delegates to optuna's TPE when the library is installed
+(``OPTUNA_AVAILABLE``). All three share one trial loop and results schema.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -37,6 +38,135 @@ def _sample(rng: np.random.Generator, spec: Dict[str, Any]):
         return args[int(rng.integers(len(args)))]
     msg = f"Unknown search-space type: {kind}"
     raise ValueError(msg)
+
+
+class TPESampler:
+    """Native Tree-structured Parzen Estimator over a flat search space.
+
+    The TPE recipe (Bergstra et al. 2011, the algorithm behind the reference's
+    ``optuna.samplers.TPESampler``): after ``n_startup`` random trials, split
+    the history at the ``gamma`` quantile into good/bad sets, model each
+    parameter's good and bad observations as Parzen mixtures (Gaussians for
+    numeric kinds, smoothed count ratios for categoricals), draw candidates
+    from the good density, and keep the candidate maximizing l(x)/g(x) — the
+    expected-improvement surrogate. Pure numpy; each parameter is modelled
+    independently (as in optuna's default non-multivariate mode).
+    """
+
+    def __init__(
+        self,
+        n_startup: int = 5,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        explore: float = 0.15,
+    ):
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        # fraction of post-startup trials drawn uniformly from the space: the
+        # escape hatch from a collapsed good-set (the role optuna's wide prior
+        # component plays) — without it the l/g ratio can pin every proposal
+        # inside a suboptimal startup cluster forever
+        self.explore = explore
+
+    # -- per-kind numeric transform: TPE models loguniform in log space ----- #
+    @staticmethod
+    def _to_cont(spec: Dict[str, Any], value):
+        if spec["type"] == "loguniform":
+            return float(np.log(value))
+        return float(value)
+
+    @staticmethod
+    def _bounds(spec: Dict[str, Any]) -> Tuple[float, float]:
+        lo, hi = float(spec["args"][0]), float(spec["args"][1])
+        if spec["type"] == "loguniform":
+            return float(np.log(lo)), float(np.log(hi))
+        return lo, hi
+
+    @staticmethod
+    def _from_cont(spec: Dict[str, Any], x: float):
+        if spec["type"] == "int":
+            lo, hi = spec["args"][0], spec["args"][1]
+            return int(np.clip(round(x), lo, hi))
+        if spec["type"] == "loguniform":
+            return float(np.exp(x))
+        return float(x)
+
+    @staticmethod
+    def _parzen_logpdf(x: np.ndarray, obs: np.ndarray, sigma: float) -> np.ndarray:
+        """log of the equal-weight Gaussian mixture centred on ``obs``."""
+        diff = (x[:, None] - obs[None, :]) / sigma
+        comp = -0.5 * diff * diff - np.log(sigma) - 0.5 * np.log(2 * np.pi)
+        return np.logaddexp.reduce(comp, axis=1) - np.log(len(obs))
+
+    def _bandwidth(self, obs: np.ndarray, span: float) -> float:
+        """Scott-style bandwidth with optuna's "magic clip" analogue: shrinks
+        as observations concentrate (fine refinement near the optimum) but the
+        floor relaxes from span/3 toward span/25 only as evidence accumulates —
+        early collapse is the failure mode."""
+        spread = float(np.std(obs)) if len(obs) > 1 else span
+        floor = span / min(25.0, 1.0 + 2.0 * len(obs))
+        return float(np.clip(1.06 * spread * len(obs) ** -0.2, floor, span))
+
+    def _suggest_numeric(
+        self, rng: np.random.Generator, spec, good: np.ndarray, bad: np.ndarray
+    ) -> float:
+        lo, hi = self._bounds(spec)
+        span = max(hi - lo, 1e-12)
+        # each mixture gets ITS OWN bandwidth: the spread-out bad set needs a
+        # broad kernel or g(x) is spiky and any candidate near a single bad
+        # observation gets vetoed
+        sigma_good = self._bandwidth(good, span)
+        centers = good[rng.integers(len(good), size=self.n_candidates)]
+        cands = np.clip(centers + rng.normal(0.0, sigma_good, self.n_candidates), lo, hi)
+        # a couple of uniform draws keep exploration alive if good collapses
+        cands = np.concatenate([cands, rng.uniform(lo, hi, 2)])
+        score = self._parzen_logpdf(cands, good, sigma_good)
+        if len(bad):
+            score = score - self._parzen_logpdf(cands, bad, self._bandwidth(bad, span))
+        return float(cands[int(np.argmax(score))])
+
+    def _suggest_categorical(self, rng: np.random.Generator, spec, good, bad):
+        choices = spec["args"]
+        counts_good = np.array([1.0 + sum(1 for v in good if v == c) for c in choices])
+        counts_bad = np.array([1.0 + sum(1 for v in bad if v == c) for c in choices])
+        ratio = (counts_good / counts_good.sum()) / (counts_bad / counts_bad.sum())
+        # same shape as the numeric path: draw candidates from the good-smoothed
+        # distribution, keep the best EI ratio among them (near-argmax once a
+        # category establishes itself; the explore trials handle revisiting)
+        p_good = counts_good / counts_good.sum()
+        cands = rng.choice(len(choices), size=self.n_candidates, p=p_good)
+        return choices[int(max(set(cands.tolist()), key=lambda i: ratio[i]))]
+
+    def suggest(
+        self,
+        rng: np.random.Generator,
+        space: SearchSpace,
+        history: List[Tuple[float, Dict[str, Any]]],
+    ) -> Dict[str, Any]:
+        """Propose the next trial's parameters given ``(value, params)`` history."""
+        if len(history) < self.n_startup or rng.random() < self.explore:
+            return {name: _sample(rng, spec) for name, spec in space.items()}
+        order = sorted(range(len(history)), key=lambda i: -history[i][0])
+        n_good = max(1, int(np.ceil(self.gamma * len(history))))
+        good_idx, bad_idx = set(order[:n_good]), set(order[n_good:])
+        params: Dict[str, Any] = {}
+        for name, spec in space.items():
+            good_vals = [history[i][1][name] for i in good_idx if name in history[i][1]]
+            bad_vals = [history[i][1][name] for i in bad_idx if name in history[i][1]]
+            if not good_vals:
+                params[name] = _sample(rng, spec)
+            elif spec["type"] == "categorical":
+                params[name] = self._suggest_categorical(rng, spec, good_vals, bad_vals)
+            else:
+                x = self._suggest_numeric(
+                    rng,
+                    spec,
+                    np.array([self._to_cont(spec, v) for v in good_vals]),
+                    np.array([self._to_cont(spec, v) for v in bad_vals]),
+                )
+                params[name] = self._from_cont(spec, x)
+        return params
 
 
 def _suggest_optuna(trial, name: str, spec: Dict[str, Any]):  # pragma: no cover - optuna absent
@@ -67,9 +197,14 @@ class OptimizeMixin:
         k: int = 10,
         budget: int = 10,
         seed: int = 0,
+        sampler: str = "tpe",
     ) -> Dict[str, Any]:
         """Search ``budget`` configurations; returns the best params (also set on
-        ``self``, refit on the winning configuration)."""
+        ``self``, refit on the winning configuration).
+
+        ``sampler``: ``"tpe"`` (native numpy TPE, default), ``"random"``, or
+        ``"optuna"`` (optuna's TPESampler; requires the library).
+        """
         space = {**self._search_space, **(param_borders or {})}
         if not space:
             msg = f"{type(self).__name__} declares no search space."
@@ -93,8 +228,11 @@ class OptimizeMixin:
             values = criterion(recs, test_interactions)
             return float(next(iter(values.values())))
 
-        results = []
-        if OPTUNA_AVAILABLE:  # pragma: no cover - optuna absent in this image
+        results: List[Tuple[float, Dict[str, Any]]] = []
+        if sampler == "optuna":  # pragma: no cover - optuna absent in this image
+            if not OPTUNA_AVAILABLE:
+                msg = "sampler='optuna' requires the optuna library (pip install optuna)"
+                raise ImportError(msg)
             import optuna
 
             optuna.logging.set_verbosity(optuna.logging.WARNING)
@@ -108,14 +246,21 @@ class OptimizeMixin:
 
             study.optimize(objective, n_trials=budget)
             best_params = study.best_params
-        else:
+        elif sampler in ("tpe", "random"):
             rng = np.random.default_rng(seed)
+            tpe = TPESampler() if sampler == "tpe" else None
             for _ in range(budget):
-                params = {name: _sample(rng, spec) for name, spec in space.items()}
+                if tpe is not None:
+                    params = tpe.suggest(rng, space, results)
+                else:
+                    params = {name: _sample(rng, spec) for name, spec in space.items()}
                 value = run_trial(params)
                 results.append((value, params))
                 logger.info("trial %s -> %.5f", params, value)
             best_params = max(results, key=lambda r: r[0])[1]
+        else:
+            msg = f"Unknown sampler {sampler!r}; use 'tpe', 'random', or 'optuna'."
+            raise ValueError(msg)
 
         for name, value in best_params.items():
             setattr(self, name, value)

@@ -7,6 +7,7 @@ from .embedding import (
     IdentityEmbedding,
     NumericalEmbedding,
     SequenceEmbedding,
+    xavier_normal_embed_init,
 )
 from .ffn import PointWiseFeedForward, SwiGLU, SwiGLUEncoder
 from .utils import create_activation

@@ -19,6 +19,16 @@ import jax.numpy as jnp
 from replay_tpu.data.nn.schema import TensorFeatureInfo, TensorMap, TensorSchema
 
 
+def xavier_normal_embed_init():
+    """torch ``xavier_normal_`` on a [V, D] table: std = sqrt(2 / (V + D)) —
+    the reference embedders' init (replay/nn/embedding.py:199). flax's default
+    (variance-scaling fan-in) gives std = 1/sqrt(D) instead; pass this to
+    ``embedding_init`` for init-identical cross-framework comparisons."""
+    import jax
+
+    return jax.nn.initializers.glorot_normal(in_axis=1, out_axis=0)
+
+
 class CategoricalEmbedding(nn.Module):
     """Embedding table with one extra row reserved for the padding id."""
 
@@ -26,13 +36,16 @@ class CategoricalEmbedding(nn.Module):
     embedding_dim: int
     padding_value: int = 0
     dtype: Any = jnp.float32
+    embedding_init: Any = None  # None -> flax default (variance-scaling fan-in)
 
     def setup(self) -> None:
+        extra = {"embedding_init": self.embedding_init} if self.embedding_init else {}
         self.table = nn.Embed(
             num_embeddings=self.cardinality + 1,
             features=self.embedding_dim,
             dtype=self.dtype,
             name="table",
+            **extra,
         )
 
     def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
@@ -68,16 +81,19 @@ class CategoricalListEmbedding(nn.Module):
     padding_value: int = 0
     pooling: str = "sum"
     dtype: Any = jnp.float32
+    embedding_init: Any = None
 
     def setup(self) -> None:
         if self.pooling not in ("sum", "mean", "max"):
             msg = f"Unknown pooling: {self.pooling}"
             raise ValueError(msg)
+        extra = {"embedding_init": self.embedding_init} if self.embedding_init else {}
         self.table = nn.Embed(
             num_embeddings=self.cardinality + 1,
             features=self.embedding_dim,
             dtype=self.dtype,
             name="table",
+            **extra,
         )
 
     def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
@@ -128,6 +144,7 @@ class SequenceEmbedding(nn.Module):
     categorical_list_pooling: str = "sum"
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
+    embedding_init: Any = None
 
     def setup(self) -> None:
         embedders = {}
@@ -149,6 +166,7 @@ class SequenceEmbedding(nn.Module):
                 embedding_dim=feature.embedding_dim,
                 padding_value=feature.padding_value,
                 dtype=self.dtype,
+                embedding_init=self.embedding_init,
                 name=f"embedding_{feature.name}",
                 **kwargs,
             )

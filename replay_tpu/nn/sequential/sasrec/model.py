@@ -46,12 +46,14 @@ class SasRecBody(nn.Module):
     use_flash: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
+    embedding_init: Any = None  # e.g. embedding.xavier_normal_embed_init()
 
     def setup(self) -> None:
         self.embedder = SequenceEmbedding(
             schema=self.schema,
             excluded_features=self.excluded_features,
             dtype=self.dtype,
+            embedding_init=self.embedding_init,
             name="embedder",
         )
         self.aggregator = PositionAwareAggregator(
@@ -118,6 +120,7 @@ class SasRec(nn.Module):
     use_flash: bool = False
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
+    embedding_init: Any = None  # e.g. embedding.xavier_normal_embed_init()
 
     def setup(self) -> None:
         self.body = SasRecBody(
@@ -134,6 +137,7 @@ class SasRec(nn.Module):
             use_flash=self.use_flash,
             excluded_features=self.excluded_features,
             dtype=self.dtype,
+            embedding_init=self.embedding_init,
             name="body",
         )
         self.head = EmbeddingTyingHead()

@@ -103,7 +103,14 @@ _RULES = {cls.__name__: cls for cls in (QuantileDiscretizingRule, UniformDiscret
 
 
 class Discretizer:
-    """Apply a set of discretizing rules column-wise (ref Discretizer API)."""
+    """Apply a set of discretizing rules column-wise (ref Discretizer API).
+
+    >>> import pandas as pd
+    >>> df = pd.DataFrame({"age": [1.0, 2.0, 3.0, 4.0]})
+    >>> Discretizer([QuantileDiscretizingRule("age", n_bins=2)]).fit_transform(df)[
+    ...     "age"].tolist()
+    [0, 0, 1, 1]
+    """
 
     def __init__(self, rules: Sequence[BaseDiscretizingRule]) -> None:
         self.rules: List[BaseDiscretizingRule] = list(rules)

@@ -84,7 +84,15 @@ class InteractionEntriesFilter(_BaseFilter):
 
 
 class MinCountFilter(_BaseFilter):
-    """Keep rows whose ``groupby_column`` value occurs at least ``num_entries`` times."""
+    """Keep rows whose ``groupby_column`` value occurs at least ``num_entries`` times.
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"user_id": [1, 1, 2], "item_id": [10, 11, 10]})
+    >>> MinCountFilter(num_entries=2).transform(log)
+       user_id  item_id
+    0        1       10
+    1        1       11
+    """
 
     def __init__(self, num_entries: int, groupby_column: str = "user_id") -> None:
         if num_entries <= 0:
@@ -260,7 +268,16 @@ class QuantileItemsFilter(_BaseFilter):
         return result
 
 class ConsecutiveDuplicatesFilter(_BaseFilter):
-    """Collapse runs of repeated items inside each query's timeline to one row."""
+    """Collapse runs of repeated items inside each query's timeline to one row.
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({
+    ...     "query_id": [1, 1, 1, 1], "item_id": [10, 10, 11, 10],
+    ...     "timestamp": [0, 1, 2, 3],
+    ... })
+    >>> ConsecutiveDuplicatesFilter().transform(log)["item_id"].tolist()
+    [10, 11, 10]
+    """
 
     def __init__(
         self,

@@ -260,7 +260,16 @@ class SequenceEncodingRule(LabelEncodingRule):
 
 
 class LabelEncoder:
-    """Apply a set of encoding rules column-wise to a dataframe."""
+    """Apply a set of encoding rules column-wise to a dataframe.
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"item_id": ["b", "a", "b"]})
+    >>> encoder = LabelEncoder([LabelEncodingRule("item_id")])
+    >>> encoder.fit_transform(log)["item_id"].tolist()
+    [0, 1, 0]
+    >>> encoder.inverse_transform(pd.DataFrame({"item_id": [1]}))["item_id"].tolist()
+    ['a']
+    """
 
     def __init__(self, rules: Sequence[LabelEncodingRule]) -> None:
         self.rules = list(rules)

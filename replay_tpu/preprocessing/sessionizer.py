@@ -16,6 +16,14 @@ import pandas as pd
 
 
 class Sessionizer:
+    """Assign session ids from per-query timestamp gaps (ref: replay/preprocessing/sessionizer.py).
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"query_id": [1, 1, 1], "timestamp": [0.0, 10.0, 500.0]})
+    >>> Sessionizer(session_gap=100.0).transform(log)["session_id"].nunique()
+    2
+    """
+
     def __init__(
         self,
         session_gap: float = 86400.0,

@@ -24,7 +24,17 @@ def _row_num(df: pd.DataFrame, group_col: str, ts_col: str) -> pd.Series:
 
 
 class RatioSplitter(Splitter):
-    """Per-group tail fraction goes to test (reference: replay/splitters/ratio_splitter.py:13)."""
+    """Per-group tail fraction goes to test (reference: replay/splitters/ratio_splitter.py:13).
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({
+    ...     "query_id": [1, 1, 1, 1], "item_id": [10, 11, 12, 13],
+    ...     "timestamp": [0, 1, 2, 3],
+    ... })
+    >>> train, test = RatioSplitter(test_size=0.5).split(log)
+    >>> train["item_id"].tolist(), test["item_id"].tolist()
+    ([10, 11], [12, 13])
+    """
 
     _init_arg_names = [
         *Splitter._init_arg_names,

@@ -52,6 +52,102 @@ def test_optimize_no_space_raises():
         PopRec().optimize(make_dataset(grouped_log()), make_dataset(grouped_log()))
 
 
+def test_optimize_tpe_itemknn():
+    """The native TPE sampler drives the full optimize loop on a real model."""
+    log = grouped_log()
+    train, test = RatioSplitter(test_size=0.4, divide_column="query_id").split(log)
+    model = ItemKNN()
+    best = model.optimize(
+        make_dataset(train), make_dataset(test), budget=8, k=3, seed=0, sampler="tpe"
+    )
+    assert set(best) == {"num_neighbours", "shrink", "weighting"}
+    assert model.num_neighbours == best["num_neighbours"]
+    assert model.similarity is not None
+
+
+def test_optimize_unknown_sampler_raises():
+    with pytest.raises(ValueError, match="sampler"):
+        ItemKNN().optimize(
+            make_dataset(grouped_log()), make_dataset(grouped_log()), sampler="grid"
+        )
+
+
+def _run_sampler(sampler_name: str, budget: int, seed: int) -> list:
+    """Maximize a known objective over a mixed space; return trial values."""
+    from replay_tpu.models.optimization import TPESampler, _sample
+
+    space = {
+        "x": {"type": "uniform", "args": [0.0, 1.0]},
+        "lr": {"type": "loguniform", "args": [1e-4, 1.0]},
+        "n": {"type": "int", "args": [1, 32]},
+        "mode": {"type": "categorical", "args": ["a", "b", "c"]},
+    }
+
+    def objective(p):
+        return (
+            -((p["x"] - 0.73) ** 2)
+            - (np.log10(p["lr"]) + 2.0) ** 2 * 0.1
+            - abs(p["n"] - 20) * 0.01
+            + (0.3 if p["mode"] == "b" else 0.0)
+        )
+
+    rng = np.random.default_rng(seed)
+    tpe = TPESampler() if sampler_name == "tpe" else None
+    history = []
+    for _ in range(budget):
+        params = tpe.suggest(rng, space, history) if tpe else {
+            k: _sample(rng, s) for k, s in space.items()
+        }
+        history.append((objective(params), params))
+    return [v for v, _ in history]
+
+
+def test_tpe_sampler_converges_1d():
+    """On 1-D smooth objectives the Parzen machinery must actually converge —
+    uniform, loguniform and categorical kinds each home in on the optimum."""
+    from replay_tpu.models.optimization import TPESampler
+
+    # uniform: maximize -(x - 0.73)^2
+    rng = np.random.default_rng(2)
+    tpe = TPESampler(explore=0.0)
+    hist = []
+    for _ in range(30):
+        p = tpe.suggest(rng, {"x": {"type": "uniform", "args": [0.0, 1.0]}}, hist)
+        hist.append((-((p["x"] - 0.73) ** 2), p))
+    assert abs(max(hist)[1]["x"] - 0.73) < 0.05
+    assert abs(np.mean([p["x"] for _, p in hist[-10:]]) - 0.73) < 0.1
+
+    # loguniform: maximize -(log10(lr) + 2)^2, optimum lr = 1e-2
+    rng = np.random.default_rng(3)
+    hist = []
+    for _ in range(30):
+        p = tpe.suggest(rng, {"lr": {"type": "loguniform", "args": [1e-5, 1.0]}}, hist)
+        hist.append((-((np.log10(p["lr"]) + 2.0) ** 2), p))
+    assert abs(np.log10(max(hist)[1]["lr"]) + 2.0) < 0.5
+
+    # categorical: +1 for 'b'; post-startup proposals lock onto it
+    rng = np.random.default_rng(4)
+    hist = []
+    for _ in range(25):
+        p = tpe.suggest(rng, {"m": {"type": "categorical", "args": ["a", "b", "c"]}}, hist)
+        hist.append(((1.0 if p["m"] == "b" else 0.0), p))
+    post = [p["m"] for _, p in hist[5:]]
+    assert post.count("b") / len(post) > 0.8
+
+
+def test_tpe_sampler_improves_over_startup():
+    """On the mixed 4-d space the guided phase must (a) keep improving past the
+    random startup and (b) concentrate: its mean objective beats the startup
+    mean on every seed. (A best-of-N race against pure random is deliberately
+    NOT asserted: at budget 30 on a bounded smooth objective, best-of-30 random
+    is a near-optimal strategy — Bergstra & Bengio 2012 — and the outcome is a
+    coin flip either way.)"""
+    for seed in range(5):
+        tpe_vals = _run_sampler("tpe", budget=30, seed=seed)
+        assert max(tpe_vals) >= max(tpe_vals[:5])  # startup phase is trials 0-4
+        assert np.mean(tpe_vals[5:]) > np.mean(tpe_vals[:5])
+
+
 def test_fallback_tops_up_sparse_main():
     log = grouped_log()
     dataset = make_dataset(log)

@@ -53,28 +53,11 @@ def _run_two_workers(script: str, extra_args, env) -> None:
 
 @pytest.mark.jax
 def test_two_process_dp_matches_single_process(tmp_path):
-    port = _free_port()
-    coordinator = f"127.0.0.1:{port}"
-    env = {
-        **{k: v for k, v in os.environ.items() if ".axon_site" not in v},
-        "PYTHONPATH": str(REPO_ROOT),
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
-        "REPLAY_TPU_CLEAN_REEXEC": "1",
-    }
-    workers = [
-        subprocess.Popen(
-            [sys.executable, str(REPO_ROOT / "tests/parallel/mp_worker.py"),
-             str(rank), coordinator, str(tmp_path / f"rank{rank}.json")],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        )
-        for rank in range(2)
-    ]
-    outputs = [w.communicate(timeout=300) for w in workers]
-    for worker, (stdout, stderr) in zip(workers, outputs):
-        assert worker.returncode == 0, stderr.decode()[-2000:]
-
+    _run_two_workers(
+        "mp_worker.py",
+        lambda rank: [str(tmp_path / f"rank{rank}.json")],
+        _clean_two_proc_env(),
+    )
     results = [json.loads((tmp_path / f"rank{r}.json").read_text()) for r in range(2)]
     # both hosts observe the SAME (psum-reduced, replicated) losses
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"], rtol=1e-6)
