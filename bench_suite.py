@@ -335,16 +335,19 @@ def main():
     for name in selected:
         print(f"--- {name} ...", file=sys.stderr, flush=True)
         t0 = time.perf_counter()
-        record = rows[name]()
+        try:
+            record = rows[name]()
+        except Exception as exc:  # a crashed row must not lose the session
+            record = {"row": name, "error": f"{type(exc).__name__}: {str(exc)[:400]}"}
         record["wall_s"] = round(time.perf_counter() - t0, 1)
         record["git_rev"] = _git_rev()
         record["captured_unix"] = int(time.time())
         results.append(record)
         print(json.dumps(record), flush=True)
-
+        if args.out:  # write-through: completed rows survive a later crash
+            with open(args.out, "w") as fh:
+                json.dump(results, fh, indent=1)
     if args.out:
-        with open(args.out, "w") as fh:
-            json.dump(results, fh, indent=1)
         print(f"wrote {args.out}", file=sys.stderr)
 
 
