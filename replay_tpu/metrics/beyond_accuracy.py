@@ -59,7 +59,13 @@ class Surprisal(Metric):
 
 
 class Coverage(Metric):
-    """Fraction of the train catalog that appears in anyone's top-k recommendations."""
+    """Fraction of the train catalog that appears in anyone's top-k recommendations.
+
+    >>> recs = {1: [10, 11], 2: [10, 12]}
+    >>> train = {1: [10, 11, 13], 2: [12, 14]}     # 5-item catalog
+    >>> Coverage(2)(recs, train)
+    {'Coverage@2': 0.6}
+    """
 
     def __init__(
         self,

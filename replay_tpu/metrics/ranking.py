@@ -121,7 +121,11 @@ def _safe_div(numerator: np.ndarray, denominator: np.ndarray) -> np.ndarray:
 
 
 class HitRate(RankingMetric):
-    """1 if any of the top-k recommendations is relevant."""
+    """1 if any of the top-k recommendations is relevant.
+
+    >>> HitRate(2)({1: [10, 11], 2: [12, 13]}, {1: [11], 2: [99]})
+    {'HitRate@2': 0.5}
+    """
 
     def _from_hits(self, k, data):
         return data.hits_occ.any(axis=1).astype(np.float64)
@@ -140,7 +144,11 @@ class Precision(RankingMetric):
 
 
 class Recall(RankingMetric):
-    """Fraction of the relevant items captured in the top-k recommendations."""
+    """Fraction of the relevant items captured in the top-k recommendations.
+
+    >>> Recall(2)({1: [10, 11]}, {1: [11, 40]})
+    {'Recall@2': 0.5}
+    """
 
     def _from_hits(self, k, data):
         return _safe_div(data.hits_first.sum(axis=1), data.gt_set)
@@ -162,7 +170,11 @@ class MAP(RankingMetric):
 
 
 class MRR(RankingMetric):
-    """Reciprocal rank of the first relevant recommendation."""
+    """Reciprocal rank of the first relevant recommendation.
+
+    >>> MRR(3)({1: [10, 11, 12]}, {1: [11]})
+    {'MRR@3': 0.5}
+    """
 
     def _from_hits(self, k, data):
         first = data.hits_occ.argmax(axis=1)

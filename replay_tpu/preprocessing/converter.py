@@ -14,6 +14,14 @@ import pandas as pd
 
 
 class CSRConverter:
+    """Interactions frame -> ``scipy.sparse.csr_matrix`` (ref preprocessing/converter.py).
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"query_id": [0, 0, 1], "item_id": [0, 2, 1]})
+    >>> CSRConverter().transform(log).toarray().tolist()
+    [[1.0, 0.0, 1.0], [0.0, 1.0, 0.0]]
+    """
+
     def __init__(
         self,
         first_dim_column: str = "query_id",

@@ -107,7 +107,13 @@ class MinCountFilter(_BaseFilter):
 
 
 class LowRatingFilter(_BaseFilter):
-    """Keep rows with ``rating_column`` >= ``value``."""
+    """Keep rows with ``rating_column`` >= ``value``.
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"item_id": [1, 2, 3], "rating": [1.0, 3.0, 5.0]})
+    >>> LowRatingFilter(value=3.0).transform(log)["item_id"].tolist()
+    [2, 3]
+    """
 
     def __init__(self, value: float, rating_column: str = "rating") -> None:
         self.value = value
@@ -118,7 +124,15 @@ class LowRatingFilter(_BaseFilter):
 
 
 class NumInteractionsFilter(_BaseFilter):
-    """Keep the first/last ``num_interactions`` interactions of each query (by timestamp)."""
+    """Keep the first/last ``num_interactions`` interactions of each query (by timestamp).
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"user_id": [1, 1, 1], "item_id": [10, 11, 12],
+    ...                     "timestamp": [0, 1, 2]})
+    >>> NumInteractionsFilter(num_interactions=2, first=False).transform(log)[
+    ...     "item_id"].tolist()
+    [11, 12]
+    """
 
     def __init__(
         self,

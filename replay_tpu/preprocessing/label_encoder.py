@@ -32,7 +32,15 @@ class LabelEncoderPartialFitWarning(Warning):
 
 
 class LabelEncodingRule:
-    """Encode one scalar column's values into contiguous ids ``[0, n)``."""
+    """Encode one scalar column's values into contiguous ids ``[0, n)``.
+
+    >>> import pandas as pd
+    >>> rule = LabelEncodingRule("item_id", handle_unknown="use_default_value",
+    ...                          default_value=-1)
+    >>> _ = rule.fit(pd.DataFrame({"item_id": ["a", "b"]}))
+    >>> rule.transform(pd.DataFrame({"item_id": ["b", "NEW"]}))["item_id"].tolist()
+    [1, -1]
+    """
 
     def __init__(
         self,

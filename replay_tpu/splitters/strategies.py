@@ -93,7 +93,15 @@ class RatioSplitter(Splitter):
 
 
 class TimeSplitter(Splitter):
-    """Split at a timestamp threshold; float threshold means a global row-count quantile."""
+    """Split at a timestamp threshold; float threshold means a global row-count quantile.
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"query_id": [1, 1, 2, 2], "item_id": [10, 11, 10, 12],
+    ...                     "timestamp": [0, 10, 5, 20]})
+    >>> train, test = TimeSplitter(time_threshold=0.5).split(log)
+    >>> sorted(test["item_id"].tolist())
+    [11, 12]
+    """
 
     _init_arg_names = [*Splitter._init_arg_names, "time_threshold", "time_column_format"]
 
@@ -150,7 +158,16 @@ class TimeSplitter(Splitter):
 
 
 class LastNSplitter(Splitter):
-    """Last N interactions (or last N seconds of history) per group go to test."""
+    """Last N interactions (or last N seconds of history) per group go to test.
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"query_id": [1, 1, 1, 2, 2], "item_id": [10, 11, 12, 10, 13],
+    ...                     "timestamp": [0, 1, 2, 0, 1]})
+    >>> train, test = LastNSplitter(N=1, divide_column="query_id",
+    ...                             strategy="interactions").split(log)
+    >>> sorted(test["item_id"].tolist())   # last event of each query
+    [12, 13]
+    """
 
     _init_arg_names = [*Splitter._init_arg_names, "N", "divide_column", "strategy"]
 
@@ -449,7 +466,15 @@ class TwoStageSplitter(Splitter):
 
 
 class KFolds(Splitter):
-    """Yield ``n_folds`` (train, test) pairs; each query's rows are dealt round-robin."""
+    """Yield ``n_folds`` (train, test) pairs; each query's rows are dealt round-robin.
+
+    >>> import pandas as pd
+    >>> log = pd.DataFrame({"query_id": [1, 1, 1, 1], "item_id": [10, 11, 12, 13],
+    ...                     "timestamp": [0, 1, 2, 3]})
+    >>> folds = list(KFolds(n_folds=2, seed=0).split(log))
+    >>> [len(test) for _, test in folds]
+    [2, 2]
+    """
 
     _init_arg_names = [*Splitter._init_arg_names, "n_folds", "strategy", "seed"]
 
