@@ -11,6 +11,9 @@ produces the full measurement batch the round-4 verdict asked for:
   100k] logits tensor (~5 GB bf16 + backward) and may legitimately OOM — that
   outcome is recorded, it is the fused head's reason to exist.
 - ``sasrec_100k_fused``
+- ``sasrec_100k_sce``  — SCE (bucketed hard-negative mining, the reference's
+  scalable loss) at the 100k catalog: the approximate-loss alternative to
+  CEFused's exact logsumexp (not numerically comparable to the CE rows).
 - ``bert4rec``         — notebook-10 config (L100 d300 h4, MLM masking).
 - ``twotower``         — notebook-15 config (d64 L50, in-batch negatives), at
   B512 (the notebook's B32 is a CPU-host artifact; recorded in the row).
@@ -163,6 +166,34 @@ def run_sasrec(num_items, dim, batch, seq_len, blocks, heads, fused, label, dtyp
         extra_flops_per_step=extra,
         meta={"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
               "loss": "CEFused" if fused else "CE"},
+    )
+
+
+def run_sasrec_sce(num_items, dim, batch, seq_len, label, dtype, quick):
+    """SCE (bucketed hard-negative mining) — the reference's scalable-loss
+    answer to huge catalogs, vs CEFused's exact tile-wise logsumexp."""
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import SCE, SCEParams
+    from replay_tpu.nn.sequential.sasrec import SasRec
+
+    tokens = batch * seq_len
+    n_buckets = max(4, int(round(tokens ** 0.5 / 16)) * 16)
+    size = 8 if quick else 256
+    model = SasRec(
+        schema=item_schema(num_items, dim), embedding_dim=dim, num_blocks=2,
+        num_heads=2, max_sequence_length=seq_len, dropout_rate=0.0, dtype=dtype,
+    )
+    trainer = Trainer(
+        model=model,
+        loss=SCE(SCEParams(n_buckets=n_buckets, bucket_size_x=size, bucket_size_y=size)),
+        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3), mesh=make_mesh(),
+    )
+    return measure(
+        trainer, sasrec_batch(num_items, batch, seq_len), label,
+        meta={"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
+              "loss": f"SCE(nb={n_buckets},bx={size},by={size})",
+              "note": "approximate loss (hard-negative buckets): scalability row, "
+                      "not numerically comparable to CE rows"},
     )
 
 
@@ -322,6 +353,7 @@ def main():
         "sasrec_27k_fused": lambda: run_sasrec(27278 if not q else 96, 128 if not q else 16, B, L, 2, 2, True, "sasrec_27k_fused", dtype),
         "sasrec_100k": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, False, "sasrec_100k", dtype),
         "sasrec_100k_fused": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, True, "sasrec_100k_fused", dtype),
+        "sasrec_100k_sce": lambda: run_sasrec_sce(100000 if not q else 128, 128 if not q else 16, B, L, "sasrec_100k_sce", dtype, q),
         "bert4rec": lambda: run_bert4rec(27278 if not q else 96, 300 if not q else 16, B, 100 if not q else L, 4 if not q else 2, dtype),
         "twotower": lambda: run_twotower(27278 if not q else 96, 64 if not q else 16, B, L, dtype),
         "pipeline_e2e": lambda: run_pipeline_e2e(3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
