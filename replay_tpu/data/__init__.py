@@ -1,7 +1,15 @@
 from .batching import UniformBatching, uniform_batch_count
 from .dataset import Dataset, nunique, select
 from .dataset_label_encoder import DatasetLabelEncoder
-from .schema import FeatureHint, FeatureInfo, FeatureSchema, FeatureSource, FeatureType
+from .schema import (
+    FeatureHint,
+    FeatureInfo,
+    FeatureSchema,
+    FeatureSource,
+    FeatureType,
+    interaction_schema,
+)
+from .spark_schema import get_schema
 
 __all__ = [
     "Dataset",
@@ -12,6 +20,8 @@ __all__ = [
     "FeatureSchema",
     "FeatureSource",
     "FeatureType",
+    "get_schema",
+    "interaction_schema",
     "nunique",
     "select",
     "uniform_batch_count",

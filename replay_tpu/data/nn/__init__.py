@@ -1,6 +1,11 @@
 from .schema_builder import TensorSchemaBuilder
 from .utils import ensure_pandas, groupby_sequences
-from .iterator import SequenceBatcher, validation_batches
+from .iterator import (
+    DEFAULT_GROUND_TRUTH_PADDING_VALUE,
+    DEFAULT_TRAIN_PADDING_VALUE,
+    SequenceBatcher,
+    validation_batches,
+)
 from .module import DataModule
 from .parquet import ParquetBatcher, write_sequence_parquet
 from .partitioning import Partitioning, ReplicasInfo
@@ -8,6 +13,14 @@ from .prefetch import prefetch
 from .schema import TensorFeatureInfo, TensorFeatureSource, TensorMap, TensorSchema
 from .sequence_tokenizer import SequenceTokenizer
 from .sequential_dataset import SequentialDataset
+
+# reference-API aliases, below every import they depend on:
+# - the reference names its pandas-backed variant explicitly
+#   (replay/data/nn/sequential_dataset.py); ours IS pandas-backed
+# - batches are plain mutable dicts; the reference types the two separately
+#   (replay/data/nn/schema.py)
+PandasSequentialDataset = SequentialDataset
+MutableTensorMap = TensorMap
 
 __all__ = [
     "ensure_pandas",
@@ -24,6 +37,10 @@ __all__ = [
     "TensorFeatureInfo",
     "TensorFeatureSource",
     "TensorMap",
+    "MutableTensorMap",
+    "PandasSequentialDataset",
+    "DEFAULT_GROUND_TRUTH_PADDING_VALUE",
+    "DEFAULT_TRAIN_PADDING_VALUE",
     "TensorSchema",
     "validation_batches",
     "write_sequence_parquet",

@@ -24,6 +24,14 @@ import numpy as np
 from replay_tpu.data.nn.partitioning import Partitioning
 from replay_tpu.data.nn.sequential_dataset import SequentialDataset
 
+# id-set padding sentinels for validation batches (MetricsBuilder's contract).
+# The reference needs distinct -1/-2 because its ground-truth and train id
+# sets can ride one tensor (torch_sequential_dataset.py:179-180); here they
+# are separate arrays, so both sentinels are any-negative — kept as named
+# constants for reference-API familiarity.
+DEFAULT_GROUND_TRUTH_PADDING_VALUE = -1
+DEFAULT_TRAIN_PADDING_VALUE = -1
+
 Batch = Dict[str, np.ndarray]
 
 
@@ -261,8 +269,8 @@ def validation_batches(
     )
     for batch in batcher:
         n = len(batch["query_id"])
-        gt = np.full((n, gt_max), -1, dtype=np.int64)
-        seen = np.full((n, train_max), -1, dtype=np.int64)
+        gt = np.full((n, gt_max), DEFAULT_GROUND_TRUTH_PADDING_VALUE, dtype=np.int64)
+        seen = np.full((n, train_max), DEFAULT_TRAIN_PADDING_VALUE, dtype=np.int64)
         for b, query_id in enumerate(batch["query_id"]):
             if not batch["valid"][b]:
                 continue

@@ -310,3 +310,36 @@ class FeatureSchema(Mapping[str, FeatureInfo]):
             if len(cols) > 1:
                 msg = f"{hint.name} hint assigned to multiple columns: {cols}"
                 raise ValueError(msg)
+
+
+def interaction_schema(
+    query_column: str = "query_id",
+    item_column: str = "item_id",
+    timestamp_column: str = "timestamp",
+    rating_column: str = "rating",
+    has_timestamp: bool = True,
+    has_rating: bool = True,
+) -> FeatureSchema:
+    """The canonical interaction-log :class:`FeatureSchema` in one call.
+
+    The framework-idiomatic sibling of ``replay_tpu.data.get_schema`` (which
+    keeps the reference contract of returning a Spark ``StructType``,
+    replay/data/spark_schema.py:7-33): same four canonical columns, but as the
+    native schema type every Dataset/splitter/tokenizer consumes.
+
+    >>> [f.column for f in interaction_schema(has_rating=False).all_features]
+    ['query_id', 'item_id', 'timestamp']
+    """
+    features = [
+        FeatureInfo(query_column, FeatureType.CATEGORICAL, FeatureHint.QUERY_ID),
+        FeatureInfo(item_column, FeatureType.CATEGORICAL, FeatureHint.ITEM_ID),
+    ]
+    if has_timestamp:
+        features.append(
+            FeatureInfo(timestamp_column, FeatureType.NUMERICAL, FeatureHint.TIMESTAMP)
+        )
+    if has_rating:
+        features.append(
+            FeatureInfo(rating_column, FeatureType.NUMERICAL, FeatureHint.RATING)
+        )
+    return FeatureSchema(features)

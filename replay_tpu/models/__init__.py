@@ -10,8 +10,15 @@ from .random_rec import RandomRec
 from .slim import SLIM
 from .word2vec import Word2VecRec
 
+# reference-API aliases: replay's abstract base is exported as `Recommender`
+# (replay/models/__init__.py:12) and its implicit-lib ALS wrapper as `ALSWrap`
+Recommender = BaseRecommender
+ALSWrap = ALS
+
 __all__ = [
     "ALS",
+    "ALSWrap",
+    "Recommender",
     "ANNMixin",
     "MIPSIndex",
     "AssociationRulesItemRec",

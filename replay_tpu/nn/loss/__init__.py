@@ -5,6 +5,13 @@ from .login_ce import LogInCE, LogInCESampled
 from .logout_ce import LogOutCE, LogOutCEWeighted
 from .sce import SCE, ScalableCrossEntropyLoss, SCEParams
 
+# with a sampled negative pool, masking the other positives out of the softmax
+# reduces to plain sampled CE — the reference ships the same literal alias
+# (replay/nn/loss/__init__.py:7, `LogOutCESampled = CE`)
+LogOutCESampled = CESampled
+# protocol name used by the reference's typing surface
+LossProto = LossBase
+
 __all__ = [
     "BCE",
     "BCESampled",
@@ -17,6 +24,8 @@ __all__ = [
     "LogOutCE",
     "LogOutCEWeighted",
     "LossBase",
+    "LossProto",
+    "LogOutCESampled",
     "SCE",
     "SCEParams",
     "ScalableCrossEntropyLoss",
