@@ -383,6 +383,22 @@ class Dataset:
 
         return self._converted(lambda df: pl.from_pandas(df) if df_backend(df) == "pandas" else df)
 
+    def to_spark(self) -> "Dataset":  # pragma: no cover - pyspark absent in image
+        """Spark-backed copy (ref dataset.py:720). Spark is an input/output
+        adapter here, not an execution engine — requires an active session."""
+        from replay_tpu.utils.types import PYSPARK_AVAILABLE
+
+        if self.is_spark:
+            return self
+        if not PYSPARK_AVAILABLE:
+            msg = "pyspark is not installed"
+            raise ImportError(msg)
+        from pyspark.sql import SparkSession
+
+        spark = SparkSession.getActiveSession() or SparkSession.builder.getOrCreate()
+        pandas_self = self.to_pandas()
+        return pandas_self._converted(spark.createDataFrame)
+
     def _converted(self, convert) -> "Dataset":  # pragma: no cover
         return Dataset(
             feature_schema=self._feature_schema.copy(),

@@ -32,10 +32,29 @@ class DatasetLabelEncoder:
         self._handle_unknown = handle_unknown_rule
         self._default_value = default_value_rule
         self._encoding_rules: dict[str, LabelEncodingRule] = {}
+        self._columns_by_source: dict[FeatureSource, list[str]] = {}
 
     @property
     def interactions_encoder(self) -> Optional[LabelEncoder]:
-        return self._group_encoder_or_none(self._fitted_columns())
+        """Encoder over the columns present in the interactions frame
+        (ref data/nn/sequence_tokenizer.py:130)."""
+        return self._group_encoder_or_none(
+            self._columns_by_source.get(FeatureSource.INTERACTIONS, [])
+        )
+
+    @property
+    def query_features_encoder(self) -> Optional[LabelEncoder]:
+        """Encoder over the columns present in the query-features frame."""
+        return self._group_encoder_or_none(
+            self._columns_by_source.get(FeatureSource.QUERY_FEATURES, [])
+        )
+
+    @property
+    def item_features_encoder(self) -> Optional[LabelEncoder]:
+        """Encoder over the columns present in the item-features frame."""
+        return self._group_encoder_or_none(
+            self._columns_by_source.get(FeatureSource.ITEM_FEATURES, [])
+        )
 
     def _fitted_columns(self) -> Sequence[str]:
         return list(self._encoding_rules)
@@ -43,6 +62,7 @@ class DatasetLabelEncoder:
     # -- fitting ----------------------------------------------------------
     def fit(self, dataset: Dataset) -> "DatasetLabelEncoder":
         self._encoding_rules = {}
+        self._columns_by_source = {}
         schema = dataset.feature_schema
         self._query_column_name = schema.query_id_column
         self._item_column_name = schema.item_id_column
@@ -73,6 +93,7 @@ class DatasetLabelEncoder:
                     fitted = True
                 else:
                     rule.partial_fit(frame)
+                self._columns_by_source.setdefault(source, []).append(feature.column)
             if fitted:
                 self._encoding_rules[feature.column] = rule
         return self
@@ -80,11 +101,18 @@ class DatasetLabelEncoder:
     def partial_fit(self, dataset: Dataset) -> "DatasetLabelEncoder":
         if not self._encoding_rules:
             return self.fit(dataset)
-        frames = [dataset.interactions, dataset.query_features, dataset.item_features]
+        frames = {
+            FeatureSource.INTERACTIONS: dataset.interactions,
+            FeatureSource.QUERY_FEATURES: dataset.query_features,
+            FeatureSource.ITEM_FEATURES: dataset.item_features,
+        }
         for column, rule in self._encoding_rules.items():
-            for frame in frames:
+            for source, frame in frames.items():
                 if frame is not None and column in frame.columns:
                     rule.partial_fit(frame)
+                    seen = self._columns_by_source.setdefault(source, [])
+                    if column not in seen:  # a frame source first seen here
+                        seen.append(column)
         return self
 
     # -- transforming -----------------------------------------------------

@@ -265,6 +265,14 @@ class TensorSchema(Mapping[str, TensorFeatureInfo]):
         return self.filter(feature_hint=FeatureHint.ITEM_ID)
 
     @property
+    def timestamp_features(self) -> "TensorSchema":
+        return self.filter(feature_hint=FeatureHint.TIMESTAMP)
+
+    @property
+    def rating_features(self) -> "TensorSchema":
+        return self.filter(feature_hint=FeatureHint.RATING)
+
+    @property
     def query_id_features(self) -> "TensorSchema":
         return self.filter(feature_hint=FeatureHint.QUERY_ID)
 

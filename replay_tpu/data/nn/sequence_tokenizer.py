@@ -57,6 +57,19 @@ class SequenceTokenizer:
     tensor_schema = property(lambda self: self._schema)
 
     @property
+    def interactions_encoder(self):
+        """Encoder over interaction-frame columns (ref sequence_tokenizer.py:130)."""
+        return self._encoder.interactions_encoder
+
+    @property
+    def query_features_encoder(self):
+        return self._encoder.query_features_encoder
+
+    @property
+    def item_features_encoder(self):
+        return self._encoder.item_features_encoder
+
+    @property
     def query_id_encoder(self):
         return self._encoder.query_id_encoder
 
@@ -210,6 +223,11 @@ class SequenceTokenizer:
         columns = {
             "query": getattr(self._encoder, "_query_column_name", None),
             "item": getattr(self._encoder, "_item_column_name", None),
+            # per-source column map backing the sub-encoder views
+            "by_source": {
+                source.name: cols
+                for source, cols in self._encoder._columns_by_source.items()
+            },
         }
         (target / "encoder_columns.json").write_text(json.dumps(columns))
 
@@ -240,6 +258,14 @@ class SequenceTokenizer:
         columns = json.loads((source / "encoder_columns.json").read_text())
         tokenizer._encoder._query_column_name = columns["query"]
         tokenizer._encoder._item_column_name = columns["item"]
+        from replay_tpu.data.schema import FeatureSource
+
+        tokenizer._encoder._columns_by_source = {
+            FeatureSource[name]: cols
+            # absent in artifacts saved before the per-source views existed:
+            # the views then report None rather than a wrong grouping
+            for name, cols in columns.get("by_source", {}).items()
+        }
         tokenizer._fitted = args["fitted"]
         return tokenizer
 

@@ -139,6 +139,15 @@ class TestSequenceTokenizer:
                 before.get_sequence(i, "item_id"), after.get_sequence(i, "item_id")
             )
         assert restored.item_id_encoder.mapping == tokenizer.item_id_encoder.mapping
+        # per-source sub-encoder views survive the artifact roundtrip
+        assert (
+            set(restored.item_features_encoder.mapping)
+            == set(tokenizer.item_features_encoder.mapping)
+        )
+        assert (
+            set(restored.interactions_encoder.mapping)
+            == set(tokenizer.interactions_encoder.mapping)
+        )
 
 
 class TestSequentialDataset:

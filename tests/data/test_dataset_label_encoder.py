@@ -59,3 +59,29 @@ def test_get_encoder(string_dataset):
     assert encoder.get_encoder(["nope"]) is None
     sub = encoder.get_encoder(["genre"])
     assert list(sub.mapping) == ["genre"]
+
+
+def test_per_source_encoders(string_dataset):
+    """Reference sub-encoder views (sequence_tokenizer.py:130-148): one encoder
+    per SOURCE frame; a column in several frames appears in each view."""
+    encoder = DatasetLabelEncoder().fit(string_dataset)
+    inter = encoder.interactions_encoder
+    assert set(inter.mapping) == {"user_id", "item_id"}
+    item = encoder.item_features_encoder
+    assert set(item.mapping) == {"item_id", "genre"}  # item_id rides both frames
+    assert encoder.query_features_encoder is None  # no query-features frame
+
+
+def test_per_source_encoders_survive_partial_fit(string_dataset):
+    """A source frame first seen in partial_fit joins the per-source views."""
+    interactions_only = Dataset(
+        feature_schema=string_dataset.feature_schema.copy(),
+        interactions=string_dataset.interactions,
+    )
+    encoder = DatasetLabelEncoder().fit(interactions_only)
+    assert encoder.item_features_encoder is None
+    encoder.partial_fit(string_dataset)  # now brings item_features
+    # partial_fit extends EXISTING rules only (genre was never fitted, so no
+    # rule appears for it), but item_id now registers its item-features source
+    assert set(encoder.item_features_encoder.mapping) == {"item_id"}
+    assert encoder.item_id_encoder.mapping["item_id"]["i4"] == 3
