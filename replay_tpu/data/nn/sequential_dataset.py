@@ -54,6 +54,11 @@ class SequentialDataset:
     def query_ids(self) -> np.ndarray:
         return self._sequences[self._query_id_column].to_numpy()
 
+    def get_all_query_ids(self) -> np.ndarray:
+        """Reference-name accessor for :attr:`query_ids`
+        (ref data/nn/sequential_dataset.py)."""
+        return self.query_ids
+
     def get_query_id(self, index: int):
         return self._sequences[self._query_id_column].iloc[index]
 

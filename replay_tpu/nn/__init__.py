@@ -19,6 +19,14 @@ from .mask import (
     padding_mask_from_ids,
 )
 from .postprocess import SeenItemsFilter
+from .vocabulary import (
+    append_item_embeddings,
+    get_item_embeddings,
+    resize_item_embeddings,
+    set_item_embeddings,
+    set_item_embeddings_by_size,
+    set_item_embeddings_by_tensor,
+)
 from .train import (
     LRSchedulerFactory,
     OptimizerFactory,
@@ -44,6 +52,12 @@ __all__ = [
     "PositionAwareAggregator",
     "RMSNorm",
     "SeenItemsFilter",
+    "append_item_embeddings",
+    "get_item_embeddings",
+    "resize_item_embeddings",
+    "set_item_embeddings",
+    "set_item_embeddings_by_size",
+    "set_item_embeddings_by_tensor",
     "SequenceEmbedding",
     "SumAggregator",
     "SwiGLU",

@@ -135,6 +135,37 @@ class Bert4Rec(nn.Module):
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
+    @classmethod
+    def from_params(
+        cls,
+        schema: TensorSchema,
+        embedding_dim: int = 192,
+        num_heads: int = 4,
+        num_blocks: int = 2,
+        max_sequence_length: int = 50,
+        dropout: float = 0.3,
+        excluded_features=None,
+        **kwargs,
+    ) -> "Bert4Rec":
+        """Keyword-compatible constructor matching the SasRec/TwoTower shape
+        (the reference's legacy bert4rec spells these block_count/head_count/
+        hidden_size — see docs/migration_from_replay.md)."""
+        excluded = {
+            name
+            for name in (schema.query_id_feature_name, schema.timestamp_feature_name)
+            if name is not None
+        } | set(excluded_features or [])
+        return cls(
+            schema=schema,
+            embedding_dim=embedding_dim,
+            num_heads=num_heads,
+            num_blocks=num_blocks,
+            max_sequence_length=max_sequence_length,
+            dropout_rate=dropout,
+            excluded_features=tuple(sorted(excluded)),
+            **kwargs,
+        )
+
     def setup(self) -> None:
         self.body = Bert4RecBody(
             schema=self.schema,

@@ -122,6 +122,37 @@ class SasRec(nn.Module):
     dtype: Any = jnp.float32
     embedding_init: Any = None  # e.g. embedding.xavier_normal_embed_init()
 
+    @classmethod
+    def from_params(
+        cls,
+        schema: TensorSchema,
+        embedding_dim: int = 192,
+        num_heads: int = 4,
+        num_blocks: int = 2,
+        max_sequence_length: int = 50,
+        dropout: float = 0.3,
+        excluded_features=None,
+        **kwargs,
+    ) -> "SasRec":
+        """The reference's keyword-compatible constructor (model.py:200):
+        query-id and timestamp features are excluded from embedding by default,
+        ``dropout`` maps to ``dropout_rate``."""
+        excluded = {
+            name
+            for name in (schema.query_id_feature_name, schema.timestamp_feature_name)
+            if name is not None
+        } | set(excluded_features or [])
+        return cls(
+            schema=schema,
+            embedding_dim=embedding_dim,
+            num_heads=num_heads,
+            num_blocks=num_blocks,
+            max_sequence_length=max_sequence_length,
+            dropout_rate=dropout,
+            excluded_features=tuple(sorted(excluded)),
+            **kwargs,
+        )
+
     def setup(self) -> None:
         self.body = SasRecBody(
             schema=self.schema,

@@ -63,6 +63,39 @@ class TwoTower(nn.Module):
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
+    @classmethod
+    def from_params(
+        cls,
+        schema: TensorSchema,
+        item_schema: Optional[TensorSchema] = None,
+        embedding_dim: int = 192,
+        num_heads: int = 4,
+        num_blocks: int = 2,
+        max_sequence_length: int = 50,
+        dropout: float = 0.3,
+        excluded_features=None,
+        **kwargs,
+    ) -> "TwoTower":
+        """The reference's keyword-compatible constructor (twotower/model.py:536).
+        The reference's ``item_features_reader`` becomes ``item_schema`` + call-time
+        ``item_feature_tensors`` (see FeaturesReader)."""
+        excluded = {
+            name
+            for name in (schema.query_id_feature_name, schema.timestamp_feature_name)
+            if name is not None
+        } | set(excluded_features or [])
+        return cls(
+            schema=schema,
+            item_schema=item_schema,
+            embedding_dim=embedding_dim,
+            num_heads=num_heads,
+            num_blocks=num_blocks,
+            max_sequence_length=max_sequence_length,
+            dropout_rate=dropout,
+            excluded_features=tuple(sorted(excluded)),
+            **kwargs,
+        )
+
     def setup(self) -> None:
         self.embedder = SequenceEmbedding(
             schema=self.schema,

@@ -328,8 +328,14 @@ class Trainer:
         self.history: List[Dict[str, float]] = []
 
     # -- state ------------------------------------------------------------- #
-    def init_state(self, example_batch: Batch) -> TrainState:
-        """Initialize parameters (replicated / vocab-sharded over the mesh)."""
+    def init_state(self, example_batch: Batch, params: Optional[Any] = None) -> TrainState:
+        """Initialize parameters (replicated / vocab-sharded over the mesh).
+
+        ``params`` seeds the state with EXISTING weights instead of a fresh
+        init — fresh optimizer moments, step 0. The post-vocabulary-surgery
+        path (replay_tpu.nn.vocab): the reference rebuilds its optimizer the
+        same way after ``set_item_embeddings_*``.
+        """
         rng = jax.random.PRNGKey(self.seed)
         init_rng, state_rng = jax.random.split(rng)
         kwargs = self._forward_kwargs(example_batch)
@@ -345,9 +351,10 @@ class Trainer:
                 module.get_logits(hidden, None, **logits_extra)
             return hidden
 
-        params = self.model.init({"params": init_rng, "dropout": init_rng}, method=init_fn)[
-            "params"
-        ]
+        if params is None:
+            params = self.model.init(
+                {"params": init_rng, "dropout": init_rng}, method=init_fn
+            )["params"]
         shardings = _params_shardings(self.mesh, params, self.shard_vocab)
         params = _place_tree(jax.tree.map(np.asarray, params), shardings)
         opt_state = self._tx.init(params)

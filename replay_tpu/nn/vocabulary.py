@@ -125,3 +125,51 @@ def set_item_embeddings(params, schema: TensorSchema, table: np.ndarray) -> dict
     """Replace the whole item table with ``[num_items, E]`` (ref
     set_item_embeddings_by_tensor)."""
     return resize_item_embeddings(params, schema, len(table), np.asarray(table))
+
+
+# reference-exact name (replay/models/nn/sequential/bert4rec/lightning.py:528)
+set_item_embeddings_by_tensor = set_item_embeddings
+
+
+def set_item_embeddings_by_size(
+    params,
+    schema: TensorSchema,
+    new_cardinality: int,
+    rng: Optional[jax.Array] = None,
+) -> dict:
+    """Grow to ``new_cardinality`` with xavier-normal rows for the NEW items —
+    the reference's expansion recipe (lightning.py:507-523: keep fitted rows,
+    ``xavier_normal_`` the rest). ``resize_item_embeddings`` with no tensor
+    gives mean-init instead; this wrapper matches the reference init.
+
+    The reference xaviers the FULL ``(new_cardinality + 1, dim)`` table and
+    copies the fitted rows back over it, so the new rows' std derives from the
+    whole table's fan — reproduced here by drawing the slice at that std."""
+    feature_name = schema.item_id_feature_name
+    if feature_name is None:
+        msg = "Schema has no ITEM_ID feature."
+        raise ValueError(msg)
+    old_cardinality = schema[feature_name].cardinality
+    if new_cardinality <= old_cardinality:
+        msg = "New vocabulary size must be greater than already fitted"
+        raise ValueError(msg)
+    dim = np.asarray(
+        _find_table_path(params, feature_name)[0][1]
+    ).shape[1]
+    std = float(np.sqrt(2.0 / ((new_cardinality + 1) + dim)))
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+    fresh = np.asarray(
+        jax.random.normal(key, (new_cardinality - old_cardinality, dim), np.float32)
+    ) * std
+    return resize_item_embeddings(params, schema, new_cardinality, fresh)
+
+
+def get_item_embeddings(params, schema: TensorSchema) -> np.ndarray:
+    """The fitted item rows ``[cardinality, E]``, padding row excluded (the
+    reference's ``get_all_embeddings`` for the item table, lightning.py:501)."""
+    feature_name = schema.item_id_feature_name
+    if feature_name is None:
+        msg = "Schema has no ITEM_ID feature."
+        raise ValueError(msg)
+    table = np.asarray(_find_table_path(params, feature_name)[0][1])
+    return table[: schema[feature_name].cardinality]

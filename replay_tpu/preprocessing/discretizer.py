@@ -75,6 +75,21 @@ class BaseDiscretizingRule:
     def fit_transform(self, df: pd.DataFrame) -> pd.DataFrame:
         return self.fit(df).transform(df)
 
+    def partial_fit(self, df: pd.DataFrame) -> "BaseDiscretizingRule":
+        """Fit if unfitted; refitting bin edges incrementally is not supported
+        (the reference's exact contract, discretizer.py:241-252)."""
+        if self.bin_edges is None:
+            return self.fit(df)
+        msg = f"{type(self).__name__} is not implemented for partial_fit yet."
+        raise NotImplementedError(msg)
+
+    def set_handle_invalid(self, handle_invalid: str) -> None:
+        """Switch the NaN strategy post-construction (ref discretizer.py:294)."""
+        if handle_invalid not in HANDLE_INVALID:
+            msg = f"handle_invalid must be one of {HANDLE_INVALID}"
+            raise ValueError(msg)
+        self.handle_invalid = handle_invalid
+
     def _as_dict(self) -> dict:
         return {
             "_rule": type(self).__name__,
@@ -127,6 +142,16 @@ class Discretizer:
 
     def fit_transform(self, df: pd.DataFrame) -> pd.DataFrame:
         return self.fit(df).transform(df)
+
+    def partial_fit(self, df: pd.DataFrame) -> "Discretizer":
+        """Delegate to each rule's partial_fit (fit-if-unfitted contract)."""
+        for rule in self.rules:
+            rule.partial_fit(df)
+        return self
+
+    def set_handle_invalid(self, handle_invalid: str) -> None:
+        for rule in self.rules:
+            rule.set_handle_invalid(handle_invalid)
 
     def save(self, path: str) -> None:
         target = Path(path).with_suffix(".replay")

@@ -211,6 +211,23 @@ class LabelEncodingRule:
             default_value=data["default_value"],
         )
 
+    def save(self, path: str) -> None:
+        """One rule as a ``.replay`` artifact (ref label_encoder.py:508)."""
+        import json
+        from pathlib import Path
+
+        target = Path(path).with_suffix(".replay")
+        target.mkdir(parents=True, exist_ok=True)
+        (target / "init_args.json").write_text(json.dumps(self._as_dict()))
+
+    @classmethod
+    def load(cls, path: str) -> "LabelEncodingRule":
+        import json
+        from pathlib import Path
+
+        source = Path(path).with_suffix(".replay")
+        return cls._from_dict(json.loads((source / "init_args.json").read_text()))
+
 
 
 class SequenceEncodingRule(LabelEncodingRule):

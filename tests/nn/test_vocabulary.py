@@ -87,3 +87,80 @@ def test_trainer_resize_then_train():
          "padding_mask": np.ones((2, SEQ_LEN), bool)},
     )
     assert logits.shape == (2, new_items)
+
+def test_reference_named_wrappers_and_old_logits_identical():
+    """set_item_embeddings_by_size (xavier rows, ref lightning.py:507) and
+    get_item_embeddings: after growth, OLD-item logits are bit-identical —
+    inputs embed the same rows and the tied head's first columns are the
+    untouched fitted rows."""
+    from replay_tpu.nn.vocabulary import (
+        get_item_embeddings,
+        set_item_embeddings_by_size,
+        set_item_embeddings_by_tensor,
+    )
+
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1,
+                   max_sequence_length=SEQ_LEN)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, NUM_ITEMS, (3, SEQ_LEN)).astype(np.int32)
+    mask = np.ones((3, SEQ_LEN), bool)
+    params = model.init(jax.random.PRNGKey(0), {"item_id": ids}, mask)["params"]
+    params = jax.tree.map(np.asarray, params)
+    before = np.asarray(model.apply({"params": params}, {"item_id": ids}, mask,
+                                    method=SasRec.forward_inference))
+    fitted = get_item_embeddings(params, schema)
+    assert fitted.shape == (NUM_ITEMS, 8)
+
+    with pytest.raises(ValueError, match="greater"):
+        set_item_embeddings_by_size(params, schema, NUM_ITEMS)
+    grown = set_item_embeddings_by_size(params, schema, NUM_ITEMS + 5,
+                                        rng=jax.random.PRNGKey(7))
+    grown_model = SasRec(schema=schema, embedding_dim=8, num_blocks=1,
+                         max_sequence_length=SEQ_LEN)
+    after = np.asarray(grown_model.apply({"params": grown}, {"item_id": ids}, mask,
+                                         method=SasRec.forward_inference))
+    assert after.shape == (3, NUM_ITEMS + 5)
+    np.testing.assert_array_equal(after[:, :NUM_ITEMS], before)
+    new_rows = get_item_embeddings(grown, schema)[NUM_ITEMS:]
+    assert np.abs(new_rows).max() > 0  # xavier, not zeros
+    assert not np.allclose(new_rows, fitted.mean(0))  # NOT the mean-init path
+
+    replacement = np.full((NUM_ITEMS + 5, 8), 2.0, np.float32)
+    replaced = set_item_embeddings_by_tensor(grown, schema, replacement)
+    np.testing.assert_array_equal(get_item_embeddings(replaced, schema), replacement)
+
+
+def test_bert4rec_surgery_and_warm_start_state():
+    """Surgery works on Bert4Rec too, and Trainer.init_state(params=...) seeds
+    a fresh optimizer around existing weights (the retrain-after-surgery flow
+    without Trainer.resize_vocabulary)."""
+    from replay_tpu.nn.sequential.bert4rec import Bert4Rec
+    from replay_tpu.nn.vocabulary import get_item_embeddings, set_item_embeddings_by_size
+
+    schema = make_schema()
+    model = Bert4Rec(schema=schema, embedding_dim=8, num_blocks=1, num_heads=2,
+                     max_sequence_length=SEQ_LEN)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"item_id": np.zeros((2, SEQ_LEN), np.int32)},
+                        np.ones((2, SEQ_LEN), bool))["params"]
+    params = jax.tree.map(np.asarray, params)
+    grown = set_item_embeddings_by_size(params, schema, NUM_ITEMS + 2)
+    assert get_item_embeddings(grown, schema).shape == (NUM_ITEMS + 2, 8)
+
+    new_model = Bert4Rec(schema=schema, embedding_dim=8, num_blocks=1, num_heads=2,
+                         max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=new_model, loss=CE(),
+                      optimizer=OptimizerFactory(name="sgd", learning_rate=0.1))
+    rng = np.random.default_rng(1)
+    batch = make_batch(NUM_ITEMS + 2, rng)
+    state = trainer.init_state(batch, params=grown)
+    np.testing.assert_array_equal(
+        get_item_embeddings(jax.tree.map(np.asarray, state.params), schema),
+        get_item_embeddings(grown, schema),
+    )
+    losses = []
+    for _ in range(6):
+        state, loss_value = trainer.train_step(state, batch)
+        losses.append(float(loss_value))
+    assert losses[-1] < losses[0]

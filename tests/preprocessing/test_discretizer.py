@@ -1,0 +1,40 @@
+"""Discretizer rule contracts: partial_fit, handle_invalid switching, rule serde."""
+
+import pandas as pd
+import pytest
+
+from replay_tpu.preprocessing import Discretizer, QuantileDiscretizingRule
+
+
+
+def test_rule_partial_fit_and_handle_invalid_switch():
+    """Reference contract (discretizer.py:241-303): partial_fit == fit when
+    unfitted, NotImplementedError after; set_handle_invalid validates."""
+    import numpy as np
+
+    rule = QuantileDiscretizingRule("x", n_bins=2)
+    df = pd.DataFrame({"x": [1.0, 2.0, 3.0, 4.0]})
+    rule.partial_fit(df)  # fit path
+    assert rule.bin_edges is not None
+    with pytest.raises(NotImplementedError):
+        rule.partial_fit(df)
+    rule.set_handle_invalid("keep")
+    assert rule.handle_invalid == "keep"
+    with pytest.raises(ValueError, match="handle_invalid"):
+        rule.set_handle_invalid("explode")
+    disc = Discretizer([QuantileDiscretizingRule("x", n_bins=2)])
+    disc.partial_fit(df)
+    disc.set_handle_invalid("skip")
+    out = disc.transform(pd.DataFrame({"x": [1.0, np.nan]}))
+    assert np.isnan(out["x"].iloc[1])
+
+
+def test_rule_save_load_roundtrip(tmp_path):
+    from replay_tpu.preprocessing import LabelEncodingRule
+
+    rule = LabelEncodingRule("item_id").fit(pd.DataFrame({"item_id": ["b", "a"]}))
+    rule.save(str(tmp_path / "rule"))
+    restored = LabelEncodingRule.load(str(tmp_path / "rule"))
+    assert restored.get_mapping() == rule.get_mapping()
+    out = restored.transform(pd.DataFrame({"item_id": ["a", "b"]}))
+    assert out["item_id"].tolist() == [1, 0]
