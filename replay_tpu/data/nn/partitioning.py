@@ -27,6 +27,11 @@ class ReplicasInfo:
     num_replicas: int = 1
     replica_id: int = 0
 
+    @property
+    def curr_replica(self) -> int:
+        """Reference-name accessor (parquet/info/replicas.py:14)."""
+        return self.replica_id
+
     def __post_init__(self) -> None:
         if not 0 <= self.replica_id < self.num_replicas:
             msg = f"replica_id {self.replica_id} out of range [0, {self.num_replicas})"
@@ -61,7 +66,9 @@ class Partitioning:
         if self.replicas is None:
             self.replicas = ReplicasInfo()
 
-    def generate(self, n: int, epoch: int = 0) -> np.ndarray:
+    def generate_raw_indices(self, n: int, epoch: int = 0) -> np.ndarray:
+        """The padded (and optionally shuffled) GLOBAL index order — phase one
+        of the reference's two-step API (parquet/info/partitioning.py:87)."""
         if n == 0:
             return np.zeros(0, dtype=np.int64)
         num = self.replicas.num_replicas
@@ -70,4 +77,12 @@ class Partitioning:
         if self.shuffle:
             rng = np.random.default_rng((self.seed, epoch))
             indices = indices[rng.permutation(padded_len)]
-        return indices[self.replicas.replica_id :: num]
+        return indices
+
+    def replica_indices(self, raw_indices: np.ndarray) -> np.ndarray:
+        """THIS replica's strided slice of a raw global order — phase two
+        (parquet/info/partitioning.py:102)."""
+        return raw_indices[self.replicas.replica_id :: self.replicas.num_replicas]
+
+    def generate(self, n: int, epoch: int = 0) -> np.ndarray:
+        return self.replica_indices(self.generate_raw_indices(n, epoch))

@@ -27,24 +27,68 @@ class _BinaryRatingBandit(BaseRecommender):
     def __init__(self) -> None:
         super().__init__()
         self.item_popularity: Optional[pd.DataFrame] = None
+        self._stats: Optional[pd.DataFrame] = None
+        self._total_trials: float = 0.0
 
-    def _fit(self, dataset: Dataset) -> None:
+    def _validated_interactions(self, dataset: Dataset) -> pd.DataFrame:
         interactions = dataset.interactions
         if self.rating_column is None:
             msg = f"{type(self).__name__} needs a RATING column with 0/1 values."
             raise ValueError(msg)
-        ratings = interactions[self.rating_column]
-        if not ratings.isin([0, 1]).all():
+        if not interactions[self.rating_column].isin([0, 1]).all():
             msg = f"{type(self).__name__} requires binary ratings (0 or 1)."
             raise ValueError(msg)
+        return interactions
+
+    def _count_stats(self, interactions: pd.DataFrame) -> pd.DataFrame:
         grouped = interactions.groupby(self.item_column)[self.rating_column]
-        stats = grouped.agg(successes="sum", trials="count").reset_index()
-        stats["rating"] = self._arm_scores(
+        return grouped.agg(successes="sum", trials="count").reset_index()
+
+    def _rescore(self) -> None:
+        stats = self._stats
+        rating = self._arm_scores(
             stats["successes"].to_numpy(np.float64),
             stats["trials"].to_numpy(np.float64),
-            float(len(interactions)),
+            self._total_trials,
         )
-        self.item_popularity = stats[[self.item_column, "rating"]]
+        self.item_popularity = stats.assign(rating=rating)[[self.item_column, "rating"]]
+
+    def _fit(self, dataset: Dataset) -> None:
+        interactions = self._validated_interactions(dataset)
+        self._stats = self._count_stats(interactions)
+        self._total_trials = float(len(interactions))
+        self._rescore()
+
+    def refit(self, dataset: Dataset) -> "_BinaryRatingBandit":
+        """Iterative update with a NEW slice of interactions: per-arm counters
+        accumulate and every score recomputes (ref ucb.py:147-186, extended to
+        the whole binary-bandit family)."""
+        if self.item_popularity is None:
+            return self.fit(dataset)
+        if self._stats is None:
+            msg = (
+                "Arm counters unavailable (artifact saved before refit support); "
+                "refit needs a model fitted in this session or saved with "
+                "arm_stats.parquet — use fit() on the full log instead."
+            )
+            raise RuntimeError(msg)
+        interactions = self._validated_interactions(dataset)
+        fresh = self._count_stats(interactions)
+        merged = (
+            self._stats.set_index(self.item_column)
+            .add(fresh.set_index(self.item_column), fill_value=0)
+            .reset_index()
+        )
+        self._stats = merged
+        self._total_trials += float(len(interactions))
+        self.fit_items = np.sort(
+            np.union1d(self.fit_items, interactions[self.item_column].unique())
+        )
+        self.fit_queries = np.sort(
+            np.union1d(self.fit_queries, interactions[self.query_column].unique())
+        )
+        self._rescore()
+        return self
 
     def _arm_scores(
         self, successes: np.ndarray, trials: np.ndarray, total_trials: float
@@ -58,9 +102,18 @@ class _BinaryRatingBandit(BaseRecommender):
 
     def _save_model(self, target: Path) -> None:
         self.item_popularity.to_parquet(target / "item_popularity.parquet")
+        if self._stats is not None:  # per-arm counters keep refit possible
+            self._stats.assign(__total=self._total_trials).to_parquet(
+                target / "arm_stats.parquet"
+            )
 
     def _load_model(self, source: Path) -> None:
         self.item_popularity = pd.read_parquet(source / "item_popularity.parquet")
+        stats_path = source / "arm_stats.parquet"
+        if stats_path.exists():
+            stats = pd.read_parquet(stats_path)
+            self._total_trials = float(stats["__total"].iloc[0])
+            self._stats = stats.drop(columns="__total")
 
 
 class Wilson(_BinaryRatingBandit):

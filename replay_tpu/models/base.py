@@ -63,6 +63,18 @@ class BaseRecommender(OptimizeMixin):
             msg = f"{type(self).__name__} is not fitted; call fit() first."
             raise RuntimeError(msg)
 
+    @property
+    def queries_count(self) -> int:
+        """Number of queries the model was trained on (ref base_rec.py:444)."""
+        self._check_fitted()
+        return len(self.fit_queries)
+
+    @property
+    def items_count(self) -> int:
+        """Number of items the model was trained on (ref base_rec.py:451)."""
+        self._check_fitted()
+        return len(self.fit_items)
+
     # -- predict ------------------------------------------------------------ #
     def predict(
         self,

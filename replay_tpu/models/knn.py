@@ -219,3 +219,8 @@ class AssociationRulesItemRec(ItemKNN):
             threshold = np.partition(sim, -self.num_neighbours, axis=0)[-self.num_neighbours]
             sim = np.where(sim >= threshold[None, :], sim, 0.0)
         self.similarity = sim.astype(np.float32)
+
+    def get_similarity(self) -> np.ndarray:
+        """The fitted rule-measure matrix (ref association_rules.py:292)."""
+        self._check_fitted()
+        return self.similarity

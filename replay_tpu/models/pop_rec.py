@@ -126,6 +126,8 @@ class CatPopRec(BaseRecommender):
         self.category_column = category_column
         self.category_popularity: Optional[pd.DataFrame] = None
         self.item_popularity: Optional[pd.DataFrame] = None
+        self._cat_counts: Optional[pd.DataFrame] = None
+        self.leaf_cat_mapping: Optional[dict] = None
 
     def _fit(self, dataset: Dataset) -> None:
         interactions = dataset.interactions
@@ -140,17 +142,76 @@ class CatPopRec(BaseRecommender):
         totals = merged.groupby(self.category_column)["__count"].transform("sum")
         merged["rating"] = merged["__count"] / totals
         self.category_popularity = merged.drop(columns="__count")
+        self._cat_counts = merged[[self.item_column, self.category_column, "__count"]]
         global_totals = counts["__count"].sum()
         self.item_popularity = counts.assign(rating=counts["__count"] / global_totals).drop(
             columns="__count"
         )
 
+    def set_cat_tree(self, cat_tree: pd.DataFrame) -> None:
+        """Set/update the category tree (ref cat_pop_rec.py:85-93): a frame with
+        ``[category, parent_cat]`` columns, one parent per category. Afterwards a
+        requested category also recommends its whole subtree's items."""
+        children: dict = {}
+        for _, row in cat_tree.iterrows():
+            children.setdefault(row["parent_cat"], []).append(row["category"])
+
+        def subtree(category):
+            # the node ITSELF is included: items may attach to internal
+            # categories, not only leaves
+            out, stack, visited = [], [category], set()
+            while stack:
+                node = stack.pop()
+                if node in visited:
+                    msg = f"cat_tree contains a cycle through {node!r}"
+                    raise ValueError(msg)
+                visited.add(node)
+                out.append(node)
+                stack.extend(children.get(node, ()))
+            return out
+
+        every_cat = set(cat_tree["category"]) | set(cat_tree["parent_cat"])
+        self.leaf_cat_mapping = {cat: subtree(cat) for cat in every_cat}
+
     def predict_for_categories(self, categories, k: int) -> pd.DataFrame:
-        """Top-k items per requested category."""
+        """Top-k items per requested category (subtree-expanded when a category
+        tree was set; popularity re-normalized within the expanded pool)."""
         self._check_fitted()
-        pool = self.category_popularity[
-            self.category_popularity[self.category_column].isin(np.asarray(categories))
-        ]
+        requested = list(np.asarray(categories))
+        if self.leaf_cat_mapping is not None:
+            if self._cat_counts is None:
+                msg = (
+                    "Category counts unavailable (artifact saved before category-"
+                    "tree support); refit the model to use set_cat_tree expansion."
+                )
+                raise RuntimeError(msg)
+            expansion = pd.DataFrame(
+                [
+                    (req, node)
+                    for req in requested
+                    for node in self.leaf_cat_mapping.get(req, [req])
+                ],
+                columns=["__requested", self.category_column],
+            )
+            pool = expansion.merge(self._cat_counts, on=self.category_column, how="inner")
+            # an item may sit under several categories of one subtree: its
+            # support is the SUM of its counts there, dedup BEFORE normalizing
+            # so ratings carry full mass and sum to 1 per request
+            pool = (
+                pool.groupby(["__requested", self.item_column])["__count"]
+                .sum()
+                .reset_index()
+            )
+            totals = pool.groupby("__requested")["__count"].transform("sum")
+            pool = (
+                pool.assign(rating=pool["__count"] / totals)
+                .drop(columns="__count")
+                .rename(columns={"__requested": self.category_column})
+            )
+        else:
+            pool = self.category_popularity[
+                self.category_popularity[self.category_column].isin(requested)
+            ]
         ranked = pool.sort_values(
             [self.category_column, "rating"], ascending=[True, False], kind="stable"
         )
@@ -164,7 +225,12 @@ class CatPopRec(BaseRecommender):
     def _save_model(self, target: Path) -> None:
         self.category_popularity.to_parquet(target / "category_popularity.parquet")
         self.item_popularity.to_parquet(target / "item_popularity.parquet")
+        if self._cat_counts is not None:  # raw counts back the tree expansion
+            self._cat_counts.to_parquet(target / "cat_counts.parquet")
 
     def _load_model(self, source: Path) -> None:
         self.category_popularity = pd.read_parquet(source / "category_popularity.parquet")
         self.item_popularity = pd.read_parquet(source / "item_popularity.parquet")
+        counts_path = source / "cat_counts.parquet"
+        if counts_path.exists():
+            self._cat_counts = pd.read_parquet(counts_path)

@@ -221,3 +221,106 @@ def test_random_rec_deterministic_with_seed():
 def test_unfitted_predict_raises():
     with pytest.raises(RuntimeError, match="not fitted"):
         PopRec().predict(make_dataset(), k=1)
+
+def test_cat_pop_rec_category_tree():
+    """set_cat_tree (ref cat_pop_rec.py:85): a parent category recommends its
+    whole subtree's items with popularity re-normalized in the subtree."""
+    log = binary_log()
+    item_features = pd.DataFrame(
+        {"item_id": np.arange(NUM_ITEMS), "category": ["a", "a", "a", "a", "b", "b", "b", "b"]}
+    )
+    model = CatPopRec().fit(make_dataset(log, item_features))
+    model.set_cat_tree(pd.DataFrame({"category": ["a", "b"], "parent_cat": ["root", "root"]}))
+    per_root = model.predict_for_categories(["root"], k=NUM_ITEMS)
+    assert set(per_root["category"]) == {"root"}
+    # the root subtree covers BOTH leaf categories' items
+    assert set(per_root["item_id"]) == set(range(NUM_ITEMS))
+    # subtree ratings renormalize to 1 over the whole pool
+    assert per_root["rating"].sum() == pytest.approx(1.0)
+    # leaf requests still work and only return their own items
+    per_leaf = model.predict_for_categories(["a"], k=NUM_ITEMS)
+    assert set(per_leaf["item_id"]) <= {0, 1, 2, 3}
+
+
+def test_bandit_refit_matches_full_fit():
+    """refit (ref ucb.py:147): counters accumulate across slices — two-slice
+    refit == one-shot fit on the concatenated log, for the whole family."""
+    from replay_tpu.models import UCB, Wilson
+
+    rows = []
+    rng = np.random.default_rng(3)
+    for u in range(40):
+        for i in range(NUM_ITEMS):
+            rows.append((u, i, int(rng.random() < (i + 1) / (NUM_ITEMS + 1)), u * NUM_ITEMS + i))
+    log = pd.DataFrame(rows, columns=["query_id", "item_id", "rating", "timestamp"])
+    first, second = log.iloc[: len(log) // 2], log.iloc[len(log) // 2 :]
+
+    for cls in (UCB, Wilson):
+        incremental = cls().fit(make_dataset(first)).refit(make_dataset(second))
+        oneshot = cls().fit(make_dataset(log))
+        merged = incremental.item_popularity.merge(
+            oneshot.item_popularity, on="item_id", suffixes=("_inc", "_one")
+        )
+        np.testing.assert_allclose(merged["rating_inc"], merged["rating_one"], rtol=1e-12)
+        assert incremental.items_count == oneshot.items_count
+        assert incremental.queries_count == oneshot.queries_count
+
+
+def test_association_rules_get_similarity():
+    log = binary_log()
+    model = AssociationRulesItemRec().fit(make_dataset(log))
+    sim = model.get_similarity()
+    assert sim.shape == (model.items_count, model.items_count)
+
+
+def test_cat_pop_rec_tree_internal_nodes_cycles_and_save(tmp_path):
+    """Items on INTERNAL categories stay reachable, cycles raise, and the
+    tree-expansion data survives save/load."""
+    from replay_tpu.utils import load, save
+
+    log = binary_log()
+    item_features = pd.DataFrame(
+        # item 7 attaches directly to the INTERNAL category "mid"
+        {"item_id": np.arange(NUM_ITEMS),
+         "category": ["a", "a", "a", "a", "b", "b", "b", "mid"]}
+    )
+    model = CatPopRec().fit(make_dataset(log, item_features))
+    tree = pd.DataFrame(
+        {"category": ["mid", "a", "b"], "parent_cat": ["root", "mid", "mid"]}
+    )
+    model.set_cat_tree(tree)
+    per_mid = model.predict_for_categories(["mid"], k=NUM_ITEMS)
+    assert 7 in set(per_mid["item_id"])  # the internal node's own item
+    assert set(per_mid["item_id"]) == set(range(NUM_ITEMS))
+    assert per_mid["rating"].sum() == pytest.approx(1.0)
+
+    with pytest.raises(ValueError, match="cycle"):
+        model.set_cat_tree(pd.DataFrame(
+            {"category": ["x", "y"], "parent_cat": ["y", "x"]}
+        ))
+
+    save(model, str(tmp_path / "catpop"))
+    loaded = load(str(tmp_path / "catpop"))
+    loaded.set_cat_tree(tree)
+    reloaded = loaded.predict_for_categories(["mid"], k=NUM_ITEMS)
+    pd.testing.assert_frame_equal(
+        reloaded.reset_index(drop=True), per_mid.reset_index(drop=True)
+    )
+
+
+def test_bandit_refit_after_save_load(tmp_path):
+    from replay_tpu.models import UCB
+    from replay_tpu.utils import load, save
+
+    log = binary_log()
+    model = UCB().fit(make_dataset(log))
+    save(model, str(tmp_path / "ucb"))
+    loaded = load(str(tmp_path / "ucb"))
+    refitted = loaded.refit(make_dataset(binary_log(seed=5)))
+    oneshot = UCB().fit(
+        make_dataset(pd.concat([binary_log(), binary_log(seed=5)], ignore_index=True))
+    )
+    merged = refitted.item_popularity.merge(
+        oneshot.item_popularity, on="item_id", suffixes=("_inc", "_one")
+    )
+    np.testing.assert_allclose(merged["rating_inc"], merged["rating_one"], rtol=1e-12)
