@@ -21,6 +21,8 @@ produces the full measurement batch the round-4 verdict asked for:
   prefetch → chunked ``train_steps``: the production input path, measured
   end-to-end against the device-resident number (ref thread-tuning note,
   replay/data/nn/parquet/parquet_dataset.py:49-52).
+- ``attention_long``   — tiled flash kernel (ops/flash_tiled.py) vs XLA full
+  attention at L=4096, fwd+bwd: the single-chip long-context A/B.
 
 Usage (default env, i.e. the TPU tunnel):
     python bench_suite.py [--rows row1,row2] [--quick] [--out BENCH_SUITE.json]
@@ -246,6 +248,54 @@ def run_twotower(num_items, dim, batch, seq_len, dtype):
                                    "B512 vs the notebook's CPU-host B32)"})
 
 
+def run_attention_long(length, quick):
+    """Tiled flash kernel vs XLA full attention at long L, fwd+bwd — the
+    single-chip long-context A/B (ops/flash_tiled.py; the single-block kernel
+    OOMs here, BENCH_NOTES round-3)."""
+    import jax
+    import jax.numpy as jnp
+
+    from replay_tpu.ops.flash_tiled import flash_attention_tiled, padding_mask_bias
+
+    on_cpu = jax.default_backend() == "cpu"
+    batch, heads, dim = (1, 1, 8) if quick else (4, 4, 64)
+    block = 16 if quick else 512
+    rng = np.random.default_rng(0)
+    shape = (batch, heads, length, dim)
+    q = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    mask = jnp.ones((batch, length), bool)
+    bias = padding_mask_bias(mask)
+
+    def xla_loss(q):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(dim)
+        tri = jnp.tril(jnp.ones((length, length), bool))
+        s = jnp.where(tri[None, None], s, -1e30)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), q) ** 2)
+
+    def tiled_loss(q):
+        return jnp.sum(
+            flash_attention_tiled(q, q, q, bias, True, block, block, on_cpu) ** 2
+        )
+
+    record = {"row": "attention_long", "B": batch, "H": heads, "L": length, "D": dim,
+              "block": block, "backend": jax.default_backend(),
+              "device_kind": jax.devices()[0].device_kind}
+    for name, fn in (("xla_full", xla_loss), ("flash_tiled", tiled_loss)):
+        try:
+            grad = jax.jit(jax.grad(fn))
+            out = grad(q)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            reps = 2 if quick else 10
+            for _ in range(reps):
+                out = grad(q)
+            jax.block_until_ready(out)
+            record[f"{name}_ms"] = round((time.perf_counter() - t0) / reps * 1000, 2)
+        except Exception as exc:  # XLA full attention MAY OOM at long L: a result
+            record[f"{name}_error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
+    return record
+
+
 def run_pipeline_e2e(num_items, dim, batch, seq_len, quick, dtype):
     """parquet → ParquetBatcher → transforms → prefetch → chunked train_steps."""
     import jax
@@ -357,6 +407,7 @@ def main():
         "bert4rec": lambda: run_bert4rec(27278 if not q else 96, 300 if not q else 16, B, 100 if not q else L, 4 if not q else 2, dtype),
         "twotower": lambda: run_twotower(27278 if not q else 96, 64 if not q else 16, B, L, dtype),
         "pipeline_e2e": lambda: run_pipeline_e2e(3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
+        "attention_long": lambda: run_attention_long(4096 if not q else 32, q),
     }
     selected = list(rows) if args.rows == "all" else args.rows.split(",")
     unknown = [name for name in selected if name not in rows]

@@ -23,9 +23,31 @@ def dot_product_attention(
     q: jnp.ndarray,  # [B, H, L, D]
     k: jnp.ndarray,
     v: jnp.ndarray,
-    mask: jnp.ndarray,  # additive [B, 1, L, L]
-    use_flash: bool = False,
+    mask: jnp.ndarray,  # additive [B, 1, L, L]; None on the "tiled" route
+    use_flash=False,  # False | True (single-block kernel) | "tiled" (long L)
+    padding_mask: jnp.ndarray = None,  # [B, L] bool, required for "tiled"
+    causal: bool = True,
 ) -> jnp.ndarray:
+    if use_flash == "tiled":
+        # length-tiled kernel: O(L·block) memory, mask computed in-kernel from
+        # (causal, padding) — callers skip building the [B, 1, L, L] tensor
+        from replay_tpu.ops.flash_tiled import flash_attention_tiled, padding_mask_bias
+        from replay_tpu.ops.flash_attention import fused_attention_available
+
+        if padding_mask is None:
+            msg = "use_flash='tiled' needs the [B, L] padding_mask"
+            raise ValueError(msg)
+        if mask is not None:
+            # the tiled kernel reconstructs attention structure from (causal,
+            # padding) alone; accepting a custom additive mask here would
+            # silently drop whatever else it encodes (e.g. TiSASRec's
+            # interval bias)
+            msg = "use_flash='tiled' cannot honor an additive mask; pass mask=None"
+            raise ValueError(msg)
+        return flash_attention_tiled(
+            q, k, v, padding_mask_bias(padding_mask), causal,
+            interpret=not fused_attention_available(),
+        ).astype(q.dtype)
     if use_flash:
         # pallas fused kernel: no [B, H, L, L] HBM materialization
         from replay_tpu.ops.flash_attention import flash_attention, fused_attention_available
@@ -42,17 +64,25 @@ def dot_product_attention(
 class MultiHeadAttention(nn.Module):
     """Standard multi-head self-attention with an additive mask.
 
-    ``use_flash=True`` routes through the pallas fused kernel
-    (replay_tpu.ops.flash_attention) — pick it on TPU for long sequences."""
+    ``use_flash=True`` routes through the single-block pallas kernel
+    (replay_tpu.ops.flash_attention, L up to ~1024); ``use_flash="tiled"``
+    through the length-tiled kernel (replay_tpu.ops.flash_tiled) — the long-L
+    path, which never materializes anything O(L²) and therefore takes the raw
+    ``padding_mask`` + ``causal`` flag instead of ``mask``."""
 
     num_heads: int
     dropout_rate: float = 0.0
-    use_flash: bool = False
+    use_flash: Any = False  # False | True | "tiled"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(
-        self, x: jnp.ndarray, mask: jnp.ndarray, deterministic: bool = True
+        self,
+        x: jnp.ndarray,
+        mask: jnp.ndarray,
+        deterministic: bool = True,
+        padding_mask: jnp.ndarray = None,
+        causal: bool = True,
     ) -> jnp.ndarray:
         dim = x.shape[-1]
         if dim % self.num_heads:
@@ -65,7 +95,10 @@ class MultiHeadAttention(nn.Module):
             return proj.reshape(*x.shape[:-1], self.num_heads, head_dim).swapaxes(-3, -2)
 
         q, k, v = split("query"), split("key"), split("value")
-        out = dot_product_attention(q, k, v, mask, use_flash=self.use_flash)
+        out = dot_product_attention(
+            q, k, v, mask, use_flash=self.use_flash,
+            padding_mask=padding_mask, causal=causal,
+        )
         out = out.swapaxes(-3, -2).reshape(*x.shape[:-1], dim)
         out = nn.Dense(dim, dtype=self.dtype, name="out")(out)
         return nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)

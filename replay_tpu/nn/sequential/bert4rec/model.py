@@ -47,7 +47,7 @@ class Bert4RecBody(nn.Module):
     activation: str = "gelu"
     num_passes_over_block: int = 1
     remat: bool = False
-    use_flash: bool = False
+    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -107,11 +107,17 @@ class Bert4RecBody(nn.Module):
             total.dtype
         )
         x = self.input_dropout(self.input_norm(x), deterministic=deterministic)
-        attention_mask = bidirectional_attention_mask(
-            padding_mask, deterministic=deterministic, dtype=self.dtype
-        )
+        if self.use_flash == "tiled":
+            attention_mask = None  # derived in-kernel: padding only, no causal
+        else:
+            attention_mask = bidirectional_attention_mask(
+                padding_mask, deterministic=deterministic, dtype=self.dtype
+            )
         for _ in range(self.num_passes_over_block):
-            x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
+            x = self.encoder(
+                x, attention_mask, padding_mask,
+                deterministic=deterministic, causal=False,
+            )
         return self.final_norm(x)
 
 
@@ -131,7 +137,7 @@ class Bert4Rec(nn.Module):
     activation: str = "gelu"
     num_passes_over_block: int = 1
     remat: bool = False
-    use_flash: bool = False
+    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 

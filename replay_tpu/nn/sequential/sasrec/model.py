@@ -43,7 +43,7 @@ class SasRecBody(nn.Module):
     activation: str = "relu"  # reference SASRec construction pins relu (model.py:246)
     encoder_type: str = "sasrec"
     remat: bool = False
-    use_flash: bool = False
+    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
     embedding_init: Any = None  # e.g. embedding.xavier_normal_embed_init()
@@ -69,6 +69,14 @@ class SasRecBody(nn.Module):
         if encoder_cls is None:
             msg = f"Unknown encoder_type: {self.encoder_type}"
             raise ValueError(msg)
+        if self.use_flash == "tiled" and self.encoder_type != "sasrec":
+            # silently running full attention here would defeat the exact
+            # long-L regime the tiled route exists for
+            msg = (
+                f"use_flash='tiled' supports encoder_type='sasrec' only; "
+                f"'{self.encoder_type}' would fall back to O(L^2) attention"
+            )
+            raise ValueError(msg)
         encoder_kwargs = (
             {"remat": self.remat, "use_flash": self.use_flash, "activation": self.activation}
             if self.encoder_type == "sasrec"
@@ -93,9 +101,14 @@ class SasRecBody(nn.Module):
     ) -> jnp.ndarray:
         embeddings = self.embedder(feature_tensors)
         x = self.aggregator(embeddings, deterministic=deterministic)
-        attention_mask = causal_attention_mask(
-            padding_mask, deterministic=deterministic, dtype=self.dtype
-        )
+        if self.use_flash == "tiled" and self.encoder_type == "sasrec":
+            # long-L route: the kernel derives causal+padding in-kernel, so the
+            # [B, 1, L, L] mask tensor is never materialized
+            attention_mask = None
+        else:
+            attention_mask = causal_attention_mask(
+                padding_mask, deterministic=deterministic, dtype=self.dtype
+            )
         x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
         return self.final_norm(x)
 
@@ -117,7 +130,7 @@ class SasRec(nn.Module):
     activation: str = "relu"  # reference SASRec construction pins relu (model.py:246)
     encoder_type: str = "sasrec"
     remat: bool = False
-    use_flash: bool = False
+    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
     embedding_init: Any = None  # e.g. embedding.xavier_normal_embed_init()

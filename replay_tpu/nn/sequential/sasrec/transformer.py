@@ -24,11 +24,14 @@ class _SasRecBlock(nn.Module):
     hidden_dim: int
     dropout_rate: float = 0.0
     activation: str = "gelu"
-    use_flash: bool = False
+    use_flash: Any = False  # False | True | "tiled"
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, attention_mask, keep, deterministic: bool = True):
+    def __call__(
+        self, x, attention_mask, keep, deterministic: bool = True,
+        padding_mask=None, causal: bool = True,
+    ):
         h = nn.LayerNorm(dtype=self.dtype, name="attn_norm")(x)
         h = MultiHeadAttention(
             num_heads=self.num_heads,
@@ -36,7 +39,8 @@ class _SasRecBlock(nn.Module):
             use_flash=self.use_flash,
             dtype=self.dtype,
             name="attention",
-        )(h, attention_mask, deterministic=deterministic)
+        )(h, attention_mask, deterministic=deterministic,
+          padding_mask=padding_mask, causal=causal)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ffn_norm")(x)
         x = PointWiseFeedForward(
@@ -62,20 +66,23 @@ class SasRecTransformerLayer(nn.Module):
     dropout_rate: float = 0.0
     activation: str = "gelu"
     remat: bool = False
-    use_flash: bool = False
+    use_flash: Any = False  # False | True | "tiled"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(
         self,
         x: jnp.ndarray,
-        attention_mask: jnp.ndarray,
+        attention_mask: jnp.ndarray,  # None on the "tiled" route
         padding_mask: jnp.ndarray,
         deterministic: bool = True,
+        causal: bool = True,
     ) -> jnp.ndarray:
         keep = padding_mask[..., None].astype(x.dtype)
+        tiled = self.use_flash == "tiled"
         block_cls = (
-            nn.remat(_SasRecBlock, static_argnums=(4,)) if self.remat else _SasRecBlock
+            # deterministic and causal are python-level flags
+            nn.remat(_SasRecBlock, static_argnums=(4, 6)) if self.remat else _SasRecBlock
         )
         for i in range(self.num_blocks):
             x = block_cls(
@@ -86,7 +93,8 @@ class SasRecTransformerLayer(nn.Module):
                 use_flash=self.use_flash,
                 dtype=self.dtype,
                 name=f"block_{i}",
-            )(x, attention_mask, keep, deterministic)
+            )(x, attention_mask, keep, deterministic,
+              padding_mask if tiled else None, causal)
         return x
 
 
