@@ -23,6 +23,8 @@ produces the full measurement batch the round-4 verdict asked for:
   replay/data/nn/parquet/parquet_dataset.py:49-52).
 - ``attention_long``   — tiled flash kernel (ops/flash_tiled.py) vs XLA full
   attention at L=4096, fwd+bwd: the single-chip long-context A/B.
+- ``sasrec_l1024`` / ``sasrec_l1024_tiled`` — the full MODEL at L=1024
+  (fused-CE head): default attention vs use_flash='tiled' end-to-end.
 
 Usage (default env, i.e. the TPU tunnel):
     python bench_suite.py [--rows row1,row2] [--quick] [--out BENCH_SUITE.json]
@@ -248,6 +250,32 @@ def run_twotower(num_items, dim, batch, seq_len, dtype):
                                    "B512 vs the notebook's CPU-host B32)"})
 
 
+def run_sasrec_longseq(length, dim, batch, fused, tiled, label, dtype, quick):
+    """SASRec at long L — the regime the reference cannot reach on one device
+    (its torch attention materializes [B, H, L, L]). A/B: default attention vs
+    use_flash='tiled', with CEFused keeping the head off the critical path."""
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE, CEFused
+    from replay_tpu.nn.sequential.sasrec import SasRec
+
+    num_items = 64 if quick else 3706
+    model = SasRec(
+        schema=item_schema(num_items, dim), embedding_dim=dim, num_blocks=2,
+        num_heads=2, max_sequence_length=length, dropout_rate=0.0, dtype=dtype,
+        use_flash="tiled" if tiled else False,
+    )
+    trainer = Trainer(
+        model=model, loss=CEFused() if fused else CE(),
+        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3), mesh=make_mesh(),
+    )
+    return measure(
+        trainer, sasrec_batch(num_items, batch, length), label, scan_k=4,
+        meta={"num_items": num_items, "d": dim, "B": batch, "L": length,
+              "attention": "flash_tiled" if tiled else "xla_full",
+              "loss": "CEFused" if fused else "CE"},
+    )
+
+
 def run_attention_long(length, quick):
     """Tiled flash kernel vs XLA full attention at long L, fwd+bwd — the
     single-chip long-context A/B (ops/flash_tiled.py; the single-block kernel
@@ -408,6 +436,8 @@ def main():
         "twotower": lambda: run_twotower(27278 if not q else 96, 64 if not q else 16, B, L, dtype),
         "pipeline_e2e": lambda: run_pipeline_e2e(3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
         "attention_long": lambda: run_attention_long(4096 if not q else 32, q),
+        "sasrec_l1024": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, False, "sasrec_l1024", dtype, q),
+        "sasrec_l1024_tiled": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, True, "sasrec_l1024_tiled", dtype, q),
     }
     selected = list(rows) if args.rows == "all" else args.rows.split(",")
     unknown = [name for name in selected if name not in rows]
