@@ -164,3 +164,31 @@ def test_metrics_state_psums_across_devices():
     assert set(got) == set(want)
     for key in want:
         assert got[key] == pytest.approx(want[key], rel=1e-5), key
+
+
+@pytest.mark.jax
+def test_fused_ce_composes_with_vocab_sharding():
+    """CEFused (pallas head, interpret off-TPU) + shard_vocab on a (4, 2) mesh
+    == plain CE data-parallel — the exact composition the large-catalog TPU
+    configs run (bench_suite sasrec_100k_fused)."""
+    from replay_tpu.nn.loss import CEFused
+
+    def losses_for(loss, model_parallel, shard_vocab):
+        model = SasRec(schema=make_schema(), embedding_dim=16, num_blocks=1,
+                       max_sequence_length=SEQ_LEN)
+        trainer = Trainer(
+            model=model, loss=loss,
+            optimizer=OptimizerFactory(name="sgd", learning_rate=0.1),
+            mesh=make_mesh(jax.devices(), model_parallel=model_parallel),
+            shard_vocab=shard_vocab, seed=0,
+        )
+        state = trainer.init_state(make_train_batch(0))
+        out = []
+        for step in range(3):
+            state, loss_value = trainer.train_step(state, make_train_batch(step))
+            out.append(float(loss_value))
+        return out
+
+    plain = losses_for(CE(), 1, False)
+    fused_sharded = losses_for(CEFused(), 2, True)
+    np.testing.assert_allclose(plain, fused_sharded, rtol=2e-4)
