@@ -67,3 +67,25 @@ class DefaultAttentionMask:
         return causal_attention_mask(
             padding_mask_from_ids(ids, self.padding_value), deterministic=deterministic, dtype=dtype
         )
+
+
+def attention_mask_for_route(
+    use_flash,
+    padding_mask: jnp.ndarray,
+    causal: bool = True,
+    deterministic: bool = False,
+    dtype=jnp.float32,
+):
+    """The additive mask a model body should hand its encoder, route-aware.
+
+    On the ``use_flash == "tiled"`` route the kernel reconstructs causal +
+    key-padding structure in-kernel, so the ``[B, 1, L, L]`` tensor must NOT be
+    built (that allocation is the thing the route eliminates) — returns None.
+    Every other route gets the standard causal or bidirectional additive mask.
+    One source of truth for the conditional shared by SasRec / Bert4Rec /
+    TwoTower bodies.
+    """
+    if use_flash == "tiled":
+        return None
+    builder = causal_attention_mask if causal else bidirectional_attention_mask
+    return builder(padding_mask, deterministic=deterministic, dtype=dtype)

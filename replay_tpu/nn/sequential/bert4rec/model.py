@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from replay_tpu.data.nn.schema import TensorMap, TensorSchema
 from replay_tpu.nn.embedding import SequenceEmbedding
 from replay_tpu.nn.head import EmbeddingTyingHead
-from replay_tpu.nn.mask import bidirectional_attention_mask
+from replay_tpu.nn.mask import attention_mask_for_route
 
 from ..sasrec.transformer import SasRecTransformerLayer
 
@@ -107,12 +107,10 @@ class Bert4RecBody(nn.Module):
             total.dtype
         )
         x = self.input_dropout(self.input_norm(x), deterministic=deterministic)
-        if self.use_flash == "tiled":
-            attention_mask = None  # derived in-kernel: padding only, no causal
-        else:
-            attention_mask = bidirectional_attention_mask(
-                padding_mask, deterministic=deterministic, dtype=self.dtype
-            )
+        attention_mask = attention_mask_for_route(
+            self.use_flash, padding_mask, causal=False,
+            deterministic=deterministic, dtype=self.dtype,
+        )
         for _ in range(self.num_passes_over_block):
             x = self.encoder(
                 x, attention_mask, padding_mask,

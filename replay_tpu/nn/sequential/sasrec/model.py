@@ -25,7 +25,7 @@ from replay_tpu.data.nn.schema import TensorMap, TensorSchema
 from replay_tpu.nn.agg import PositionAwareAggregator
 from replay_tpu.nn.embedding import SequenceEmbedding
 from replay_tpu.nn.head import EmbeddingTyingHead
-from replay_tpu.nn.mask import causal_attention_mask
+from replay_tpu.nn.mask import attention_mask_for_route
 
 from .transformer import DiffTransformerLayer, SasRecTransformerLayer
 
@@ -101,14 +101,10 @@ class SasRecBody(nn.Module):
     ) -> jnp.ndarray:
         embeddings = self.embedder(feature_tensors)
         x = self.aggregator(embeddings, deterministic=deterministic)
-        if self.use_flash == "tiled" and self.encoder_type == "sasrec":
-            # long-L route: the kernel derives causal+padding in-kernel, so the
-            # [B, 1, L, L] mask tensor is never materialized
-            attention_mask = None
-        else:
-            attention_mask = causal_attention_mask(
-                padding_mask, deterministic=deterministic, dtype=self.dtype
-            )
+        attention_mask = attention_mask_for_route(
+            self.use_flash, padding_mask, causal=True,
+            deterministic=deterministic, dtype=self.dtype,
+        )
         x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
         return self.final_norm(x)
 

@@ -31,7 +31,7 @@ from replay_tpu.nn.agg import PositionAwareAggregator
 from replay_tpu.nn.embedding import SequenceEmbedding
 from replay_tpu.nn.ffn import SwiGLUEncoder
 from replay_tpu.nn.head import EmbeddingTyingHead
-from replay_tpu.nn.mask import causal_attention_mask
+from replay_tpu.nn.mask import attention_mask_for_route
 
 from ..sasrec.transformer import SasRecTransformerLayer
 
@@ -61,6 +61,7 @@ class TwoTower(nn.Module):
     dropout_rate: float = 0.0
     item_encoder_blocks: int = 1
     excluded_features: tuple = ()
+    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
     dtype: Any = jnp.float32
 
     @classmethod
@@ -115,6 +116,7 @@ class TwoTower(nn.Module):
             num_heads=self.num_heads,
             hidden_dim=self.hidden_dim or self.embedding_dim * 4,
             dropout_rate=self.dropout_rate,
+            use_flash=self.use_flash,
             dtype=self.dtype,
             name="encoder",
         )
@@ -143,8 +145,9 @@ class TwoTower(nn.Module):
         """Query hidden states [B, L, E]."""
         embeddings = self.embedder(feature_tensors)
         x = self.aggregator(embeddings, deterministic=deterministic)
-        attention_mask = causal_attention_mask(
-            padding_mask, deterministic=deterministic, dtype=self.dtype
+        attention_mask = attention_mask_for_route(
+            self.use_flash, padding_mask, causal=True,
+            deterministic=deterministic, dtype=self.dtype,
         )
         x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
         x = self.final_norm(x)

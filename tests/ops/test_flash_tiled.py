@@ -111,7 +111,7 @@ def test_long_sequence_runs_blockwise():
     )
 
 
-@pytest.mark.parametrize("model_kind", ["sasrec", "bert4rec"])
+@pytest.mark.parametrize("model_kind", ["sasrec", "bert4rec", "twotower"])
 def test_model_tiled_route_matches_default(model_kind):
     """use_flash='tiled' through the REAL model API (mask never materialized)
     equals the default path on real rows — the production long-L entry point."""
@@ -119,12 +119,13 @@ def test_model_tiled_route_matches_default(model_kind):
     from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
     from replay_tpu.nn.sequential.bert4rec import Bert4Rec
     from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.nn.sequential.twotower import TwoTower
 
     num_items, seq_len = 12, 10
     schema = TensorSchema(TensorFeatureInfo(
         "item_id", FeatureType.CATEGORICAL, is_seq=True,
         feature_hint=FeatureHint.ITEM_ID, cardinality=num_items, embedding_dim=8))
-    cls = SasRec if model_kind == "sasrec" else Bert4Rec
+    cls = {"sasrec": SasRec, "bert4rec": Bert4Rec, "twotower": TwoTower}[model_kind]
     kwargs = dict(schema=schema, embedding_dim=8, num_blocks=2, num_heads=2,
                   max_sequence_length=seq_len)
     plain = cls(**kwargs)
@@ -202,3 +203,4 @@ def test_tiled_misuse_guards():
     with pytest.raises(ValueError, match="additive mask"):
         dot_product_attention(q, q, q, jnp.zeros((1, 1, 4, 4)), use_flash="tiled",
                               padding_mask=jnp.ones((1, 4), bool))
+
