@@ -6,7 +6,18 @@ ML-1M-sized catalog) which sustains 11.07 it/s × 512 ≈ 5668 sequences/sec on 
 reference's CPU box. Prints ONE JSON line:
 
     {"metric": "sasrec_train_samples_per_sec", "value": ..., "unit": "samples/sec",
-     "vs_baseline": ..., "backend": "tpu", "mfu": ...}
+     "vs_baseline": ..., "backend": "tpu", "mfu": ..., "compile_seconds": ...,
+     "peak_memory_bytes": ...}
+
+The metric/value/vs_baseline schema is frozen; observability fields are
+additive (``compile_seconds`` from the trainer's CompileTracker,
+``peak_memory_bytes`` from obs.MemoryMonitor — null where the backend has no
+allocator stats). The MFU math and the peak-TFLOPs table live in
+``replay_tpu.obs.mfu`` (shared with bench_suite.py and Trainer.fit telemetry);
+the sidecar is written through ``obs.JsonlLogger``. ``REPLAY_TPU_BENCH_BATCH``
+/ ``_SEQ_LEN`` / ``_NUM_ITEMS`` / ``_EMBEDDING_DIM`` / ``_NUM_BLOCKS`` shrink
+the shape for CI smoke runs (flagged ``shape_override``; never persisted to
+the sidecar).
 
 Backend policy (the TPU tunnel in this container is flaky — see BENCH_NOTES.md):
 
@@ -31,34 +42,30 @@ import time
 
 import numpy as np
 
-BATCH = 512
-SEQ_LEN = 50
-NUM_ITEMS = 3706  # ML-1M catalog size
-EMBEDDING_DIM = 64
-NUM_BLOCKS = 2
+# import-light on purpose (no jax): safe before the backend health probe;
+# the peak-TFLOPs table and cost-model FLOPs live in obs.mfu now, shared
+# with bench_suite.py and Trainer.fit's telemetry
+from replay_tpu.obs import JsonlLogger, MemoryMonitor
+from replay_tpu.obs.mfu import flops_per_step, mfu as _mfu
+
+_DEFAULTS = {"BATCH": 512, "SEQ_LEN": 50, "NUM_ITEMS": 3706, "EMBEDDING_DIM": 64, "NUM_BLOCKS": 2}
+
+
+def _shape(name: str) -> int:
+    """REPLAY_TPU_BENCH_<name> overrides the headline shape (CI smoke runs tiny
+    configs); any override marks the record and disables sidecar persistence."""
+    return int(os.environ.get(f"REPLAY_TPU_BENCH_{name}", _DEFAULTS[name]))
+
+
+BATCH = _shape("BATCH")
+SEQ_LEN = _shape("SEQ_LEN")
+NUM_ITEMS = _shape("NUM_ITEMS")  # default: ML-1M catalog size
+EMBEDDING_DIM = _shape("EMBEDDING_DIM")
+NUM_BLOCKS = _shape("NUM_BLOCKS")
+SHAPE_OVERRIDE = any(_shape(k) != v for k, v in _DEFAULTS.items())
 BASELINE_SAMPLES_PER_SEC = 11.07 * 512  # notebook 09 cell 28 (reference CPU box)
 
 SIDECAR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_SIDECAR.json")
-
-# peak dense bf16 TFLOP/s per chip, keyed by substring of jax Device.device_kind
-_PEAK_BF16_TFLOPS = {
-    "v5 lite": 197.0,
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v6 lite": 918.0,
-    "v6e": 918.0,
-    "v4": 275.0,
-    "v3": 123.0,
-    "v2": 46.0,
-}
-
-
-def _peak_tflops(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in _PEAK_BF16_TFLOPS.items():
-        if key in kind:
-            return peak
-    return None
 
 
 def _backend_healthy(timeout: float = 180.0) -> bool:
@@ -218,18 +225,17 @@ def main() -> None:
     jax.block_until_ready(loss_value)
     dispatch_step_ms = (time.perf_counter() - start) / dispatch_steps * 1000
 
-    # per-step FLOPs from XLA's own cost model of the compiled train step
-    step_flops = None
-    try:
-        analysis = trainer._train_step.lower(state, trainer._put_batch(batch)).compile().cost_analysis()
-        if analysis and "flops" in analysis:
-            step_flops = float(analysis["flops"])
-            if use_fused_ce:
-                # the pallas custom call is opaque to the cost model: add the
-                # analytic head FLOPs it replaced (fwd 2NEI + bwd 2*2NEI)
-                step_flops += 6.0 * BATCH * SEQ_LEN * EMBEDDING_DIM * NUM_ITEMS
-    except Exception:  # cost analysis is best-effort across backends
-        pass
+    # per-step FLOPs from XLA's own cost model of the compiled train step;
+    # the pallas custom call is opaque to the cost model, so the fused head
+    # adds back the analytic FLOPs it replaced (fwd 2NEI + bwd 2*2NEI)
+    step_flops = flops_per_step(
+        trainer._train_step,
+        state,
+        trainer._put_batch(batch),
+        extra_flops=(
+            6.0 * BATCH * SEQ_LEN * EMBEDDING_DIM * NUM_ITEMS if use_fused_ce else 0.0
+        ),
+    )
 
     # headline: K optimizer steps per XLA dispatch (Trainer.train_steps lax.scan
     # path, same math as train_step) with the input chunk already resident on
@@ -271,16 +277,28 @@ def main() -> None:
         # distinguishable from the baseline in the sidecar's best-run history
         "fused_ce": use_fused_ce,
         "flash_attention": use_flash,
+        # additive observability fields (obs collectors): how long XLA spent
+        # building the step/scan programs, and the per-device HBM peak
+        # (null on hosts whose backend exposes no allocator stats)
+        "compile_seconds": round(trainer.compile_tracker.total_compile_seconds, 2),
+        "peak_memory_bytes": MemoryMonitor().peak_bytes(),
     }
+    if SHAPE_OVERRIDE:
+        record["shape_override"] = {
+            "B": BATCH, "L": SEQ_LEN, "items": NUM_ITEMS,
+            "d": EMBEDDING_DIM, "blocks": NUM_BLOCKS,
+        }
     device_kind = jax.devices()[0].device_kind
     record["device_kind"] = device_kind
     if step_flops:
         tflops = step_flops * steps / elapsed / 1e12
         record["tflops_per_sec"] = round(tflops, 3)
-        peak = _peak_tflops(device_kind)
-        if peak and not on_cpu:
-            record["mfu"] = round(tflops / peak, 4)
-    if record["backend"] == "tpu":
+        # the cost model aggregates the whole sharded program: normalize the
+        # peak by the chip count or multi-chip slices report >1.0 MFU
+        utilization = _mfu(tflops, device_kind, device_count=jax.device_count())
+        if utilization is not None and not on_cpu:
+            record["mfu"] = round(utilization, 4)
+    if record["backend"] == "tpu" and not SHAPE_OVERRIDE:
         record["captured_unix"] = int(time.time())
         rev = _git_rev()
         if rev:
@@ -290,9 +308,13 @@ def main() -> None:
         existing = _load_sidecar()
         if existing is None or record["value"] >= existing.get("value", 0.0):
             try:
-                with open(SIDECAR_PATH, "w") as fh:
-                    json.dump(record, fh)
-                    fh.write("\n")
+                sidecar = JsonlLogger(
+                    os.path.dirname(SIDECAR_PATH),
+                    filename=os.path.basename(SIDECAR_PATH),
+                    mode="w",
+                )
+                sidecar.log_record(record)
+                sidecar.close()
             except OSError:
                 pass
     print(json.dumps(record))

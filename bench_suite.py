@@ -42,7 +42,9 @@ import time
 
 import numpy as np
 
-from bench import _git_rev, _peak_tflops
+from bench import _git_rev
+from replay_tpu.obs import JsonlLogger, MemoryMonitor
+from replay_tpu.obs.mfu import flops_per_step, mfu as _mfu
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -58,6 +60,11 @@ def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=Non
     """
     import jax
 
+    # device peak_bytes_in_use is a process-lifetime high-water mark and the
+    # suite runs rows sequentially: only report it for rows that RAISED it,
+    # so no row inherits a bigger predecessor's peak
+    monitor = MemoryMonitor()
+    peak_before = monitor.peak_bytes()
     try:
         state = trainer.init_state(batch)
         for _ in range(2):
@@ -69,17 +76,12 @@ def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=Non
         jax.block_until_ready(loss_value)
         dispatch_step = time.perf_counter() - t0
 
-        step_flops = None
-        try:
-            analysis = (
-                trainer._train_step.lower(state, trainer._put_batch(batch))
-                .compile()
-                .cost_analysis()
-            )
-            if analysis and "flops" in analysis:
-                step_flops = float(analysis["flops"]) + extra_flops_per_step
-        except Exception:
-            pass
+        step_flops = flops_per_step(
+            trainer._train_step,
+            state,
+            trainer._put_batch(batch),
+            extra_flops=extra_flops_per_step,
+        )
 
         chunk = [batch] * scan_k
         state, _ = trainer.train_steps(state, chunk)  # compile + warm
@@ -109,14 +111,21 @@ def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=Non
             "final_loss": round(float(np.asarray(losses)[-1]), 4),
             "backend": jax.default_backend(),
             "device_kind": jax.devices()[0].device_kind,
+            "compile_seconds": round(trainer.compile_tracker.total_compile_seconds, 2),
+            "peak_memory_bytes": (
+                peak_after
+                if (peak_after := monitor.peak_bytes()) is not None
+                and peak_after != peak_before
+                else None
+            ),
             **(meta or {}),
         }
         if step_flops:
             tflops = step_flops * steps / elapsed / 1e12
             record["tflops_per_sec"] = round(tflops, 3)
-            peak = _peak_tflops(record["device_kind"])
-            if peak and record["backend"] != "cpu":
-                record["mfu"] = round(tflops / peak, 4)
+            utilization = _mfu(tflops, record["device_kind"], device_count=jax.device_count())
+            if utilization is not None and record["backend"] != "cpu":
+                record["mfu"] = round(utilization, 4)
         return record
     except Exception as exc:  # OOM / compile failure is a result, not a crash
         return {"row": label, "error": f"{type(exc).__name__}: {str(exc)[:400]}",
@@ -414,7 +423,14 @@ def main():
     parser.add_argument("--rows", default="all")
     parser.add_argument("--quick", action="store_true", help="toy shapes (CPU smoke)")
     parser.add_argument("--out", default=None)
+    parser.add_argument(
+        "--run-dir",
+        default=os.environ.get("REPLAY_TPU_RUN_DIR"),
+        help="also append each row as a JSON line to RUN_DIR/events.jsonl "
+             "(the shared obs artifact shape; default: $REPLAY_TPU_RUN_DIR)",
+    )
     args = parser.parse_args()
+    run_log = JsonlLogger(args.run_dir) if args.run_dir else None
 
     import jax.numpy as jnp
     import jax
@@ -457,9 +473,13 @@ def main():
         record["captured_unix"] = int(time.time())
         results.append(record)
         print(json.dumps(record), flush=True)
+        if run_log is not None:  # same artifact shape as training runs / dryruns
+            run_log.log_record({"event": "bench_row", **record})
         if args.out:  # write-through: completed rows survive a later crash
             with open(args.out, "w") as fh:
                 json.dump(results, fh, indent=1)
+    if run_log is not None:
+        run_log.close()
     if args.out:
         print(f"wrote {args.out}", file=sys.stderr)
 

@@ -25,9 +25,11 @@ padding_mask), Bert4Rec (+ token_mask) and TwoTower share one loop.
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import logging
 import math
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
@@ -40,6 +42,16 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from replay_tpu.metrics.builder import MetricsBuilder
+from replay_tpu.obs import (
+    CompileTracker,
+    ConsoleLogger,
+    JsonlLogger,
+    MemoryMonitor,
+    MultiLogger,
+    RunLogger,
+    StepTelemetry,
+    TrainerEvent,
+)
 
 logger = logging.getLogger("replay_tpu")
 
@@ -285,6 +297,10 @@ class Trainer:
     label_field: str = "positive_labels"
     target_mask_field: str = "target_padding_mask"
     negative_field: str = "negative_labels"
+    # every jitted path registers here: compile_tracker.report() shows traces
+    # (== compiled programs; 1 per fn under the static-shapes invariant) and
+    # compile wall-time, surfaced by fit's on_fit_end event
+    compile_tracker: CompileTracker = field(default_factory=CompileTracker)
 
     def __post_init__(self) -> None:
         if isinstance(self.loss, str):
@@ -453,8 +469,12 @@ class Trainer:
     def train_step(self, state: TrainState, batch: Batch) -> Tuple[TrainState, jnp.ndarray]:
         """One jitted optimizer step on a (data-sharded) batch."""
         if self._train_step is None:
-            self._train_step = jax.jit(self._build_train_step(), donate_argnums=0)
-        return self._train_step(state, self._put_batch(batch))
+            self._train_step = jax.jit(
+                self.compile_tracker.wrap(self._build_train_step(), "train_step"),
+                donate_argnums=0,
+            )
+        with self.compile_tracker.observe("train_step"):
+            return self._train_step(state, self._put_batch(batch))
 
     def train_steps(
         self, state: TrainState, batches: Sequence[Batch]
@@ -469,12 +489,16 @@ class Trainer:
         if self._train_scan is None:
             step_fn = self._build_train_step()
             self._train_scan = jax.jit(
-                lambda s, stacked: jax.lax.scan(step_fn, s, stacked), donate_argnums=0
+                self.compile_tracker.wrap(
+                    lambda s, stacked: jax.lax.scan(step_fn, s, stacked), "train_scan"
+                ),
+                donate_argnums=0,
             )
         stacked = jax.tree.map(
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *list(batches)
         )
-        new_state, losses = self._train_scan(state, self._put_stacked(stacked))
+        with self.compile_tracker.observe("train_scan"):
+            new_state, losses = self._train_scan(state, self._put_stacked(stacked))
         return new_state, np.asarray(losses)
 
     def _put_stacked(self, stacked: Batch) -> Batch:
@@ -523,6 +547,9 @@ class Trainer:
         patience: Optional[int] = None,
         mode: str = "max",
         prefetch: int = 0,
+        loggers: Optional[RunLogger | Sequence[RunLogger]] = None,
+        profile_steps: Optional[Tuple[int, int]] = None,
+        profile_dir: Optional[str] = None,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`. A dict of
@@ -539,6 +566,26 @@ class Trainer:
         ``set_epoch`` is called so shuffling advances per epoch), a zero- or
         one-arg callable returning an iterable (the arg is the epoch), or a plain
         one-shot iterator (materialized once if several epochs are requested).
+
+        ``loggers`` attaches run-telemetry sinks (``replay_tpu.obs``): fit then
+        emits ``on_fit_start`` / ``on_train_step`` (loss, LR, samples/sec) /
+        ``on_validation_end`` / ``on_epoch_end`` / ``on_checkpoint`` /
+        ``on_fit_end`` (telemetry summary, compile report, peak device memory)
+        events to every sink. ``log_every`` is itself a sink — a
+        :class:`~replay_tpu.obs.ConsoleLogger` on the same event stream — so
+        the old print path and a ``JsonlLogger`` run directory see identical
+        records. With explicit ``loggers`` every step emits an event, costing
+        one scalar device sync (the loss; the step counter is tracked on host
+        after a one-time fetch, and LR-schedule evaluation is a tiny host-side
+        dispatch only when a scheduler is configured); with only ``log_every``
+        the cadence is every ``log_every``-th EXECUTED step, counted globally
+        across epochs (the old path counted per-epoch stream positions, so
+        the exact steps printed can differ from pre-event-layer logs).
+
+        ``profile_steps=(start, stop)`` captures a ``jax.profiler`` trace of
+        the half-open step window [start, stop) — counted over steps actually
+        executed by this fit call — into ``profile_dir`` (default: the first
+        JsonlLogger's ``run_dir/profile``, else ``./jax_profile``).
 
         ``checkpoint_every`` additionally saves MID-epoch every that many steps,
         recording the data-iterator position (epoch + step within the epoch) in
@@ -623,6 +670,99 @@ class Trainer:
             if seen_values:
                 best_value = max(seen_values) if mode == "max" else min(seen_values)
 
+        # -- run-telemetry sinks (replay_tpu.obs) -------------------------- #
+        explicit_loggers: List[RunLogger] = []
+        if loggers is not None:
+            # duck-typed: RunLogger is a protocol, a single sink is anything
+            # with log_event (a structural conformer need not subclass it)
+            explicit_loggers = (
+                [loggers] if hasattr(loggers, "log_event") else list(loggers)
+            )
+        sinks: List[RunLogger] = list(explicit_loggers)
+        if log_every:
+            # events already arrive at log_every cadence when no explicit
+            # sinks ask for per-step records — the console then prints each one
+            sinks.append(ConsoleLogger(every=log_every if explicit_loggers else 1))
+        run_logger: Optional[RunLogger] = (
+            MultiLogger(sinks) if len(sinks) > 1 else (sinks[0] if sinks else None)
+        )
+        event_every = 1 if explicit_loggers else (log_every or 0)
+
+        def emit(name: str, step=None, epoch=None, **payload) -> None:
+            if run_logger is not None:
+                run_logger.log_event(
+                    TrainerEvent(event=name, step=step, epoch=epoch, payload=payload)
+                )
+
+        telemetry = StepTelemetry(warmup_steps=1)
+        memory = MemoryMonitor()
+        lr_schedule = (
+            self.optimizer.scheduler.create(self.optimizer.learning_rate)
+            if self.optimizer.scheduler is not None
+            else None
+        )
+
+        def current_lr(step: int) -> float:
+            if lr_schedule is None:
+                return float(self.optimizer.learning_rate)
+            return float(lr_schedule(step))
+
+        def fit_end_payload() -> Dict[str, Any]:
+            return {
+                "telemetry": telemetry.summary(),
+                "compile": self.compile_tracker.report(),
+                "peak_memory_bytes": memory.peak_bytes(),
+                "history_len": len(self.history),
+            }
+
+        emit(
+            "on_fit_start",
+            epoch=start_epoch,
+            epochs=epochs,
+            model=type(self.model).__name__,
+            loss=type(self.loss).__name__,
+            optimizer=self.optimizer.name,
+            learning_rate=self.optimizer.learning_rate,
+            mesh={axis: int(n) for axis, n in self.mesh.shape.items()},
+            resumed=bool(resume and pending_restore_step is not None),
+        )
+
+        if profile_steps is not None:
+            profile_start, profile_stop = int(profile_steps[0]), int(profile_steps[1])
+            if profile_stop <= profile_start or profile_start < 0:
+                msg = f"profile_steps must be a valid [start, stop) window, got {profile_steps}"
+                raise ValueError(msg)
+
+            def resolved_profile_dir() -> str:
+                if profile_dir is not None:
+                    return profile_dir
+                queue = list(explicit_loggers)
+                while queue:  # MultiLogger nests sinks: search them too
+                    sink = queue.pop(0)
+                    if isinstance(sink, JsonlLogger):
+                        return os.path.join(sink.run_dir, "profile")
+                    if isinstance(sink, MultiLogger):
+                        queue.extend(sink.loggers)
+                return "jax_profile"
+
+        profile_stack = contextlib.ExitStack()
+        profile_active = False
+        measured_total = 0  # steps actually executed by THIS fit call
+        last_emitted_at = 0
+        step_base = None  # int(state.step) fetched once; then tracked on host
+
+        def telemetry_tick(batch: Batch) -> Dict[str, float]:
+            """Fold the steps since the last tick into the telemetry window
+            (shared by the per-step emit path and the epoch-tail flush)."""
+            nonlocal last_emitted_at
+            delta = measured_total - last_emitted_at
+            last_emitted_at = measured_total
+            reference = batch.get(self.padding_mask_field)
+            rows = (
+                int(np.asarray(reference).shape[0]) if reference is not None else None
+            )
+            return telemetry.tick(samples=rows * delta if rows else None, steps=delta)
+
         if pending_restore_step is not None and start_epoch >= epochs:
             # run already complete: restore the checkpoint and return it instead
             # of raising "received no batches" — the monitored best when one is
@@ -637,121 +777,181 @@ class Trainer:
                 restore_step = resumed_best_step
             restored = checkpoint_manager.restore(template, step=restore_step)
             logger.info("resume: run already complete at step %d", restore_step)
+            emit("on_fit_end", step=restore_step, epoch=start_epoch,
+                 note="resume: run already complete", **fit_end_payload())
             return _place_tree(restored, jax.tree.map(self._template_sharding, template))
 
-        for epoch in range(start_epoch, epochs):
-            # n_steps = position in the epoch's batch stream (skipped batches
-            # included, keeping checkpoint_every aligned across resumes);
-            # measured_steps = batches that actually trained THIS process
-            epoch_loss, n_steps, measured_steps = None, 0, 0
-            skipped = 0
-            epoch_batches = batches_for(epoch)
-            if prefetch:
-                from replay_tpu.data.nn.prefetch import prefetch as _prefetch
+        stopped_early = False
+        with profile_stack:  # closes a still-open profiler window on any exit
+            for epoch in range(start_epoch, epochs):
+                # n_steps = position in the epoch's batch stream (skipped batches
+                # included, keeping checkpoint_every aligned across resumes);
+                # measured_steps = batches that actually trained THIS process
+                epoch_loss, n_steps, measured_steps = None, 0, 0
+                skipped = 0
+                epoch_needs_mark = True  # re-mark per epoch: discounts the
+                # inter-epoch validation/checkpoint gap from the telemetry window
+                epoch_batches = batches_for(epoch)
+                if prefetch:
+                    from replay_tpu.data.nn.prefetch import prefetch as _prefetch
 
-                epoch_batches = _prefetch(iter(epoch_batches), depth=prefetch)
-            for batch in epoch_batches:
-                if state is None:
-                    state = self.init_state(batch)
-                    if pending_restore_step is not None:
-                        restored = checkpoint_manager.restore(
-                            state, step=pending_restore_step
-                        )
-                        state = _place_tree(
-                            restored, jax.tree.map(self._template_sharding, state)
-                        )
-                        pending_restore_step = None
-                if epoch == start_epoch and skipped < skip_steps:
-                    # fast-forward: the batch stream is deterministic per epoch,
-                    # so consuming without stepping lands on the exact position
-                    skipped += 1
+                    epoch_batches = _prefetch(iter(epoch_batches), depth=prefetch)
+                for batch in epoch_batches:
+                    if state is None:
+                        state = self.init_state(batch)
+                        if pending_restore_step is not None:
+                            restored = checkpoint_manager.restore(
+                                state, step=pending_restore_step
+                            )
+                            state = _place_tree(
+                                restored, jax.tree.map(self._template_sharding, state)
+                            )
+                            pending_restore_step = None
+                    if epoch == start_epoch and skipped < skip_steps:
+                        # fast-forward: the batch stream is deterministic per epoch,
+                        # so consuming without stepping lands on the exact position
+                        skipped += 1
+                        n_steps += 1
+                        continue
+                    if epoch_needs_mark:
+                        telemetry.mark()
+                        epoch_needs_mark = False
+                    if (
+                        profile_steps is not None
+                        and not profile_active
+                        and measured_total == profile_start
+                    ):
+                        from replay_tpu.utils.profiling import trace
+
+                        profile_stack.enter_context(trace(resolved_profile_dir()))
+                        profile_active = True
+                    state, loss_value = self.train_step(state, batch)
+                    # accumulate on device: float() here would sync every step
+                    epoch_loss = loss_value if epoch_loss is None else epoch_loss + loss_value
                     n_steps += 1
-                    continue
-                state, loss_value = self.train_step(state, batch)
-                # accumulate on device: float() here would sync every step
-                epoch_loss = loss_value if epoch_loss is None else epoch_loss + loss_value
-                n_steps += 1
-                measured_steps += 1
-                if log_every and n_steps % log_every == 0:
-                    logger.info("epoch %d step %d loss %.4f", epoch, n_steps, float(loss_value))
-                if (
-                    checkpoint_every
-                    and checkpoint_manager is not None
-                    and n_steps % checkpoint_every == 0
-                ):
+                    measured_steps += 1
+                    measured_total += 1
+                    if profile_active and measured_total >= profile_stop:
+                        profile_stack.close()
+                        profile_active = False
+                    if event_every and measured_total % event_every == 0:
+                        if step_base is None:
+                            # one-time base fetch: state.step then advances in
+                            # lockstep with measured_total within this fit
+                            step_base = int(state.step) - measured_total
+                        step_id = step_base + measured_total
+                        loss_f = float(loss_value)  # THE per-event device sync
+                        tick = telemetry_tick(batch)
+                        emit(
+                            "on_train_step",
+                            step=step_id,
+                            epoch=epoch,
+                            loss=loss_f,
+                            # the rate the optimizer APPLIED: optax schedules
+                            # are indexed by steps completed before the update
+                            lr=current_lr(step_id - 1),
+                            samples_per_sec=tick["samples_per_sec"],
+                            steps_per_sec=tick["steps_per_sec"],
+                            step_seconds=tick["step_seconds"],
+                        )
+                    if (
+                        checkpoint_every
+                        and checkpoint_manager is not None
+                        and n_steps % checkpoint_every == 0
+                    ):
+                        checkpoint_manager.save(
+                            int(state.step),
+                            state,
+                            history=self.history,
+                            metadata={
+                                "mid_epoch": True, "epoch": epoch, "step_in_epoch": n_steps,
+                            },
+                        )
+                        emit("on_checkpoint", step=int(state.step), epoch=epoch,
+                             mid_epoch=True, step_in_epoch=n_steps)
+                record = {
+                    "epoch": epoch,
+                    # a resumed epoch averages only the steps THIS process ran;
+                    # NaN when every batch was fast-forwarded (nothing measured)
+                    "train_loss": (
+                        float(epoch_loss) / measured_steps
+                        if measured_steps
+                        else float("nan")
+                    ),
+                }
+                if event_every and measured_total > last_emitted_at:
+                    # flush the tail steps into the telemetry window HERE —
+                    # float(epoch_loss) above already fenced them, and ticking
+                    # after validation would dilute the steady-state rate;
+                    # fits shorter than the event cadence get real numbers
+                    telemetry_tick(batch)
+                if val_batches is not None:
+                    # several validation streams (the reference's sequential
+                    # CombinedLoader): a dict of factories gets per-stream prefixes
+                    streams = (
+                        val_batches if isinstance(val_batches, dict) else {"": val_batches}
+                    )
+                    for stream_name, factory in streams.items():
+                        stream_metrics = self.validate(
+                            state,
+                            factory(),
+                            metrics=metrics,
+                            top_k=top_k,
+                            item_count=item_count,
+                            postprocessors=postprocessors,
+                        )
+                        prefix = f"{stream_name}/" if stream_name else ""
+                        record.update({f"{prefix}{k}": v for k, v in stream_metrics.items()})
+                    emit("on_validation_end",
+                         step=int(state.step) if state is not None else None,
+                         epoch=epoch, record=record)
+                self.history.append(record)
+                emit("on_epoch_end",
+                     step=int(state.step) if state is not None else None,
+                     epoch=epoch, record=record)
+                if not log_every:
+                    # log_every=0 silences the per-step prints only — the
+                    # per-epoch record line predates the event layer and stays
+                    logger.info("epoch %d: %s", epoch, record)
+
+                improved = False
+                if monitor is not None:
+                    if monitor not in record:
+                        msg = f"monitor '{monitor}' not in the epoch record {sorted(record)}"
+                        raise KeyError(msg)
+                    value = record[monitor]
+                    improved = (
+                        best_value is None
+                        or (mode == "max" and value > best_value)
+                        or (mode == "min" and value < best_value)
+                    )
+                    if improved:
+                        # deep-copy: the NEXT train_step donates this state's buffers
+                        # (donate_argnums=0), which would leave a dead pytree here
+                        best_state = jax.tree.map(lambda x: x.copy(), state)
+                        best_value, stale_epochs = value, 0
+                    else:
+                        stale_epochs += 1
+                if checkpoint_manager is not None and state is not None:
+                    metadata = {"epoch": epoch}
+                    if monitor:
+                        metadata.update({"best": improved, monitor: value})
                     checkpoint_manager.save(
                         int(state.step),
                         state,
                         history=self.history,
-                        metadata={
-                            "mid_epoch": True, "epoch": epoch, "step_in_epoch": n_steps,
-                        },
+                        metadata=metadata,
                     )
-            record = {
-                "epoch": epoch,
-                # a resumed epoch averages only the steps THIS process ran;
-                # NaN when every batch was fast-forwarded (nothing measured)
-                "train_loss": (
-                    float(epoch_loss) / measured_steps
-                    if measured_steps
-                    else float("nan")
-                ),
-            }
-            if val_batches is not None:
-                # several validation streams (the reference's sequential
-                # CombinedLoader): a dict of factories gets per-stream prefixes
-                streams = (
-                    val_batches if isinstance(val_batches, dict) else {"": val_batches}
-                )
-                for stream_name, factory in streams.items():
-                    stream_metrics = self.validate(
-                        state,
-                        factory(),
-                        metrics=metrics,
-                        top_k=top_k,
-                        item_count=item_count,
-                        postprocessors=postprocessors,
+                    if improved:
+                        checkpoint_manager.mark_best(int(state.step))
+                    emit("on_checkpoint", step=int(state.step), epoch=epoch,
+                         mid_epoch=False, best=bool(improved) if monitor else None)
+                if monitor is not None and patience is not None and stale_epochs >= patience:
+                    logger.info(
+                        "early stop: no %s improvement for %d epochs", monitor, patience
                     )
-                    prefix = f"{stream_name}/" if stream_name else ""
-                    record.update({f"{prefix}{k}": v for k, v in stream_metrics.items()})
-            self.history.append(record)
-            logger.info("epoch %d: %s", epoch, record)
-
-            improved = False
-            if monitor is not None:
-                if monitor not in record:
-                    msg = f"monitor '{monitor}' not in the epoch record {sorted(record)}"
-                    raise KeyError(msg)
-                value = record[monitor]
-                improved = (
-                    best_value is None
-                    or (mode == "max" and value > best_value)
-                    or (mode == "min" and value < best_value)
-                )
-                if improved:
-                    # deep-copy: the NEXT train_step donates this state's buffers
-                    # (donate_argnums=0), which would leave a dead pytree here
-                    best_state = jax.tree.map(lambda x: x.copy(), state)
-                    best_value, stale_epochs = value, 0
-                else:
-                    stale_epochs += 1
-            if checkpoint_manager is not None and state is not None:
-                metadata = {"epoch": epoch}
-                if monitor:
-                    metadata.update({"best": improved, monitor: value})
-                checkpoint_manager.save(
-                    int(state.step),
-                    state,
-                    history=self.history,
-                    metadata=metadata,
-                )
-                if improved:
-                    checkpoint_manager.mark_best(int(state.step))
-            if monitor is not None and patience is not None and stale_epochs >= patience:
-                logger.info(
-                    "early stop: no %s improvement for %d epochs", monitor, patience
-                )
-                break
+                    stopped_early = True
+                    break
         if state is None:
             msg = "fit() received no batches"
             raise ValueError(msg)
@@ -762,6 +962,8 @@ class Trainer:
             best_state = _place_tree(
                 restored, jax.tree.map(self._template_sharding, state)
             )
+        emit("on_fit_end", step=int(state.step), stopped_early=stopped_early,
+             **fit_end_payload())
         return best_state if best_state is not None else state
 
     # -- eval / predict ---------------------------------------------------- #
@@ -777,7 +979,7 @@ class Trainer:
                 method=type(model).forward_inference,
             )
 
-        return jax.jit(eval_logits)
+        return jax.jit(self.compile_tracker.wrap(eval_logits, "eval_logits"))
 
     def predict_logits(
         self, state: TrainState, batch: Batch, candidates: Optional[jnp.ndarray] = None
@@ -797,10 +999,13 @@ class Trainer:
             return None
         if self._catalog_fn is None:
             self._catalog_fn = jax.jit(
-                lambda params, features: model.apply(
-                    {"params": params},
-                    item_feature_tensors=features,
-                    method=type(model).encode_items,
+                self.compile_tracker.wrap(
+                    lambda params, features: model.apply(
+                        {"params": params},
+                        item_feature_tensors=features,
+                        method=type(model).encode_items,
+                    ),
+                    "encode_items",
                 )
             )
         return self._catalog_fn(state.params, batch.get("item_feature_tensors"))
@@ -817,7 +1022,9 @@ class Trainer:
                     method=type(model).get_query_embeddings,
                 )
 
-            self._query_embeddings_fn = jax.jit(embed)
+            self._query_embeddings_fn = jax.jit(
+                self.compile_tracker.wrap(embed, "query_embeddings")
+            )
         return self._query_embeddings_fn
 
     def _catalog_logits(self, state: TrainState, batch: Batch, catalog) -> jnp.ndarray:

@@ -1,0 +1,39 @@
+"""Run-telemetry subsystem: trainer events, step timing, MFU, memory, compiles.
+
+The reference stack gets training observability for free from PyTorch Lightning
+(loggers, progress bars, callbacks — replay/nn/lightning/module.py:14-120 wires
+them); this JAX stack has no Lightning, so the trainer emits structured
+:class:`TrainerEvent` records to pluggable :class:`RunLogger` sinks instead,
+and a collectors layer measures what Lightning never could: jit retraces
+(:class:`CompileTracker`), device memory (:class:`MemoryMonitor`), steady-state
+throughput (:class:`StepTelemetry`) and achieved-vs-peak FLOPs (:mod:`.mfu`).
+Beyond-parity — SURVEY.md §5.
+"""
+
+from .collectors import CompileTracker, MemoryMonitor, StepTelemetry
+from .events import (
+    ConsoleLogger,
+    JsonlLogger,
+    MultiLogger,
+    RunLogger,
+    TensorBoardLogger,
+    TrainerEvent,
+)
+from .mfu import PEAK_BF16_TFLOPS, cost_analysis, flops_per_step, mfu, peak_tflops
+
+__all__ = [
+    "CompileTracker",
+    "ConsoleLogger",
+    "JsonlLogger",
+    "MemoryMonitor",
+    "MultiLogger",
+    "PEAK_BF16_TFLOPS",
+    "RunLogger",
+    "StepTelemetry",
+    "TensorBoardLogger",
+    "TrainerEvent",
+    "cost_analysis",
+    "flops_per_step",
+    "mfu",
+    "peak_tflops",
+]

@@ -1,0 +1,244 @@
+"""Telemetry collectors: step timing, jit-retrace counting, device memory.
+
+Beyond-parity (the reference delegates run metering to Lightning and has no
+retrace/memory story at all — SURVEY.md §5). :class:`StepTelemetry`
+generalizes ``utils/profiling.StepTimer`` (which remains as the minimal
+bench-style timer); :class:`CompileTracker` makes the static-shapes invariant
+of CLAUDE.md observable instead of aspirational; :class:`MemoryMonitor` snaps
+``Device.memory_stats()`` per chip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class StepTelemetry:
+    """Steady-state *and* instantaneous step timing.
+
+    Call :meth:`mark` once before the first step, then :meth:`tick` after each
+    step (or each observed group of ``steps`` steps); each tick returns the
+    instantaneous rates since the previous mark/tick. :meth:`summary` returns
+    the steady-state record — shape-stable: the same keys come back whether or
+    not anything was measured (NaN-filled), so JSONL consumers never KeyError.
+
+    ``warmup_steps`` ticks are excluded from the steady-state window (compile
+    happens on the first step); pass a device ``result`` to fence with
+    ``block_until_ready`` when the caller has not already synchronized.
+    """
+
+    def __init__(self, warmup_steps: int = 1, samples_per_step: Optional[int] = None) -> None:
+        self.warmup_steps = max(int(warmup_steps), 0)
+        self.samples_per_step = samples_per_step
+        self._count = 0
+        self._last: Optional[float] = None
+        self._start: Optional[float] = None
+        self._measured_samples = 0.0
+        self._measured_steps = 0
+
+    @staticmethod
+    def _fence(result: Any) -> None:
+        if result is not None:
+            import jax
+
+            jax.block_until_ready(result)
+
+    def mark(self, result: Any = None) -> None:
+        """Set the reference point for the next tick's instantaneous rate.
+
+        Re-marking after a pause (validation, checkpointing) RESUMES the
+        steady-state window: the gap since the last tick is discounted, so
+        non-training wall time never dilutes the summary rates.
+        """
+        self._fence(result)
+        now = time.perf_counter()
+        if self._start is not None and self._last is not None:
+            self._start += now - self._last
+        self._last = now
+
+    def tick(self, result: Any = None, samples: Optional[float] = None, steps: int = 1) -> Dict[str, float]:
+        """Record ``steps`` completed steps totalling ``samples`` samples."""
+        self._fence(result)
+        now = time.perf_counter()
+        nan = float("nan")
+        if samples is None and self.samples_per_step is not None:
+            samples = self.samples_per_step * steps
+        before_count, before_time = self._count, self._last
+        self._count += steps
+        record = {
+            "step": float(self._count),
+            "step_seconds": nan,
+            "steps_per_sec": nan,
+            "samples_per_sec": nan,
+        }
+        if before_time is not None:
+            elapsed = now - before_time
+            if elapsed > 0:
+                record["step_seconds"] = elapsed / steps
+                record["steps_per_sec"] = steps / elapsed
+                if samples is not None:
+                    record["samples_per_sec"] = samples / elapsed
+        self._last = now
+        if self._count <= self.warmup_steps:
+            # still inside warmup: the steady-state clock starts at this
+            # tick's END (its wall time includes compile)
+            self._start = now
+        else:
+            measured = min(steps, self._count - self.warmup_steps)
+            frac = measured / steps
+            if measured < steps:
+                # the tick spans the warmup boundary: prorate its window so
+                # the post-warmup portion is neither discarded (NaN summaries
+                # on short runs) nor counted against zero elapsed (inflation)
+                if before_time is not None and now > before_time:
+                    self._start = now - (now - before_time) * frac
+                else:
+                    self._start, measured, frac = now, 0, 0.0
+            elif self._start is None:
+                # warmup_steps=0: the window is anchored at mark() time; a
+                # tick with no anchor at all has no time base and is dropped
+                if before_time is not None:
+                    self._start = before_time
+                else:
+                    self._start, measured, frac = now, 0, 0.0
+            self._measured_steps += measured
+            if samples:
+                self._measured_samples += samples * frac
+        return record
+
+    def summary(self, result: Any = None) -> Dict[str, float]:
+        """Steady-state record over every post-warmup tick (shape-stable)."""
+        self._fence(result)
+        nan = float("nan")
+        record = {
+            "steps": float(self._measured_steps),
+            "elapsed_seconds": nan,
+            "steps_per_sec": nan,
+            "samples_per_sec": nan,
+        }
+        if self._start is not None and self._measured_steps > 0:
+            # the window ends at the LAST TICK, not at this call: summary()
+            # typically runs after validation/checkpointing whose wall time
+            # must not dilute the steady-state training rate
+            end = self._last if self._last is not None else time.perf_counter()
+            elapsed = end - self._start
+            if elapsed > 0:
+                record["elapsed_seconds"] = elapsed
+                record["steps_per_sec"] = self._measured_steps / elapsed
+                if self._measured_samples:
+                    record["samples_per_sec"] = self._measured_samples / elapsed
+        return record
+
+
+class CompileTracker:
+    """Counts jit cache misses (traces) per function and compile wall-time.
+
+    :meth:`wrap` the *python* step function before handing it to ``jax.jit``:
+    every retrace executes the python body once, so the wrapper's counter is
+    exactly the number of compiled programs XLA built for that name. Pair the
+    dispatch call with :meth:`observe` to attribute wall-clock to compilation
+    (jit traces + compiles synchronously inside the triggering call).
+
+    Under the static-shapes convention (CLAUDE.md) a healthy training run
+    shows ``traces == 1`` per jitted function; anything higher is a shape leak.
+    """
+
+    def __init__(self) -> None:
+        self._traces: Dict[str, int] = {}
+        self._compile_seconds: Dict[str, float] = {}
+
+    def wrap(self, fn: Callable, name: Optional[str] = None) -> Callable:
+        label = name or getattr(fn, "__name__", "fn")
+        self._traces.setdefault(label, 0)
+
+        @functools.wraps(fn)
+        def traced(*args, **kwargs):
+            self._traces[label] = self._traces.get(label, 0) + 1
+            return fn(*args, **kwargs)
+
+        return traced
+
+    @contextlib.contextmanager
+    def observe(self, name: str):
+        """Attribute the enclosed call's wall time to compilation iff a trace
+        of ``name`` happened inside it (first call / retrace)."""
+        before = self._traces.get(name, 0)
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self._traces.get(name, 0) > before:
+                elapsed = time.perf_counter() - start
+                self._compile_seconds[name] = self._compile_seconds.get(name, 0.0) + elapsed
+
+    @property
+    def traces(self) -> Dict[str, int]:
+        return dict(self._traces)
+
+    @property
+    def compile_seconds(self) -> Dict[str, float]:
+        return dict(self._compile_seconds)
+
+    @property
+    def total_compile_seconds(self) -> float:
+        return float(sum(self._compile_seconds.values()))
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        """{name: {traces, compile_seconds}} over every wrapped function."""
+        return {
+            name: {
+                "traces": count,
+                "compile_seconds": round(self._compile_seconds.get(name, 0.0), 4),
+            }
+            for name, count in sorted(self._traces.items())
+        }
+
+
+class MemoryMonitor:
+    """Per-device ``memory_stats()`` snapshots and the cross-device peak.
+
+    CPU backends report no allocator stats (``memory_stats() is None``): every
+    accessor then degrades to an empty snapshot / ``None`` peak rather than
+    raising, so the same telemetry code runs on the TPU and the CPU-mesh dry
+    runs.
+    """
+
+    def __init__(self, devices=None) -> None:
+        self._devices = devices
+
+    def _resolve(self):
+        if self._devices is None:
+            import jax
+
+            self._devices = jax.devices()
+        return self._devices
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        stats = {}
+        for device in self._resolve():
+            try:
+                device_stats = device.memory_stats()
+            except Exception:  # backends without allocator introspection
+                device_stats = None
+            if not device_stats:
+                continue
+            stats[str(device)] = {
+                k: float(v)
+                for k, v in device_stats.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+        return stats
+
+    def _max_over_devices(self, key: str) -> Optional[int]:
+        values = [s[key] for s in self.snapshot().values() if key in s]
+        return int(max(values)) if values else None
+
+    def peak_bytes(self) -> Optional[int]:
+        """Max ``peak_bytes_in_use`` over devices (None when unavailable)."""
+        return self._max_over_devices("peak_bytes_in_use")
+
+    def bytes_in_use(self) -> Optional[int]:
+        return self._max_over_devices("bytes_in_use")

@@ -1,0 +1,236 @@
+"""Event/callback layer: the Lightning logger replacement.
+
+Parity target: replay/nn/lightning delegates run logging to PyTorch Lightning's
+``Trainer(logger=...)`` / callback machinery (module.py:14-120); here the
+trainer emits :class:`TrainerEvent` records to :class:`RunLogger` sinks.
+
+Event flow emitted by ``replay_tpu.nn.Trainer.fit``::
+
+    on_fit_start
+      on_train_step*          (loss, lr, samples_per_sec, step_seconds)
+      on_validation_end?      (the epoch's metric record, when validating)
+      on_epoch_end            (the full history record)
+      on_checkpoint?          (every checkpoint save, incl. mid-epoch)
+    on_fit_end                (telemetry summary, compile report, peak memory)
+
+Every event flattens to one JSON-able dict (``event`` + ``time`` + optional
+``step``/``epoch`` + the payload), so a run directory's ``events.jsonl`` is a
+self-describing artifact shared by training runs, ``bench.py`` records and the
+CPU-mesh dry runs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Mapping, Optional, Sequence
+
+logger = logging.getLogger("replay_tpu")
+
+
+def _jsonable(value: Any) -> Any:
+    """Coerce numpy / jax scalars and containers into plain, STRICT JSON
+    types. Non-finite floats become null: shape-stable keys survive, and the
+    emitted lines stay valid RFC-8259 JSON (the bare ``NaN`` token is not)."""
+    if isinstance(value, Mapping):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, float):
+        return value if math.isfinite(value) else None
+    if isinstance(value, (str, bool, int)) or value is None:
+        return value
+    # numpy / jax scalars and 0-d arrays expose item(); arrays expose tolist()
+    if hasattr(value, "item") and getattr(value, "ndim", None) in (0, None):
+        try:
+            return _jsonable(value.item())
+        except (TypeError, ValueError):
+            pass
+    if hasattr(value, "tolist"):
+        try:
+            return _jsonable(value.tolist())
+        except (TypeError, ValueError):
+            pass
+    return str(value)
+
+
+@dataclass
+class TrainerEvent:
+    """One observation from a training run.
+
+    ``payload`` keys flatten into the record next to ``event``/``time``/
+    ``step``/``epoch``, so consumers index events.jsonl lines by plain keys.
+    """
+
+    event: str
+    step: Optional[int] = None
+    epoch: Optional[int] = None
+    time: float = field(default_factory=time.time)
+    payload: Dict[str, Any] = field(default_factory=dict)
+
+    def to_record(self) -> Dict[str, Any]:
+        record: Dict[str, Any] = {"event": self.event, "time": self.time}
+        if self.step is not None:
+            record["step"] = int(self.step)
+        if self.epoch is not None:
+            record["epoch"] = int(self.epoch)
+        for key, value in self.payload.items():
+            record[str(key)] = _jsonable(value)
+        return record
+
+
+class RunLogger:
+    """Protocol for event sinks. Subclasses implement :meth:`log_event`;
+    :meth:`close` is optional (flush/teardown). Usable as a context manager."""
+
+    def log_event(self, event: TrainerEvent) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "RunLogger":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+
+class JsonlLogger(RunLogger):
+    """One JSON line per event, appended to ``run_dir/filename``.
+
+    Lines are flushed as written so a crashed run keeps its telemetry. The
+    same sink doubles as a raw-record writer (:meth:`log_record`) for driver
+    artifacts like ``BENCH_TPU_SIDECAR.json`` that are single records rather
+    than event streams (``mode="w"``).
+    """
+
+    def __init__(self, run_dir: str, filename: str = "events.jsonl", mode: str = "a") -> None:
+        self.run_dir = str(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.path = os.path.join(self.run_dir, filename)
+        self._fh = open(self.path, mode)
+
+    def log_record(self, record: Mapping[str, Any]) -> None:
+        self._fh.write(json.dumps(_jsonable(record), allow_nan=False))
+        self._fh.write("\n")
+        self._fh.flush()
+
+    def log_event(self, event: TrainerEvent) -> None:
+        self.log_record(event.to_record())
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+
+def _load_summary_writer():
+    """Resolve a TensorBoard SummaryWriter class, or None when no backend is
+    installed (tensorboardX, then torch's bundled writer)."""
+    try:
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter
+    except ImportError:
+        return None
+
+
+class TensorBoardLogger(RunLogger):
+    """Scalar writer over an optional TensorBoard backend.
+
+    Missing backend → a warning once, then every call is a no-op: attaching
+    this logger can never break a training run (the optional-dependency rule
+    of utils/types.py applied to observability).
+    """
+
+    def __init__(self, log_dir: str) -> None:
+        self.log_dir = str(log_dir)
+        writer_cls = _load_summary_writer()
+        if writer_cls is None:
+            logger.warning(
+                "TensorBoardLogger: no tensorboard backend installed "
+                "(tensorboardX or torch); events will be dropped"
+            )
+            self._writer = None
+        else:
+            self._writer = writer_cls(self.log_dir)
+
+    @staticmethod
+    def _scalars(payload: Mapping[str, Any]):
+        """Numeric payload entries, flattening one dict level — the trainer
+        nests epoch/validation metrics under a ``record`` key."""
+        for key, value in payload.items():
+            if isinstance(value, Mapping):
+                for sub_key, sub_value in value.items():
+                    if not isinstance(sub_value, bool) and isinstance(sub_value, (int, float)):
+                        yield f"{key}/{sub_key}", sub_value
+            elif not isinstance(value, bool) and isinstance(value, (int, float)):
+                yield key, value
+
+    def log_event(self, event: TrainerEvent) -> None:
+        if self._writer is None:
+            return
+        step = int(event.step) if event.step is not None else 0
+        for key, value in self._scalars(event.payload):
+            tag = key if event.event == "on_train_step" else f"{event.event}/{key}"
+            self._writer.add_scalar(tag, float(value), global_step=step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+class MultiLogger(RunLogger):
+    """Fan one event stream out to several sinks."""
+
+    def __init__(self, loggers: Iterable[RunLogger]) -> None:
+        self.loggers: Sequence[RunLogger] = tuple(loggers)
+
+    def log_event(self, event: TrainerEvent) -> None:
+        for sink in self.loggers:
+            sink.log_event(event)
+
+    def close(self) -> None:
+        for sink in self.loggers:
+            sink.close()
+
+
+class ConsoleLogger(RunLogger):
+    """The old ``log_every`` print path, rebuilt on the event stream: every
+    ``every``-th *received* train-step event and every epoch record go to the
+    python logger (the trainer pre-filters the stream to the requested cadence
+    when the console is the only sink, so counting received events is exact)."""
+
+    def __init__(self, every: int = 100) -> None:
+        self.every = max(int(every), 1)
+        self._seen = 0
+
+    def log_event(self, event: TrainerEvent) -> None:
+        if event.event == "on_train_step":
+            self._seen += 1
+            if self._seen % self.every == 0:
+                logger.info(
+                    "epoch %s step %s loss %.4f",
+                    event.epoch,
+                    event.step,
+                    event.payload.get("loss", float("nan")),
+                )
+        elif event.event == "on_epoch_end":
+            logger.info("epoch %s: %s", event.epoch, event.payload.get("record"))
+        elif event.event == "on_fit_end":
+            summary = {
+                k: event.payload.get(k)
+                for k in ("telemetry", "compile", "peak_memory_bytes")
+                if k in event.payload
+            }
+            logger.info("fit complete: %s", summary)

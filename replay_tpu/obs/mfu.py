@@ -1,0 +1,73 @@
+"""Model-FLOPs-utilization: the XLA cost model and the peak-TFLOPs table.
+
+The single home of the peak dense-bf16 throughput table and the cost-model
+FLOPs extraction that ``bench.py`` and ``bench_suite.py`` previously each kept
+privately ("Demystifying BERT" argues MFU belongs in every run record, not in
+one-off bench scripts — PAPERS.md). Import-light on purpose: drivers import
+this before deciding whether jax may be imported at all (the TPU-tunnel health
+probe in bench.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# peak dense bf16 TFLOP/s per chip, keyed by substring of jax Device.device_kind
+PEAK_BF16_TFLOPS = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 46.0,
+}
+
+
+def peak_tflops(device_kind: str) -> Optional[float]:
+    """Peak dense bf16 TFLOP/s for a ``jax.Device.device_kind`` string, or
+    None for kinds without a table entry (CPU hosts, unknown chips)."""
+    kind = (device_kind or "").lower()
+    for key, peak in PEAK_BF16_TFLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def cost_analysis(jitted_fn: Any, *args, **kwargs) -> Optional[dict]:
+    """XLA's cost analysis of ``jitted_fn`` compiled for ``args`` — normalized
+    to one dict across jax versions (older versions return a per-computation
+    list), or None when the backend offers no analysis."""
+    try:
+        analysis = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
+    except Exception:  # best-effort across backends
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    return analysis if isinstance(analysis, dict) else None
+
+
+def flops_per_step(jitted_fn: Any, *args, extra_flops: float = 0.0, **kwargs) -> Optional[float]:
+    """Per-call FLOPs of a compiled step from the XLA cost model.
+
+    ``extra_flops`` adds work the cost model cannot see — pallas custom calls
+    are opaque to it, so callers add the analytic FLOPs of the kernel they
+    fused (e.g. the CEFused head: fwd 2NEI + bwd 2·2NEI).
+    """
+    analysis = cost_analysis(jitted_fn, *args, **kwargs)
+    if not analysis or "flops" not in analysis:
+        return None
+    flops = float(analysis["flops"])
+    if flops <= 0:
+        return None
+    return flops + float(extra_flops)
+
+
+def mfu(tflops_per_sec: float, device_kind: str, device_count: int = 1) -> Optional[float]:
+    """Achieved ÷ peak TFLOP/s over ``device_count`` chips, or None when the
+    chip kind has no peak entry (an MFU against an unknown peak is noise)."""
+    peak = peak_tflops(device_kind)
+    if not peak or device_count < 1:
+        return None
+    return float(tflops_per_sec) / (peak * device_count)

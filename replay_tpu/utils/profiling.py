@@ -3,6 +3,10 @@
 ``trace(dir)`` wraps a region in a jax.profiler trace viewable in TensorBoard /
 xprof; ``StepTimer`` measures steady-state steps/sec + samples/sec the way
 bench.py does (block_until_ready fencing, warmup exclusion).
+
+For per-step instantaneous rates, retrace counting and device-memory
+telemetry see :mod:`replay_tpu.obs` (``StepTelemetry`` generalizes this
+timer and feeds ``Trainer.fit``'s event stream).
 """
 
 from __future__ import annotations
@@ -43,15 +47,27 @@ class StepTimer:
             self._start = time.perf_counter()
 
     def finish(self, result=None) -> dict:
+        """Steady-state record — shape-stable: always ``steps`` (measured,
+        post-warmup), ``steps_per_sec`` and ``samples_per_sec``, NaN-filled
+        when nothing was measured, so JSONL consumers never KeyError."""
         if result is not None:
             import jax
 
             jax.block_until_ready(result)
         measured = self._count - self.warmup_steps
         if self._start is None or measured <= 0:
-            return {"steps": self._count, "steps_per_sec": float("nan")}
+            return {
+                "steps": max(measured, 0),
+                "steps_per_sec": float("nan"),
+                "samples_per_sec": float("nan"),
+            }
         elapsed = time.perf_counter() - self._start
-        out = {"steps": measured, "steps_per_sec": measured / elapsed}
-        if self.samples_per_step:
-            out["samples_per_sec"] = measured * self.samples_per_step / elapsed
-        return out
+        return {
+            "steps": measured,
+            "steps_per_sec": measured / elapsed,
+            "samples_per_sec": (
+                measured * self.samples_per_step / elapsed
+                if self.samples_per_step
+                else float("nan")
+            ),
+        }

@@ -1,0 +1,321 @@
+"""Run-telemetry subsystem: event sinks, collectors, MFU math, trainer wiring.
+
+Core tier covers the host-side pieces (loggers, telemetry math, the peak-TFLOPs
+table); the jax tier covers retrace counting and device memory; the smoke test
+drives ``Trainer.fit`` end-to-end with a ``JsonlLogger`` and asserts the
+static-shapes invariant (exactly one train-step compile across epochs) plus the
+bench driver's JSON-line contract with the new observability fields.
+"""
+
+import json
+import logging
+import math
+import time
+
+import numpy as np
+import pytest
+
+from replay_tpu.obs import (
+    CompileTracker,
+    ConsoleLogger,
+    JsonlLogger,
+    MemoryMonitor,
+    MultiLogger,
+    RunLogger,
+    StepTelemetry,
+    TensorBoardLogger,
+    TrainerEvent,
+    flops_per_step,
+    mfu,
+    peak_tflops,
+)
+from replay_tpu.obs import events as events_module
+from replay_tpu.utils import StepTimer
+
+
+class RecordingLogger(RunLogger):
+    def __init__(self):
+        self.events = []
+        self.closed = False
+
+    def log_event(self, event):
+        self.events.append(event)
+
+    def close(self):
+        self.closed = True
+
+
+# --------------------------------------------------------------------------- #
+# event layer (core)
+# --------------------------------------------------------------------------- #
+def test_trainer_event_to_record_coerces_numpy():
+    event = TrainerEvent(
+        "on_train_step",
+        step=np.int64(7),
+        epoch=1,
+        payload={
+            "loss": np.float32(1.5),
+            "arr": np.arange(3),
+            "nested": {"lr": np.float64(0.1), "flag": True},
+            "none": None,
+        },
+    )
+    record = event.to_record()
+    assert record["event"] == "on_train_step"
+    assert record["step"] == 7 and isinstance(record["step"], int)
+    assert record["loss"] == 1.5 and isinstance(record["loss"], float)
+    assert record["arr"] == [0, 1, 2]
+    assert record["nested"] == {"lr": 0.1, "flag": True}
+    assert record["none"] is None
+    json.dumps(record)  # fully JSON-able
+
+
+def test_jsonl_logger_roundtrip(tmp_path):
+    run_dir = tmp_path / "run"
+    with JsonlLogger(str(run_dir)) as sink:
+        sink.log_event(TrainerEvent("on_fit_start", payload={"epochs": 2}))
+        sink.log_event(
+            TrainerEvent("on_train_step", step=1, payload={"loss": float("nan")})
+        )
+        sink.log_record({"event": "custom", "value": np.float32(3.0)})
+    lines = [json.loads(line) for line in open(sink.path)]
+    assert [line["event"] for line in lines] == ["on_fit_start", "on_train_step", "custom"]
+    assert lines[0]["epochs"] == 2
+    # strict JSON: NaN serializes as null, but the key stays (shape-stable)
+    assert "loss" in lines[1] and lines[1]["loss"] is None
+    assert lines[2]["value"] == 3.0
+    # append mode: a second logger on the same file extends the stream
+    more = JsonlLogger(str(run_dir))
+    more.log_event(TrainerEvent("on_fit_end"))
+    more.close()
+    more.close()  # idempotent
+    assert len(open(sink.path).readlines()) == 4
+
+
+def test_multi_logger_fans_out_and_closes():
+    sinks = [RecordingLogger(), RecordingLogger()]
+    multi = MultiLogger(sinks)
+    multi.log_event(TrainerEvent("on_fit_start"))
+    multi.log_event(TrainerEvent("on_fit_end"))
+    for sink in sinks:
+        assert [e.event for e in sink.events] == ["on_fit_start", "on_fit_end"]
+    multi.close()
+    assert all(sink.closed for sink in sinks)
+
+
+def test_tensorboard_logger_missing_backend_is_noop(tmp_path, monkeypatch):
+    monkeypatch.setattr(events_module, "_load_summary_writer", lambda: None)
+    sink = TensorBoardLogger(str(tmp_path / "tb"))
+    sink.log_event(TrainerEvent("on_train_step", step=1, payload={"loss": 1.0}))
+    sink.close()  # never raises without a backend
+
+
+def test_tensorboard_logger_writes_scalars(tmp_path, monkeypatch):
+    calls = []
+
+    class FakeWriter:
+        def __init__(self, log_dir):
+            calls.append(("init", log_dir))
+
+        def add_scalar(self, tag, value, global_step=0):
+            calls.append((tag, value, global_step))
+
+        def close(self):
+            calls.append(("close",))
+
+    monkeypatch.setattr(events_module, "_load_summary_writer", lambda: FakeWriter)
+    sink = TensorBoardLogger(str(tmp_path / "tb"))
+    sink.log_event(
+        TrainerEvent(
+            "on_train_step",
+            step=5,
+            payload={"loss": 2.0, "note": "skipped", "flag": True},
+        )
+    )
+    # the trainer nests epoch/validation metrics under a dict-valued "record"
+    sink.log_event(
+        TrainerEvent(
+            "on_epoch_end", step=5, payload={"record": {"train_loss": 1.2, "ndcg@5": 0.5}}
+        )
+    )
+    sink.close()
+    assert ("loss", 2.0, 5) in calls  # train-step scalars keep bare tags
+    assert ("on_epoch_end/record/train_loss", 1.2, 5) in calls
+    assert ("on_epoch_end/record/ndcg@5", 0.5, 5) in calls
+    assert not any(tag in ("note", "flag") for tag, *_ in calls)
+    assert calls[-1] == ("close",)
+
+
+def test_console_logger_cadence(caplog):
+    sink = ConsoleLogger(every=2)
+    with caplog.at_level(logging.INFO, logger="replay_tpu"):
+        for step in range(1, 5):
+            sink.log_event(
+                TrainerEvent("on_train_step", step=step, epoch=0, payload={"loss": 1.0})
+            )
+        sink.log_event(
+            TrainerEvent("on_epoch_end", epoch=0, payload={"record": {"train_loss": 1.0}})
+        )
+    step_lines = [r for r in caplog.records if "step" in r.message]
+    assert len(step_lines) == 2  # every 2nd received event
+    assert any("epoch 0:" in r.getMessage() for r in caplog.records)
+
+
+# --------------------------------------------------------------------------- #
+# collectors (core where possible)
+# --------------------------------------------------------------------------- #
+def test_step_telemetry_rates_and_summary():
+    telemetry = StepTelemetry(warmup_steps=1, samples_per_step=4)
+    telemetry.mark()
+    first = telemetry.tick()
+    assert np.isfinite(first["samples_per_sec"])  # finite from the very first tick
+    time.sleep(0.01)
+    tick = telemetry.tick(samples=8, steps=2)
+    assert tick["steps_per_sec"] == pytest.approx(2 / (tick["step_seconds"] * 2))
+    assert tick["samples_per_sec"] == pytest.approx(tick["steps_per_sec"] * 4)
+    summary = telemetry.summary()
+    assert set(summary) == {"steps", "elapsed_seconds", "steps_per_sec", "samples_per_sec"}
+    assert summary["steps"] == 2 and np.isfinite(summary["samples_per_sec"])
+
+
+def test_step_telemetry_multi_step_first_tick_not_inflated():
+    """A first tick covering many steps (sparse log_every cadence) prorates
+    across the warmup boundary: counting its steps while starting the clock at
+    its end would double the reported steady-state rate; discarding it outright
+    would NaN short runs."""
+    telemetry = StepTelemetry(warmup_steps=1)
+    telemetry.mark()
+    time.sleep(0.02)
+    telemetry.tick(steps=100, samples=400)  # spans warmup: 99 steps prorated in
+    time.sleep(0.02)
+    telemetry.tick(steps=100, samples=400)
+    summary = telemetry.summary()
+    assert summary["steps"] == 199
+    # ~199 steps over ~0.04 s of prorated window: no 2x inflation
+    assert summary["steps_per_sec"] == pytest.approx(100 / 0.02, rel=0.5)
+
+
+def test_step_telemetry_summary_window_ends_at_last_tick():
+    """summary() after a long gap (validation, checkpointing) must not dilute
+    the steady-state rate with non-training wall time."""
+    telemetry = StepTelemetry(warmup_steps=0)
+    telemetry.mark()
+    time.sleep(0.02)
+    telemetry.tick(steps=10, samples=10)
+    rate = telemetry.summary()["steps_per_sec"]
+    time.sleep(0.05)  # "validation" happens here
+    assert telemetry.summary()["steps_per_sec"] == pytest.approx(rate, rel=0.05)
+
+
+def test_step_telemetry_mark_discounts_pauses():
+    """Re-marking after a pause (the trainer re-marks per epoch, after
+    validation/checkpointing) resumes the window without the gap."""
+    telemetry = StepTelemetry(warmup_steps=0)
+    telemetry.mark()
+    time.sleep(0.02)
+    telemetry.tick(steps=10)
+    time.sleep(0.06)  # inter-epoch validation
+    telemetry.mark()
+    time.sleep(0.02)
+    telemetry.tick(steps=10)
+    summary = telemetry.summary()
+    assert summary["steps"] == 20
+    # ~20 steps / ~0.04 s of TRAINING time; with the pause counted the rate
+    # would be ~2.5x lower and fall outside the tolerance
+    assert summary["steps_per_sec"] == pytest.approx(20 / 0.04, rel=0.4)
+
+
+def test_step_telemetry_summary_shape_stable_when_unmeasured():
+    summary = StepTelemetry().summary()
+    assert set(summary) == {"steps", "elapsed_seconds", "steps_per_sec", "samples_per_sec"}
+    assert summary["steps"] == 0
+    assert all(
+        math.isnan(summary[k])
+        for k in ("elapsed_seconds", "steps_per_sec", "samples_per_sec")
+    )
+
+
+def test_step_timer_finish_shape_stable():
+    # the satellite fix: measured <= 0 must not change the record's shape
+    empty = StepTimer(warmup_steps=5, samples_per_step=8)
+    empty.tick()
+    record = empty.finish()
+    assert set(record) == {"steps", "steps_per_sec", "samples_per_sec"}
+    assert record["steps"] == 0  # measured steps, not the raw tick count
+    assert math.isnan(record["steps_per_sec"]) and math.isnan(record["samples_per_sec"])
+    # no samples_per_step: the key is still present (NaN), never missing
+    timer = StepTimer(warmup_steps=1)
+    for _ in range(3):
+        timer.tick()
+    record = timer.finish()
+    assert record["steps"] == 2 and record["steps_per_sec"] > 0
+    assert math.isnan(record["samples_per_sec"])
+
+
+def test_peak_tflops_table_and_mfu():
+    assert peak_tflops("TPU v5 lite") == 197.0
+    assert peak_tflops("TPU v5p chip") == 459.0
+    assert peak_tflops("cpu") is None and peak_tflops("") is None
+    assert mfu(19.7, "TPU v5e") == pytest.approx(0.1)
+    assert mfu(19.7, "TPU v5e", device_count=2) == pytest.approx(0.05)
+    assert mfu(10.0, "cpu") is None  # unknown peak -> no made-up MFU
+
+
+@pytest.mark.jax
+def test_compile_tracker_counts_retraces():
+    import jax
+    import jax.numpy as jnp
+
+    tracker = CompileTracker()
+    jitted = jax.jit(tracker.wrap(lambda x: x * 2, "double"))
+    with tracker.observe("double"):
+        jitted(jnp.ones((3,)))
+    jitted(jnp.ones((3,)))  # cache hit: no retrace
+    with tracker.observe("double"):
+        jitted(jnp.ones((4,)))  # shape-unstable call: retrace
+    assert tracker.traces["double"] == 2
+    assert tracker.compile_seconds["double"] > 0
+    report = tracker.report()
+    assert report["double"]["traces"] == 2
+    assert tracker.total_compile_seconds == pytest.approx(
+        tracker.compile_seconds["double"]
+    )
+
+
+@pytest.mark.jax
+def test_compile_tracker_observe_skips_cache_hits():
+    import jax
+    import jax.numpy as jnp
+
+    tracker = CompileTracker()
+    jitted = jax.jit(tracker.wrap(lambda x: x + 1, "inc"))
+    jitted(jnp.ones((2,)))  # compile outside observe
+    with tracker.observe("inc"):
+        jitted(jnp.ones((2,)))  # cache hit: no compile time attributed
+    assert tracker.compile_seconds.get("inc", 0.0) == 0.0
+
+
+@pytest.mark.jax
+def test_memory_monitor_degrades_on_cpu():
+    monitor = MemoryMonitor()
+    snapshot = monitor.snapshot()
+    assert isinstance(snapshot, dict)  # CPU: usually {} (no allocator stats)
+    peak = monitor.peak_bytes()
+    assert peak is None or (isinstance(peak, int) and peak > 0)
+    assert monitor.bytes_in_use() is None or monitor.bytes_in_use() >= 0
+
+
+@pytest.mark.jax
+def test_flops_per_step_normalizes_cost_analysis():
+    import jax
+    import jax.numpy as jnp
+
+    jitted = jax.jit(lambda a, b: a @ b)
+    flops = flops_per_step(jitted, jnp.ones((8, 8)), jnp.ones((8, 8)))
+    assert flops is None or flops > 0  # backend-dependent, but never raises
+    assert (
+        flops_per_step(jitted, jnp.ones((8, 8)), jnp.ones((8, 8)), extra_flops=10.0)
+        == pytest.approx(flops + 10.0)
+        if flops
+        else True
+    )
