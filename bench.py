@@ -68,16 +68,33 @@ BASELINE_SAMPLES_PER_SEC = 11.07 * 512  # notebook 09 cell 28 (reference CPU box
 SIDECAR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_SIDECAR.json")
 
 
-def _backend_healthy(timeout: float = 180.0) -> bool:
+def _backend_healthy(timeout: float = 180.0, attempts: int = 2, backoff: float = 5.0) -> bool:
     """Probe the default jax backend in a THROWAWAY subprocess: a wedged device
-    tunnel blocks inside jax.devices() where no in-process timeout can reach."""
-    probe = subprocess.run(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        capture_output=True,
-        timeout=None if timeout <= 0 else timeout,
-        check=False,
-    )
-    return probe.returncode == 0
+    tunnel blocks inside jax.devices() where no in-process timeout can reach.
+
+    Bounded retry (``attempts`` total, ``backoff`` seconds apart): one
+    transient tunnel hiccup must not force the CPU-fallback path and lose a
+    real-silicon measurement window."""
+    for attempt in range(max(attempts, 1)):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                timeout=None if timeout <= 0 else timeout,
+                check=False,
+            )
+        except subprocess.TimeoutExpired:
+            probe = None
+        if probe is not None and probe.returncode == 0:
+            return True
+        if attempt + 1 < max(attempts, 1):
+            print(
+                f"bench: backend probe failed (attempt {attempt + 1}/{attempts}); "
+                f"retrying in {backoff:g}s",
+                file=sys.stderr,
+            )
+            time.sleep(backoff)
+    return False
 
 
 PROBE_TIMEOUT = float(os.environ.get("REPLAY_TPU_BENCH_PROBE_TIMEOUT", "120"))
@@ -121,10 +138,8 @@ def _reexec_on_cpu() -> None:
 def main() -> None:
     is_fallback = bool(os.environ.get("REPLAY_TPU_BENCH_FALLBACK"))
     if not is_fallback:
-        try:
-            healthy = _backend_healthy(PROBE_TIMEOUT)
-        except subprocess.TimeoutExpired:
-            healthy = False
+        # timeouts are handled (and retried once) inside the probe itself
+        healthy = _backend_healthy(PROBE_TIMEOUT)
         if not healthy:
             sidecar = _load_sidecar()
             if sidecar is not None:

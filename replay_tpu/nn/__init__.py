@@ -30,6 +30,8 @@ from .vocabulary import (
 from .train import (
     LRSchedulerFactory,
     OptimizerFactory,
+    PreemptionHandler,
+    RecoveryPolicy,
     Trainer,
     TrainState,
     make_mesh,
@@ -50,6 +52,8 @@ __all__ = [
     "OptimizerFactory",
     "PointWiseFeedForward",
     "PositionAwareAggregator",
+    "PreemptionHandler",
+    "RecoveryPolicy",
     "RMSNorm",
     "SeenItemsFilter",
     "append_item_embeddings",

@@ -26,10 +26,12 @@ padding_mask), Bert4Rec (+ token_mask) and TwoTower share one loop.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import inspect
 import logging
 import math
 import os
+import signal as _signal
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
@@ -144,12 +146,120 @@ class OptimizerFactory:
 # TrainState
 # --------------------------------------------------------------------------- #
 class TrainState(struct.PyTreeNode):
-    """Pure pytree of everything a train step mutates."""
+    """Pure pytree of everything a train step mutates.
+
+    ``bad_steps`` counts optimizer updates the non-finite sentinel discarded
+    (NaN/Inf loss or gradient norm): on such steps ``step`` and ``rng`` still
+    advance — keeping step ids aligned with the batch stream across resumes —
+    but ``params``/``opt_state`` keep their previous values.
+    """
 
     step: jnp.ndarray
     params: Any
     opt_state: Any
     rng: jnp.ndarray
+    bad_steps: jnp.ndarray
+
+
+# --------------------------------------------------------------------------- #
+# Resilience: recovery policy + preemption handling (docs/robustness.md)
+# --------------------------------------------------------------------------- #
+@dataclass
+class RecoveryPolicy:
+    """When and how ``Trainer.fit`` rolls back a diverging run.
+
+    Two triggers share one response (restore the last checkpoint — which is
+    always finite, because the sentinel never lets a non-finite update into the
+    state — and back the learning rate off by ``lr_backoff``):
+
+    * ``max_consecutive_bad`` sentinel-skipped steps in a row;
+    * a monitored-metric blowup at epoch end: the monitored value went
+      non-finite, or worsened past ``blowup_factor`` × the best seen (``mode=
+      "min"``: value > best × factor; ``mode="max"``: value < best / factor).
+      ``blowup_factor=None`` keeps only the non-finite check.
+
+    ``max_restarts`` bounds the total rollbacks for the fit call; exhausting it
+    raises ``RuntimeError`` instead of burning the remaining budget. Rollback
+    restores weights/optimizer state only — the batch stream keeps moving
+    forward, so the poisoned data window is not replayed.
+    """
+
+    max_consecutive_bad: int = 5
+    max_restarts: int = 3
+    lr_backoff: float = 0.5
+    blowup_factor: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.max_consecutive_bad < 1:
+            msg = "max_consecutive_bad must be >= 1"
+            raise ValueError(msg)
+        if self.max_restarts < 0:
+            msg = "max_restarts must be >= 0"
+            raise ValueError(msg)
+        if not 0.0 < self.lr_backoff <= 1.0:
+            msg = "lr_backoff must be in (0, 1]"
+            raise ValueError(msg)
+        if self.blowup_factor is not None and self.blowup_factor <= 1.0:
+            msg = "blowup_factor must be > 1"
+            raise ValueError(msg)
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT → request a checkpoint at the next step boundary.
+
+    ``fit`` installs one around its training loop (when a checkpoint manager is
+    attached): the first signal only sets a flag, the loop saves a
+    position-stamped mid-epoch checkpoint at the current step boundary and
+    returns cleanly, and ``fit(resume=True)`` continues from that exact batch.
+    A second signal falls through to the previously-installed handler, so a
+    double Ctrl-C still force-exits. Off the main thread ``signal.signal``
+    is unavailable — installation degrades to a no-op and the flag can only be
+    set by test harnesses calling :meth:`request` directly.
+    """
+
+    def __init__(self, signals: Sequence[int] = (_signal.SIGTERM, _signal.SIGINT)) -> None:
+        self.signals = tuple(signals)
+        self.requested = False
+        self.signal_name: Optional[str] = None
+        self._previous: Dict[int, Any] = {}
+        self._installed = False
+
+    def request(self, signum: Optional[int] = None) -> None:
+        self.requested = True
+        if signum is not None:
+            self.signal_name = _signal.Signals(signum).name
+
+    def _handle(self, signum, frame) -> None:
+        if self.requested:  # second signal: defer to the original behavior
+            previous = self._previous.get(signum)
+            if callable(previous):
+                previous(signum, frame)
+                return
+            raise KeyboardInterrupt
+        logger.warning(
+            "received %s: checkpointing at the next step boundary, then exiting",
+            _signal.Signals(signum).name,
+        )
+        self.request(signum)
+
+    def __enter__(self) -> "PreemptionHandler":
+        try:
+            for sig in self.signals:
+                self._previous[sig] = _signal.signal(sig, self._handle)
+            self._installed = True
+        except ValueError:  # not the main thread: restore what was installed
+            for sig, previous in self._previous.items():
+                _signal.signal(sig, previous)
+            self._previous.clear()
+            self._installed = False
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        if self._installed:
+            for sig, previous in self._previous.items():
+                _signal.signal(sig, previous)
+            self._previous.clear()
+            self._installed = False
 
 
 # --------------------------------------------------------------------------- #
@@ -328,6 +438,8 @@ class Trainer:
         self._eval_logits = None
         self._query_embeddings_fn = None
         self._catalog_fn = None
+        self.last_step_metrics: Optional[Dict[str, Any]] = None
+        self._lr_scale = 1.0  # RecoveryPolicy backoff multiplier (1.0 = none)
         self._forward_params = _signature_names(type(self.model).__call__)
         self._inference_params = (
             _signature_names(type(self.model).forward_inference)
@@ -377,13 +489,19 @@ class Trainer:
         if jax.process_count() > 1:
             opt_state = _globalize_scalars(self.mesh, opt_state)
             replicated = NamedSharding(self.mesh, P())
-            step, rng = (
+            step, rng, bad_steps = (
                 jax.make_array_from_process_local_data(replicated, np.asarray(v))
-                for v in (jnp.zeros((), jnp.int32), state_rng)
+                for v in (jnp.zeros((), jnp.int32), state_rng, jnp.zeros((), jnp.int32))
             )
-            return TrainState(step=step, params=params, opt_state=opt_state, rng=rng)
+            return TrainState(
+                step=step, params=params, opt_state=opt_state, rng=rng, bad_steps=bad_steps
+            )
         return TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state, rng=state_rng
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            rng=state_rng,
+            bad_steps=jnp.zeros((), jnp.int32),
         )
 
     def _forward_kwargs(self, batch: Batch, **overrides) -> Dict[str, Any]:
@@ -457,24 +575,47 @@ class Trainer:
                 )
 
             loss_value, grads = jax.value_and_grad(loss_fn)(state.params)
+            # non-finite sentinel: one fused flag decides, in-jit, whether this
+            # update may touch the state. A NaN/Inf loss or gradient norm keeps
+            # the previous params/opt_state (jnp.where select — no host round
+            # trip, static shapes preserved); step/rng still advance so step
+            # ids stay aligned with the batch stream across resumes.
+            grad_norm = optax.global_norm(grads)
+            good = jnp.isfinite(loss_value) & jnp.isfinite(grad_norm)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+
+            def keep(new, old):
+                return jnp.where(good, new, old)
+
             new_state = TrainState(
-                step=state.step + 1, params=params, opt_state=opt_state, rng=rng
+                step=state.step + 1,
+                params=jax.tree.map(keep, params, state.params),
+                opt_state=jax.tree.map(keep, opt_state, state.opt_state),
+                rng=rng,
+                bad_steps=state.bad_steps + (~good).astype(jnp.int32),
             )
-            return new_state, loss_value
+            metrics = {"loss": loss_value, "good": good, "grad_norm": grad_norm}
+            return new_state, metrics
 
         return train_step
 
     def train_step(self, state: TrainState, batch: Batch) -> Tuple[TrainState, jnp.ndarray]:
-        """One jitted optimizer step on a (data-sharded) batch."""
+        """One jitted optimizer step on a (data-sharded) batch.
+
+        Returns ``(state, loss)``; the full step metrics — ``loss``, the
+        sentinel's ``good`` flag and ``grad_norm``, all device scalars — stay
+        readable on :attr:`last_step_metrics` until the next step.
+        """
         if self._train_step is None:
             self._train_step = jax.jit(
                 self.compile_tracker.wrap(self._build_train_step(), "train_step"),
                 donate_argnums=0,
             )
         with self.compile_tracker.observe("train_step"):
-            return self._train_step(state, self._put_batch(batch))
+            new_state, metrics = self._train_step(state, self._put_batch(batch))
+        self.last_step_metrics = metrics
+        return new_state, metrics["loss"]
 
     def train_steps(
         self, state: TrainState, batches: Sequence[Batch]
@@ -498,8 +639,10 @@ class Trainer:
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *list(batches)
         )
         with self.compile_tracker.observe("train_scan"):
-            new_state, losses = self._train_scan(state, self._put_stacked(stacked))
-        return new_state, np.asarray(losses)
+            new_state, metrics = self._train_scan(state, self._put_stacked(stacked))
+        # per-step [K] arrays (loss / sentinel good flags / grad norms)
+        self.last_step_metrics = metrics
+        return new_state, np.asarray(metrics["loss"])
 
     def _put_stacked(self, stacked: Batch) -> Batch:
         """Device placement for a [K, ...] stack of batches: the per-row leaves
@@ -550,6 +693,9 @@ class Trainer:
         loggers: Optional[RunLogger | Sequence[RunLogger]] = None,
         profile_steps: Optional[Tuple[int, int]] = None,
         profile_dir: Optional[str] = None,
+        recovery: Optional[RecoveryPolicy] = None,
+        detect_anomalies: Optional[bool] = None,
+        handle_preemption: Optional[bool] = None,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`. A dict of
@@ -593,6 +739,27 @@ class Trainer:
         checkpoint and fast-forwards the (deterministic, epoch-seeded) batch
         stream to that exact position, so a killed run continues with the same
         loss curve as an uninterrupted one.
+
+        Resilience (docs/robustness.md): the train step's non-finite sentinel
+        always protects the state — a NaN/Inf loss or gradient norm discards
+        that update in-jit and bumps ``state.bad_steps``. ``detect_anomalies``
+        additionally checks the sentinel flag on host every step and emits an
+        ``on_anomaly`` event per skipped step (default: on when ``recovery`` is
+        set or explicit ``loggers`` are attached — those paths already pay the
+        per-step device sync; off for log_every-only runs, which stay
+        sync-free). A ``recovery`` policy counts bad steps regardless:
+        ``detect_anomalies=False`` silences the events, never the rollback
+        trigger. ``recovery`` attaches a :class:`RecoveryPolicy`: after
+        ``max_consecutive_bad`` skipped steps or an epoch-end monitored-metric
+        blowup, fit restores the manager's latest checkpoint (or, before any
+        save, a snapshot of the initial state), backs the learning rate off,
+        emits ``on_recovery`` and continues forward in the batch stream —
+        bounded by ``max_restarts``, then ``RuntimeError``. ``handle_preemption``
+        (default: on when a ``checkpoint_manager`` is attached) installs
+        SIGTERM/SIGINT handlers for the duration of the loop: the first signal
+        saves a position-stamped mid-epoch checkpoint at the next step boundary
+        and returns the state cleanly, so ``fit(resume=True)`` reproduces the
+        uninterrupted run exactly; a second signal force-exits.
         """
         if checkpoint_manager is not None and not self.history:
             # resume: prior epoch records survive the restart (metric-history
@@ -651,6 +818,10 @@ class Trainer:
                         "restore_checkpoint and pass state= instead."
                     )
                     raise ValueError(msg)
+                if meta.get("lr_scale"):
+                    # the killed run had backed its LR off (RecoveryPolicy);
+                    # resuming at full rate would rerun the divergence
+                    self._set_lr_scale(float(meta["lr_scale"]))
                 pending_restore_step = latest
                 resumed_best_step = checkpoint_manager.best_step()
                 logger.info(
@@ -694,6 +865,85 @@ class Trainer:
                     TrainerEvent(event=name, step=step, epoch=epoch, payload=payload)
                 )
 
+        # -- resilience: anomaly detection / recovery / preemption ---------- #
+        # host-side anomaly checks cost one device sync per step, so they
+        # default on only where that sync already happens (explicit loggers)
+        # or where they are required (a recovery policy); the in-jit sentinel
+        # itself is always active and needs no host involvement
+        check_anomalies = (
+            detect_anomalies
+            if detect_anomalies is not None
+            else (recovery is not None or bool(explicit_loggers))
+        )
+        consecutive_bad, restarts = 0, 0
+        initial_snapshot = None  # rollback target before any checkpoint exists
+
+        def do_recovery(reason: str, epoch: int) -> TrainState:
+            """Roll back to the last checkpoint (else the initial snapshot),
+            back the LR off, and return the state to continue from. The batch
+            stream is NOT rewound — recovery moves forward through the data."""
+            nonlocal restarts, consecutive_bad, step_base
+            restarts += 1
+            consecutive_bad = 0
+            step_base = None  # state.step jumps backward: refetch the base
+            if restarts > recovery.max_restarts:
+                emit("on_recovery", epoch=epoch, reason=reason, restarts=restarts,
+                     exhausted=True)
+                msg = (
+                    f"RecoveryPolicy budget exhausted: {restarts - 1} restarts "
+                    f"(max_restarts={recovery.max_restarts}) did not stabilize "
+                    f"the run (last trigger: {reason})"
+                )
+                raise RuntimeError(msg)
+            target = (
+                checkpoint_manager.latest_step() if checkpoint_manager is not None else None
+            )
+            if target is not None:
+                restored = checkpoint_manager.restore(state, step=target)
+                new_state = _place_tree(
+                    restored, jax.tree.map(self._template_sharding, state)
+                )
+            else:
+                new_state = jax.tree.map(lambda x: x.copy(), initial_snapshot)
+            self._set_lr_scale(self._lr_scale * recovery.lr_backoff)
+            logger.warning(
+                "recovery %d/%d (%s): rolled back to %s, lr scale now %.3g",
+                restarts, recovery.max_restarts, reason,
+                f"checkpoint step {target}" if target is not None else "initial state",
+                self._lr_scale,
+            )
+            emit("on_recovery", step=int(new_state.step), epoch=epoch, reason=reason,
+                 restarts=restarts, restored_step=target, lr_scale=self._lr_scale)
+            return new_state
+
+        def save_mid_epoch(preempted: bool = False) -> None:
+            # ONE position-stamping path for periodic and preemption saves:
+            # resume reads the same metadata either way (epoch/n_steps are the
+            # loop's live values at call time)
+            extra: Dict[str, Any] = {"preempted": True} if preempted else {}
+            if self._lr_scale != 1.0:  # recovery backoff survives the resume
+                extra["lr_scale"] = self._lr_scale
+            checkpoint_manager.save(
+                int(state.step),
+                state,
+                history=self.history,
+                metadata={
+                    "mid_epoch": True,
+                    "epoch": epoch,
+                    "step_in_epoch": n_steps,
+                    **extra,
+                },
+            )
+            emit("on_checkpoint", step=int(state.step), epoch=epoch,
+                 mid_epoch=True, step_in_epoch=n_steps, **extra)
+
+        install_preemption = (
+            handle_preemption
+            if handle_preemption is not None
+            else checkpoint_manager is not None
+        )
+        preemption = PreemptionHandler() if install_preemption else None
+
         telemetry = StepTelemetry(warmup_steps=1)
         memory = MemoryMonitor()
         lr_schedule = (
@@ -703,17 +953,23 @@ class Trainer:
         )
 
         def current_lr(step: int) -> float:
+            # _lr_scale read at call time: recovery backoff shows up immediately
+            # (every schedule kind is linear in its peak rate, so scaling the
+            # schedule value equals rebuilding the schedule from the scaled lr)
             if lr_schedule is None:
-                return float(self.optimizer.learning_rate)
-            return float(lr_schedule(step))
+                return float(self.optimizer.learning_rate) * self._lr_scale
+            return float(lr_schedule(step)) * self._lr_scale
 
         def fit_end_payload() -> Dict[str, Any]:
-            return {
+            payload = {
                 "telemetry": telemetry.summary(),
                 "compile": self.compile_tracker.report(),
                 "peak_memory_bytes": memory.peak_bytes(),
                 "history_len": len(self.history),
             }
+            if state is not None:  # sentinel-skipped updates over the run
+                payload["bad_steps"] = int(state.bad_steps)
+            return payload
 
         emit(
             "on_fit_start",
@@ -782,12 +1038,15 @@ class Trainer:
             return _place_tree(restored, jax.tree.map(self._template_sharding, template))
 
         stopped_early = False
-        with profile_stack:  # closes a still-open profiler window on any exit
+        # profile_stack closes a still-open profiler window on any exit; the
+        # preemption handler restores the previous SIGTERM/SIGINT handlers
+        with profile_stack, (preemption or contextlib.nullcontext()):
             for epoch in range(start_epoch, epochs):
                 # n_steps = position in the epoch's batch stream (skipped batches
                 # included, keeping checkpoint_every aligned across resumes);
-                # measured_steps = batches that actually trained THIS process
-                epoch_loss, n_steps, measured_steps = None, 0, 0
+                # epoch_good = device count of batches that actually trained AND
+                # passed the sentinel on THIS process
+                epoch_loss, epoch_good, n_steps = None, None, 0
                 skipped = 0
                 epoch_needs_mark = True  # re-mark per epoch: discounts the
                 # inter-epoch validation/checkpoint gap from the telemetry window
@@ -807,6 +1066,10 @@ class Trainer:
                                 restored, jax.tree.map(self._template_sharding, state)
                             )
                             pending_restore_step = None
+                    if recovery is not None and initial_snapshot is None:
+                        # rollback target until the first checkpoint lands;
+                        # .copy() detaches from the donation chain
+                        initial_snapshot = jax.tree.map(lambda x: x.copy(), state)
                     if epoch == start_epoch and skipped < skip_steps:
                         # fast-forward: the batch stream is deterministic per epoch,
                         # so consuming without stepping lands on the exact position
@@ -826,11 +1089,41 @@ class Trainer:
                         profile_stack.enter_context(trace(resolved_profile_dir()))
                         profile_active = True
                     state, loss_value = self.train_step(state, batch)
-                    # accumulate on device: float() here would sync every step
-                    epoch_loss = loss_value if epoch_loss is None else epoch_loss + loss_value
+                    step_metrics = self.last_step_metrics
+                    # accumulate on device: float() here would sync every step.
+                    # Sentinel-skipped steps contribute 0 (their loss is
+                    # non-finite and would poison the epoch average).
+                    safe_loss = jnp.where(step_metrics["good"], loss_value, 0.0)
+                    epoch_loss = safe_loss if epoch_loss is None else epoch_loss + safe_loss
+                    good_flag = step_metrics["good"].astype(jnp.int32)
+                    epoch_good = good_flag if epoch_good is None else epoch_good + good_flag
                     n_steps += 1
-                    measured_steps += 1
                     measured_total += 1
+                    if check_anomalies or recovery is not None:
+                        # a recovery policy must see every bad step even when
+                        # detect_anomalies=False silenced the event emission
+                        if not bool(step_metrics["good"]):
+                            consecutive_bad += 1
+                            if check_anomalies:
+                                emit(
+                                    "on_anomaly",
+                                    step=int(state.step),
+                                    epoch=epoch,
+                                    loss=float(loss_value),
+                                    grad_norm=float(step_metrics["grad_norm"]),
+                                    consecutive_bad=consecutive_bad,
+                                    bad_steps_total=int(state.bad_steps),
+                                )
+                            if (
+                                recovery is not None
+                                and consecutive_bad >= recovery.max_consecutive_bad
+                            ):
+                                state = do_recovery("consecutive_bad_steps", epoch)
+                                # the epoch average must describe the RESTORED
+                                # trajectory, not the discarded one
+                                epoch_loss, epoch_good = None, None
+                        else:
+                            consecutive_bad = 0
                     if profile_active and measured_total >= profile_stop:
                         profile_stack.close()
                         profile_active = False
@@ -854,29 +1147,42 @@ class Trainer:
                             steps_per_sec=tick["steps_per_sec"],
                             step_seconds=tick["step_seconds"],
                         )
+                    boundary_saved = False
                     if (
                         checkpoint_every
                         and checkpoint_manager is not None
                         and n_steps % checkpoint_every == 0
                     ):
-                        checkpoint_manager.save(
+                        save_mid_epoch()
+                        boundary_saved = True
+                    if preemption is not None and preemption.requested:
+                        # the signal handler only set a flag; this is the step
+                        # boundary it asked for — save a position-stamped
+                        # checkpoint and exit cleanly (resume=True continues
+                        # from this exact batch). A periodic save that just
+                        # landed on this same step already recorded the
+                        # position — don't serialize the state twice in the
+                        # shutdown window.
+                        if checkpoint_manager is not None and not boundary_saved:
+                            save_mid_epoch(preempted=True)
+                        emit("on_preemption", step=int(state.step), epoch=epoch,
+                             signal=preemption.signal_name)
+                        logger.warning(
+                            "preemption: checkpoint saved at step %d; exiting fit",
                             int(state.step),
-                            state,
-                            history=self.history,
-                            metadata={
-                                "mid_epoch": True, "epoch": epoch, "step_in_epoch": n_steps,
-                            },
                         )
-                        emit("on_checkpoint", step=int(state.step), epoch=epoch,
-                             mid_epoch=True, step_in_epoch=n_steps)
+                        emit("on_fit_end", step=int(state.step), epoch=epoch,
+                             preempted=True, **fit_end_payload())
+                        return state
+                # a resumed epoch averages only the steps THIS process ran, and
+                # the average runs over sentinel-approved steps only (skipped
+                # steps contributed 0 loss); NaN when nothing was measured or
+                # every measured step was bad
+                good_count = int(epoch_good) if epoch_good is not None else 0
                 record = {
                     "epoch": epoch,
-                    # a resumed epoch averages only the steps THIS process ran;
-                    # NaN when every batch was fast-forwarded (nothing measured)
                     "train_loss": (
-                        float(epoch_loss) / measured_steps
-                        if measured_steps
-                        else float("nan")
+                        float(epoch_loss) / good_count if good_count else float("nan")
                     ),
                 }
                 if event_every and measured_total > last_emitted_at:
@@ -914,6 +1220,37 @@ class Trainer:
                     # per-epoch record line predates the event layer and stays
                     logger.info("epoch %d: %s", epoch, record)
 
+                if (
+                    recovery is not None
+                    and monitor is not None
+                    and monitor in record
+                    # epoch_good is None when nothing fed the average — a
+                    # fully-fast-forwarded resumed epoch, or a mid-epoch
+                    # rollback that already answered this incident (the reset
+                    # above) — so the NaN record must not burn a second restart
+                    and epoch_good is not None
+                ):
+                    # epoch-level blowup guard: the monitored value went
+                    # non-finite, or worsened past blowup_factor x the best —
+                    # roll back BEFORE this epoch's checkpoint could become the
+                    # rollback target, and skip its best-tracking entirely
+                    value = float(record[monitor])
+                    blown = not math.isfinite(value)
+                    if (
+                        not blown
+                        and recovery.blowup_factor is not None
+                        and best_value is not None
+                        and math.isfinite(best_value)
+                    ):
+                        blown = (
+                            value > best_value * recovery.blowup_factor
+                            if mode == "min"
+                            else value < best_value / recovery.blowup_factor
+                        )
+                    if blown:
+                        state = do_recovery("metric_blowup", epoch)
+                        continue
+
                 improved = False
                 if monitor is not None:
                     if monitor not in record:
@@ -934,6 +1271,8 @@ class Trainer:
                         stale_epochs += 1
                 if checkpoint_manager is not None and state is not None:
                     metadata = {"epoch": epoch}
+                    if self._lr_scale != 1.0:  # recovery backoff survives resume
+                        metadata["lr_scale"] = self._lr_scale
                     if monitor:
                         metadata.update({"best": improved, monitor: value})
                     checkpoint_manager.save(
@@ -1172,8 +1511,25 @@ class Trainer:
         if jax.process_count() > 1:
             opt_state = _globalize_scalars(self.mesh, opt_state)
         return TrainState(
-            step=state.step, params=params, opt_state=opt_state, rng=state.rng
+            step=state.step,
+            params=params,
+            opt_state=opt_state,
+            rng=state.rng,
+            bad_steps=state.bad_steps,
         )
+
+    def _set_lr_scale(self, scale: float) -> None:
+        """Rebuild the optimizer with the base learning rate scaled by
+        ``scale`` (RecoveryPolicy backoff). The optax state layout is identical
+        for any LR, so a restored ``opt_state`` keeps working; the jitted step
+        functions are invalidated (one retrace per rollback — rare by design)."""
+        self._lr_scale = float(scale)
+        factory = dataclasses.replace(
+            self.optimizer, learning_rate=self.optimizer.learning_rate * self._lr_scale
+        )
+        self._tx = factory.create()
+        self._train_step = None
+        self._train_scan = None
 
     # -- checkpointing ------------------------------------------------------ #
     def save_checkpoint(

@@ -8,10 +8,17 @@ Event flow emitted by ``replay_tpu.nn.Trainer.fit``::
 
     on_fit_start
       on_train_step*          (loss, lr, samples_per_sec, step_seconds)
+      on_anomaly*             (a non-finite step the sentinel skipped:
+                               loss, grad_norm, consecutive_bad)
+      on_recovery*            (RecoveryPolicy rollback: reason, restored_step,
+                               lr_scale, restarts)
       on_validation_end?      (the epoch's metric record, when validating)
       on_epoch_end            (the full history record)
       on_checkpoint?          (every checkpoint save, incl. mid-epoch)
-    on_fit_end                (telemetry summary, compile report, peak memory)
+      on_preemption?          (SIGTERM/SIGINT honored: checkpoint saved,
+                               fit exits cleanly for resume=True)
+    on_fit_end                (telemetry summary, compile report, peak memory,
+                               sentinel bad_steps total)
 
 Every event flattens to one JSON-able dict (``event`` + ``time`` + optional
 ``step``/``epoch`` + the payload), so a run directory's ``events.jsonl`` is a
@@ -225,6 +232,27 @@ class ConsoleLogger(RunLogger):
                     event.step,
                     event.payload.get("loss", float("nan")),
                 )
+        elif event.event == "on_anomaly":
+            logger.warning(
+                "anomaly at step %s: non-finite loss/grads, update skipped "
+                "(%s consecutive)",
+                event.step,
+                event.payload.get("consecutive_bad"),
+            )
+        elif event.event == "on_recovery":
+            logger.warning(
+                "recovery (%s): rolled back to step %s, lr scale %s, restart %s",
+                event.payload.get("reason"),
+                event.payload.get("restored_step"),
+                event.payload.get("lr_scale"),
+                event.payload.get("restarts"),
+            )
+        elif event.event == "on_preemption":
+            logger.warning(
+                "preemption (%s) at step %s: checkpoint saved, exiting",
+                event.payload.get("signal"),
+                event.step,
+            )
         elif event.event == "on_epoch_end":
             logger.info("epoch %s: %s", event.epoch, event.payload.get("record"))
         elif event.event == "on_fit_end":

@@ -12,17 +12,40 @@ pattern) so optax NamedTuple internals never need to be serialized structurally 
 the template supplies the treedef, the npz supplies the arrays, and shapes are
 validated leaf-by-leaf. Works for sharded arrays: leaves are gathered to host on
 save and re-placed by the trainer's shardings on the next device_put.
+
+Crash consistency (docs/robustness.md): every file is written to a ``.tmp``
+sibling, fsynced and ``os.replace``d into place — a SIGKILL mid-save can leave
+a stray temp file, never a half-written visible one. The JSON sidecar lands
+LAST, so its presence is the commit marker: ``CheckpointManager`` treats a
+payload without a sidecar (or with an unreadable one) as an aborted save and
+skips it on resume instead of raising mid-restore.
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import os
 import shutil
+import zipfile
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
+
+logger = logging.getLogger("replay_tpu")
+
+
+def _atomic_replace(path: Path, write) -> None:
+    """Write via ``write(fh)`` into ``<path>.tmp``, fsync, then rename into
+    place — readers only ever observe absent or complete files."""
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as fh:
+        write(fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
 
 
 def save_pytree(
@@ -56,14 +79,20 @@ def save_pytree(
         checkpointer.wait_until_finished()
     elif backend == "npz":
         arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
-        np.savez(str(target.with_suffix(".npz")), **arrays)
+        # tmp + os.replace: a preemption mid-write leaves a stray .tmp, never a
+        # truncated .npz under the visible name (np.savez accepts a handle, so
+        # no implicit-.npz-suffix surprises on the temp path)
+        _atomic_replace(target.with_suffix(".npz"), lambda fh: np.savez(fh, **arrays))
     else:
         msg = f"Unknown checkpoint backend: {backend}"
         raise ValueError(msg)
     # reserved keys win over caller metadata: restore routes on "backend"
     meta = {**(metadata or {}), "num_leaves": len(leaves), "backend": backend}
     if jax.process_index() == 0:  # one writer for the shared-fs sidecar
-        target.with_suffix(".json").write_text(json.dumps(meta))
+        # the sidecar is the commit marker and therefore lands last, atomically
+        _atomic_replace(
+            target.with_suffix(".json"), lambda fh: fh.write(json.dumps(meta).encode())
+        )
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -161,6 +190,8 @@ class CheckpointManager:
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
         self.backend = backend
+        # steps whose files failed the last integrity scan (see valid_steps)
+        self.skipped_steps: List[int] = []
 
     def _step_path(self, step: int) -> Path:
         return self.directory / f"step_{step}"
@@ -178,8 +209,45 @@ class CheckpointManager:
         """The JSON metadata saved alongside checkpoint ``step``."""
         return load_metadata(str(self._step_path(step)))
 
+    # -- integrity --------------------------------------------------------- #
+    def _payload_ok(self, step: int) -> bool:
+        """Cheap payload probe: an npz must open as a zip archive (a truncated
+        half-written file has no central directory and fails immediately, no
+        array reads); an orbax checkpoint must have its directory."""
+        npz = self._step_path(step).with_suffix(".npz")
+        if npz.exists():
+            try:
+                zipfile.ZipFile(npz).close()
+                return True
+            except (zipfile.BadZipFile, OSError):
+                return False
+        return (self.directory / f"step_{step}.orbax").exists()
+
+    def _step_valid(self, step: int) -> bool:
+        try:
+            meta = self.metadata(step)
+        except (OSError, ValueError):  # missing or unparseable sidecar
+            return False
+        return isinstance(meta, dict) and self._payload_ok(step)
+
+    def valid_steps(self) -> List[int]:
+        """``all_steps()`` minus incomplete or corrupt entries — those are
+        reported (warning + :attr:`skipped_steps`) and skipped, so a save
+        interrupted by preemption never breaks the next ``resume=True``."""
+        good: List[int] = []
+        bad: List[int] = []
+        for step in self.all_steps():
+            (good if self._step_valid(step) else bad).append(step)
+        if bad:
+            logger.warning(
+                "skipping incomplete/corrupt checkpoint step(s) %s in %s",
+                bad, self.directory,
+            )
+        self.skipped_steps = bad
+        return good
+
     def latest_step(self) -> Optional[int]:
-        steps = self.all_steps()
+        steps = self.valid_steps()
         return steps[-1] if steps else None
 
     def save(
@@ -196,7 +264,12 @@ class CheckpointManager:
         if jax.process_index() != 0:
             return  # save_pytree already barriered; one process rotates/records
         if history is not None:
-            (self.directory / "history.json").write_text(json.dumps(history))
+            # atomic like every other file here: a torn history.json would
+            # crash the next resume's checkpoint_manager.history() read
+            _atomic_replace(
+                self.directory / "history.json",
+                lambda fh: fh.write(json.dumps(history).encode()),
+            )
         protected = self.best_step()
         for old in self.all_steps()[: -self.max_to_keep]:
             if old == protected:  # the monitored winner survives rotation
@@ -206,18 +279,18 @@ class CheckpointManager:
     # -- monitored-best tracking ------------------------------------------- #
     def mark_best(self, step: int) -> None:
         """Record ``step`` as the monitored winner (survives rotation)."""
-        (self.directory / "best.json").write_text(json.dumps({"step": step}))
+        _atomic_replace(
+            self.directory / "best.json",
+            lambda fh: fh.write(json.dumps({"step": step}).encode()),
+        )
 
     def best_step(self) -> Optional[int]:
         path = self.directory / "best.json"
         if not path.exists():
             return None
         step = json.loads(path.read_text())["step"]
-        payload_exists = (
-            self._step_path(step).with_suffix(".npz").exists()
-            or (self.directory / f"step_{step}.orbax").exists()
-        )
-        return step if payload_exists else None
+        # a best.json pointing at a deleted or corrupt step is stale, not fatal
+        return step if self._step_valid(step) else None
 
     def restore_best(self, template: Any) -> Any:
         """Restore the monitored-best checkpoint (falls back to the latest)."""
@@ -225,11 +298,48 @@ class CheckpointManager:
         return self.restore(template, step=step)
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
+        """Restore checkpoint ``step`` (default: the latest VALID one).
+
+        The step's metadata is validated before unflattening — a corrupt
+        sidecar, a leaf-count mismatch against the template, or a truncated
+        payload each raise a ``ValueError`` naming the offending step instead
+        of a bare deserialization traceback."""
         step = step if step is not None else self.latest_step()
         if step is None:
             msg = f"No checkpoints found in {self.directory}"
             raise FileNotFoundError(msg)
-        return restore_pytree(str(self._step_path(step)), template)
+        path = self._step_path(step)
+        try:
+            meta = load_metadata(str(path))
+        except FileNotFoundError:
+            msg = f"Checkpoint step_{step} not found in {self.directory}"
+            raise FileNotFoundError(msg) from None
+        except (OSError, ValueError) as exc:
+            msg = (
+                f"Checkpoint step_{step} in {self.directory} has an unreadable "
+                f"metadata sidecar ({exc}) — the save was likely interrupted; "
+                "delete the step files or restore an earlier step."
+            )
+            raise ValueError(msg) from exc
+        num_leaves = meta.get("num_leaves") if isinstance(meta, dict) else None
+        expected = len(jax.tree.leaves(template))
+        if num_leaves is not None and num_leaves != expected:
+            msg = (
+                f"Checkpoint step_{step} records num_leaves={num_leaves} but the "
+                f"template has {expected} leaves — saved from an incompatible "
+                "model/optimizer config, or by an older replay_tpu version with "
+                "a different TrainState layout."
+            )
+            raise ValueError(msg)
+        try:
+            return restore_pytree(str(path), template)
+        except (zipfile.BadZipFile, EOFError, KeyError, OSError) as exc:
+            msg = (
+                f"Checkpoint step_{step} in {self.directory} is corrupt or "
+                f"incomplete ({type(exc).__name__}: {exc}); delete it or "
+                "restore an earlier step."
+            )
+            raise ValueError(msg) from exc
 
     def history(self) -> List[Dict[str, float]]:
         path = self.directory / "history.json"

@@ -1,0 +1,124 @@
+"""Deterministic fault injection for the resilience layer (docs/robustness.md).
+
+No reference-stack counterpart (Lightning tests its fault-tolerant loop with
+ad-hoc monkeypatching); here the failure modes the trainer must survive —
+NaN batches, preemption signals, truncated checkpoint files — are injected
+through one small harness so every recovery path in
+``tests/nn/test_fault_tolerance.py`` is exercised reproducibly on the
+8-device virtual CPU mesh:
+
+* :class:`NaNInjector` poisons chosen batches of a stream (exercises the
+  in-jit non-finite sentinel and ``RecoveryPolicy`` rollback);
+* :class:`SignalAtStep` raises a real SIGTERM/SIGINT at a chosen batch index
+  (exercises :class:`~replay_tpu.nn.train.PreemptionHandler` end-to-end,
+  through the actual OS signal machinery);
+* :func:`truncate_file` chops a checkpoint payload as a crash mid-write would
+  (exercises ``CheckpointManager``'s skip-and-report integrity scan).
+
+Injection positions are 0-based GLOBAL batch indices counted across every
+``wrap`` call of one injector instance, so a multi-epoch ``fit`` stream hits
+the same absolute steps regardless of epoch boundaries.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Dict, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def inject_nan(batch: Dict[str, Any], fields: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+    """A copy of ``batch`` with every float leaf replaced by all-NaN.
+
+    Integer/bool leaves (ids, masks, labels) pass through untouched — a "NaN
+    batch" means the continuous features are poisoned, which drives the loss
+    AND every gradient non-finite in one forward/backward. ``fields`` narrows
+    the poisoning to the given top-level batch keys. Raises if nothing was
+    poisoned: a silently-clean "fault" would make a recovery test vacuous.
+    """
+
+    poisoned = 0
+
+    def poison(value):
+        nonlocal poisoned
+        if isinstance(value, dict):
+            return {key: poison(item) for key, item in value.items()}
+        if isinstance(value, (list, tuple)):
+            return type(value)(poison(item) for item in value)
+        array = np.asarray(value)
+        if np.issubdtype(array.dtype, np.floating):
+            poisoned += 1
+            return np.full_like(array, np.nan)
+        return value
+
+    out = {
+        key: (poison(value) if fields is None or key in fields else value)
+        for key, value in batch.items()
+    }
+    if not poisoned:
+        msg = (
+            "inject_nan found no float leaves to poison "
+            f"(fields={list(fields) if fields is not None else 'all'}); "
+            "the batch must carry at least one float feature for a NaN fault"
+        )
+        raise ValueError(msg)
+    return out
+
+
+class NaNInjector:
+    """Poison the batches at the given global stream positions.
+
+    >>> injector = NaNInjector(at_steps=(2, 5))
+    >>> # trainer.fit(lambda epoch: injector.wrap(make_batches(epoch)), ...)
+    """
+
+    def __init__(self, at_steps: Iterable[int], fields: Optional[Sequence[str]] = None) -> None:
+        self.at_steps = frozenset(int(s) for s in at_steps)
+        self.fields = fields
+        self.position = 0  # global batch index across wrap() calls
+        self.injected_at: list = []
+
+    def wrap(self, batches: Iterable[Dict[str, Any]]) -> Iterator[Dict[str, Any]]:
+        for batch in batches:
+            if self.position in self.at_steps:
+                self.injected_at.append(self.position)
+                batch = inject_nan(batch, self.fields)
+            self.position += 1
+            yield batch
+
+
+class SignalAtStep:
+    """Raise a real OS signal just before yielding batch ``at_step``.
+
+    The default SIGTERM models a preemption notice arriving while the trainer
+    is fetching data; with ``fit``'s PreemptionHandler installed the flag is
+    set immediately and honored at the next step boundary. Fires at most once
+    per instance.
+    """
+
+    def __init__(self, at_step: int, sig: int = signal.SIGTERM) -> None:
+        self.at_step = int(at_step)
+        self.sig = sig
+        self.position = 0  # global batch index across wrap() calls
+        self.raised = False
+
+    def wrap(self, batches: Iterable[Dict[str, Any]]) -> Iterator[Dict[str, Any]]:
+        for batch in batches:
+            if self.position == self.at_step and not self.raised:
+                self.raised = True
+                signal.raise_signal(self.sig)
+            self.position += 1
+            yield batch
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5, keep_bytes: Optional[int] = None) -> int:
+    """Truncate ``path`` in place — the on-disk state a crash mid-write leaves
+    behind (for non-atomic writers) or a partially-synced copy. Returns the
+    new size in bytes."""
+    size = os.path.getsize(path)
+    keep = int(size * keep_fraction) if keep_bytes is None else min(keep_bytes, size)
+    with open(path, "r+b") as fh:
+        fh.truncate(keep)
+    return keep

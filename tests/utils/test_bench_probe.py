@@ -1,0 +1,69 @@
+"""bench.py backend-health probe: bounded retry-with-backoff semantics.
+
+One transient tunnel hiccup (a failed or timed-out probe subprocess) must not
+force the CPU-fallback path; a persistently dead backend must still fail fast
+after the bounded attempts.
+"""
+
+import importlib.util
+import os
+import subprocess
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench", os.path.join(REPO, "bench.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.mark.core
+def test_probe_retries_once_after_transient_failure(bench, monkeypatch):
+    calls = []
+    sleeps = []
+
+    def flaky_run(*args, **kwargs):
+        calls.append(args)
+        returncode = 1 if len(calls) == 1 else 0
+        return types.SimpleNamespace(returncode=returncode)
+
+    monkeypatch.setattr(bench.subprocess, "run", flaky_run)
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    assert bench._backend_healthy(timeout=1.0, attempts=2, backoff=0.01) is True
+    assert len(calls) == 2  # first failed, retry succeeded
+    assert sleeps == [0.01]  # backed off exactly once
+
+
+@pytest.mark.core
+def test_probe_timeout_counts_as_failed_attempt(bench, monkeypatch):
+    calls = []
+
+    def timing_out_run(cmd, **kwargs):
+        calls.append(cmd)
+        if len(calls) == 1:
+            raise subprocess.TimeoutExpired(cmd=cmd, timeout=kwargs.get("timeout") or 0)
+        return types.SimpleNamespace(returncode=0)
+
+    monkeypatch.setattr(bench.subprocess, "run", timing_out_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda _: None)
+    assert bench._backend_healthy(timeout=1.0, attempts=2, backoff=0.0) is True
+    assert len(calls) == 2
+
+
+@pytest.mark.core
+def test_probe_gives_up_after_bounded_attempts(bench, monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        bench.subprocess,
+        "run",
+        lambda *a, **k: (calls.append(a), types.SimpleNamespace(returncode=1))[1],
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda _: None)
+    assert bench._backend_healthy(timeout=1.0, attempts=2, backoff=0.0) is False
+    assert len(calls) == 2  # bounded: no endless retry loop

@@ -379,6 +379,70 @@ def test_resume_already_complete_returns_checkpoint(tmp_path):
 
 
 @pytest.mark.jax
+def test_resume_when_best_step_points_at_deleted_step(tmp_path):
+    """best.json referencing a step whose files were deleted (manual cleanup,
+    over-eager retention) is stale, not fatal: best_step() returns None and a
+    monitored resume completes, re-deriving the best from the restored
+    history."""
+
+    def train_batches(epoch: int):
+        return [make_batch(epoch * 10 + i) for i in range(3)]
+
+    trainer_a = make_trainer()
+    manager = CheckpointManager(str(tmp_path / "run"), max_to_keep=100)
+    trainer_a.fit(
+        train_batches, epochs=2, checkpoint_manager=manager, monitor="train_loss",
+        mode="min",
+    )
+    best = manager.best_step()
+    assert best is not None
+    manager._delete_step(best)  # best.json now dangles
+    assert manager.best_step() is None
+
+    trainer_b = make_trainer()
+    state_b = trainer_b.fit(
+        train_batches, epochs=3, checkpoint_manager=manager, monitor="train_loss",
+        mode="min", resume=True,
+    )
+    # the deleted best forced the resume back to the previous checkpoint, so
+    # its epoch is replayed (one duplicate record); the run then completes
+    assert trainer_b.history[-1]["epoch"] == 2
+    assert np.isfinite(trainer_b.history[-1]["train_loss"])
+    assert int(state_b.step) > 0
+    assert manager.best_step() is not None  # a fresh best was re-marked
+
+
+@pytest.mark.jax
+def test_resume_after_interrupted_final_save(tmp_path):
+    """A run whose final save was interrupted (truncated payload) resumes from
+    the previous intact checkpoint and reproduces the uninterrupted final
+    state exactly."""
+    from replay_tpu.utils.faults import truncate_file
+
+    def train_batches(epoch: int):
+        return [make_batch(epoch * 10 + i) for i in range(3)]
+
+    trainer_a = make_trainer()
+    manager = CheckpointManager(str(tmp_path / "run"), max_to_keep=100)
+    state_a = trainer_a.fit(train_batches, epochs=2, checkpoint_manager=manager)
+    final = manager.latest_step()
+    truncate_file(str(tmp_path / "run" / f"step_{final}.npz"), keep_fraction=0.5)
+
+    assert manager.latest_step() == 3  # epoch-0 checkpoint survives the scan
+    assert manager.skipped_steps == [final]
+    trainer_b = make_trainer()
+    state_b = trainer_b.fit(
+        train_batches, epochs=2, checkpoint_manager=manager, resume=True
+    )
+    assert int(state_b.step) == int(state_a.step)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        state_a.params,
+        state_b.params,
+    )
+
+
+@pytest.mark.jax
 def test_resume_already_complete_returns_monitored_best(tmp_path):
     """When the finished run tracked a monitor, re-running with resume=True must
     hand back the BEST checkpoint (what the original fit returned), not the
