@@ -16,10 +16,14 @@ NamedSharding in the trainer.
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+if TYPE_CHECKING:  # import-light: the tracer is optional, duck-typed at runtime
+    from replay_tpu.obs.trace import Tracer
 
 from replay_tpu.data.nn.partitioning import Partitioning
 from replay_tpu.data.nn.sequential_dataset import SequentialDataset
@@ -63,6 +67,11 @@ class SequenceBatcher:
         length (the SURVEY §7 padding-waste mitigation). XLA compiles one
         program per distinct shape — a handful of buckets, not per-batch
         dynamic shapes. ``max_sequence_length`` remains the top bucket.
+    :param tracer: optional :class:`replay_tpu.obs.Tracer`: every batch
+        assembly is recorded as a ``batch_build`` span. Share the trainer's
+        tracer to see, inside its ``data_wait`` phase, how much is THIS
+        batcher (gather/pad) versus upstream iteration — on a prefetch
+        thread the spans land on that thread's timeline in ``trace.json``.
     """
 
     dataset: SequentialDataset
@@ -75,6 +84,7 @@ class SequenceBatcher:
     partitioning: Optional[Partitioning] = None
     epoch: int = field(default=0)
     bucket_boundaries: Optional[Sequence[int]] = None
+    tracer: Optional["Tracer"] = None
 
     def __post_init__(self) -> None:
         if (
@@ -166,7 +176,14 @@ class SequenceBatcher:
         sample = self.dataset.get_sequence(0, name) if len(self.dataset) else np.zeros(0)
         return np.int32 if np.issubdtype(np.asarray(sample).dtype, np.integer) else np.float32
 
+    def _span(self, name: str):
+        return self.tracer.span(name) if self.tracer is not None else contextlib.nullcontext()
+
     def _make_batch(self, chunk: np.ndarray, L: int, dtypes: Dict) -> Batch:
+        with self._span("batch_build"):
+            return self._assemble_batch(chunk, L, dtypes)
+
+    def _assemble_batch(self, chunk: np.ndarray, L: int, dtypes: Dict) -> Batch:
         n_real = len(chunk)
         if n_real < self.batch_size:  # pad final batch by repeating its first row
             chunk = np.concatenate(

@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from replay_tpu.data.nn.schema import TensorMap, TensorSchema
@@ -99,14 +100,19 @@ class SasRecBody(nn.Module):
         padding_mask: jnp.ndarray,  # [B, L] bool
         deterministic: bool = True,
     ) -> jnp.ndarray:
-        embeddings = self.embedder(feature_tensors)
-        x = self.aggregator(embeddings, deterministic=deterministic)
-        attention_mask = attention_mask_for_route(
-            self.use_flash, padding_mask, causal=True,
-            deterministic=deterministic, dtype=self.dtype,
-        )
-        x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
-        return self.final_norm(x)
+        # named scopes label the HLO per stage so device profiles line up with
+        # the host-side Tracer spans (obs.trace) by name
+        with jax.named_scope("embed"):
+            embeddings = self.embedder(feature_tensors)
+            x = self.aggregator(embeddings, deterministic=deterministic)
+        with jax.named_scope("encoder"):
+            attention_mask = attention_mask_for_route(
+                self.use_flash, padding_mask, causal=True,
+                deterministic=deterministic, dtype=self.dtype,
+            )
+            x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
+        with jax.named_scope("final_norm"):
+            return self.final_norm(x)
 
 
 class SasRec(nn.Module):

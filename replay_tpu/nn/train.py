@@ -32,6 +32,7 @@ import logging
 import math
 import os
 import signal as _signal
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
@@ -52,7 +53,10 @@ from replay_tpu.obs import (
     MultiLogger,
     RunLogger,
     StepTelemetry,
+    Tracer,
     TrainerEvent,
+    goodput_breakdown,
+    traced_iterator,
 )
 
 logger = logging.getLogger("replay_tpu")
@@ -411,6 +415,11 @@ class Trainer:
     # (== compiled programs; 1 per fn under the static-shapes invariant) and
     # compile wall-time, surfaced by fit's on_fit_end event
     compile_tracker: CompileTracker = field(default_factory=CompileTracker)
+    # host-side span tracer (obs.trace): an ENABLED Tracer here (or passed to
+    # fit as tracer=...) records data_wait/h2d/compile/train_step/validation/
+    # checkpoint/recovery spans, a trace.json Chrome trace and per-epoch
+    # goodput breakdowns; None = tracing off, the span hooks cost ~nothing
+    tracer: Optional[Tracer] = None
 
     def __post_init__(self) -> None:
         if isinstance(self.loss, str):
@@ -551,7 +560,12 @@ class Trainer:
                 }
                 if "deterministic" in self._forward_params:
                     kwargs["deterministic"] = False
-                hidden = model.apply({"params": params}, rngs={"dropout": dropout_rng}, **kwargs)
+                # named scopes label the lowered HLO so a jax.profiler device
+                # trace correlates with the host-side Tracer spans by name
+                with jax.named_scope("forward"):
+                    hidden = model.apply(
+                        {"params": params}, rngs={"dropout": dropout_rng}, **kwargs
+                    )
                 logits_extra = {
                     name: batch[name] for name in self._logits_extra_params if name in batch
                 }
@@ -565,14 +579,15 @@ class Trainer:
                     )
                 if getattr(loss, "needs_rng", False):
                     loss.rng = loss_rng
-                return loss(
-                    hidden,
-                    batch.get("feature_tensors", {}),
-                    batch[label_f],
-                    batch.get(neg_f),
-                    batch[pad_f],
-                    target_mask,
-                )
+                with jax.named_scope("loss"):
+                    return loss(
+                        hidden,
+                        batch.get("feature_tensors", {}),
+                        batch[label_f],
+                        batch.get(neg_f),
+                        batch[pad_f],
+                        target_mask,
+                    )
 
             loss_value, grads = jax.value_and_grad(loss_fn)(state.params)
             # non-finite sentinel: one fused flag decides, in-jit, whether this
@@ -600,6 +615,35 @@ class Trainer:
 
         return train_step
 
+    def _h2d_span(self):
+        """A ``h2d`` span when an enabled tracer is attached, else a no-op."""
+        if self.tracer is not None and self.tracer.enabled:
+            return self.tracer.span("h2d")
+        return contextlib.nullcontext()
+
+    def traced_train_step(
+        self, state: TrainState, batch: Batch
+    ) -> Tuple[TrainState, jnp.ndarray]:
+        """:meth:`train_step` under the attached tracer's ``train_step`` span.
+
+        Blocks on the loss inside the span (dispatch is async — an unfenced
+        span would time the enqueue, not the step) and carves XLA build time
+        out of any step that triggered a (re)trace into a nested ``compile``
+        span. Falls back to a plain :meth:`train_step` when tracing is off.
+        Shared by ``fit``'s traced loop and the multi-chip dry run.
+        """
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled:
+            return self.train_step(state, batch)
+        compile_before = self.compile_tracker.total_compile_seconds
+        with tracer.span("train_step") as step_span:
+            state, loss_value = self.train_step(state, batch)
+            jax.block_until_ready(loss_value)
+        compile_delta = self.compile_tracker.total_compile_seconds - compile_before
+        if compile_delta > 0:
+            tracer.carve(step_span, "compile", compile_delta)
+        return state, loss_value
+
     def train_step(self, state: TrainState, batch: Batch) -> Tuple[TrainState, jnp.ndarray]:
         """One jitted optimizer step on a (data-sharded) batch.
 
@@ -612,8 +656,10 @@ class Trainer:
                 self.compile_tracker.wrap(self._build_train_step(), "train_step"),
                 donate_argnums=0,
             )
+        with self._h2d_span():
+            placed = self._put_batch(batch)
         with self.compile_tracker.observe("train_step"):
-            new_state, metrics = self._train_step(state, self._put_batch(batch))
+            new_state, metrics = self._train_step(state, placed)
         self.last_step_metrics = metrics
         return new_state, metrics["loss"]
 
@@ -638,8 +684,10 @@ class Trainer:
         stacked = jax.tree.map(
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *list(batches)
         )
+        with self._h2d_span():
+            placed = self._put_stacked(stacked)
         with self.compile_tracker.observe("train_scan"):
-            new_state, metrics = self._train_scan(state, self._put_stacked(stacked))
+            new_state, metrics = self._train_scan(state, placed)
         # per-step [K] arrays (loss / sentinel good flags / grad norms)
         self.last_step_metrics = metrics
         return new_state, np.asarray(metrics["loss"])
@@ -696,6 +744,8 @@ class Trainer:
         recovery: Optional[RecoveryPolicy] = None,
         detect_anomalies: Optional[bool] = None,
         handle_preemption: Optional[bool] = None,
+        tracer: Optional[Tracer | bool] = None,
+        trace_path: Optional[str] = None,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`. A dict of
@@ -760,6 +810,23 @@ class Trainer:
         saves a position-stamped mid-epoch checkpoint at the next step boundary
         and returns the state cleanly, so ``fit(resume=True)`` reproduces the
         uninterrupted run exactly; a second signal force-exits.
+
+        Tracing/goodput (docs/performance.md "Goodput and tracing"):
+        ``tracer=True`` (or an ``obs.Tracer`` instance) records host-side
+        spans — ``data_wait`` / ``h2d`` / ``compile`` / ``train_step`` /
+        ``validation`` / ``checkpoint`` / ``recovery`` — and (a) writes a
+        Chrome trace-event ``trace.json`` at fit end to ``trace_path``
+        (default: the first JsonlLogger's run dir), (b) adds a ``goodput``
+        breakdown (phase fractions summing to 1.0 + ``input_starvation``) to
+        every ``on_epoch_end``/``on_fit_end`` event. A tracer passed as an
+        ARGUMENT scopes to this fit call (detached at fit end); preattach one
+        to :attr:`tracer` to trace every fit. Goodput fractions decompose the
+        fit thread's wall clock — spans from other threads (a prefetch
+        worker's ``batch_build``) appear in ``trace.json`` only. Tracing
+        synchronizes on the loss every step for honest step times, so leave
+        it off for maximum-throughput runs. Epoch windows tile the run: each
+        closes at its ``on_epoch_end`` emission, so the end-of-epoch
+        checkpoint save lands in the NEXT epoch's window.
         """
         if checkpoint_manager is not None and not self.history:
             # resume: prior epoch records survive the restart (metric-history
@@ -840,6 +907,26 @@ class Trainer:
             ]
             if seen_values:
                 best_value = max(seen_values) if mode == "max" else min(seen_values)
+            if resumed_best_step is not None:
+                # the winning checkpoint's sidecar records the monitored value
+                # at mark time (the same channel lr_scale resumes through):
+                # it survives a lost/truncated history.json, so the seed never
+                # regresses to None just because the history did
+                try:
+                    sidecar_value = checkpoint_manager.metadata(resumed_best_step).get(monitor)
+                except (OSError, ValueError):
+                    sidecar_value = None
+                if (
+                    isinstance(sidecar_value, (int, float))
+                    and not isinstance(sidecar_value, bool)
+                    and math.isfinite(sidecar_value)
+                    and (
+                        best_value is None
+                        or (mode == "max" and sidecar_value > best_value)
+                        or (mode == "min" and sidecar_value < best_value)
+                    )
+                ):
+                    best_value = float(sidecar_value)
 
         # -- run-telemetry sinks (replay_tpu.obs) -------------------------- #
         explicit_loggers: List[RunLogger] = []
@@ -859,11 +946,79 @@ class Trainer:
         )
         event_every = 1 if explicit_loggers else (log_every or 0)
 
+        # -- span tracing + goodput accounting (replay_tpu.obs.trace) ------- #
+        prior_tracer = self.tracer
+        tracer_from_arg = tracer is not None
+        if tracer is True:
+            tracer = Tracer()
+        if isinstance(tracer, Tracer):
+            self.tracer = tracer  # train_step's h2d spans route through it too
+        trace = self.tracer if self.tracer is not None and self.tracer.enabled else None
+        tracing = trace is not None
+        if tracing and trace_path is None:
+            queue: List[RunLogger] = list(explicit_loggers)
+            while queue:  # MultiLogger nests sinks: search them too
+                sink = queue.pop(0)
+                if isinstance(sink, JsonlLogger):
+                    trace_path = os.path.join(sink.run_dir, "trace.json")
+                    break
+                if isinstance(sink, MultiLogger):
+                    queue.extend(sink.loggers)
+        # goodput windows decompose THIS thread's wall clock: other threads'
+        # spans (a prefetch worker's batch_build) overlap it rather than
+        # consume it, so they stay out of the fractions (trace.json keeps them)
+        fit_trace_base = trace.snapshot(only_current_thread=True) if tracing else {}
+        fit_summary_base = trace.summary() if tracing else {}
+        fit_trace_t0 = time.perf_counter()
+
+        def span(name: str, **args):
+            """A trace span when tracing, else a no-op context."""
+            return trace.span(name, **args) if tracing else contextlib.nullcontext()
+
+        def trace_window(base: Dict[str, float], t0: float) -> Dict[str, Any]:
+            """Goodput record over this thread's spans since (base, t0)."""
+            current = trace.snapshot(only_current_thread=True)
+            diff = {name: current.get(name, 0.0) - base.get(name, 0.0) for name in current}
+            return goodput_breakdown(diff, time.perf_counter() - t0)
+
+        def fit_spans() -> Dict[str, Dict[str, float]]:
+            """Per-name span totals over THIS fit (all threads): a reused
+            tracer's earlier fits are subtracted out."""
+            out: Dict[str, Dict[str, float]] = {}
+            for name, entry in trace.summary().items():
+                prev = fit_summary_base.get(
+                    name, {"count": 0, "seconds": 0.0, "self_seconds": 0.0}
+                )
+                count = entry["count"] - prev["count"]
+                if count > 0:
+                    out[name] = {
+                        "count": count,
+                        "seconds": entry["seconds"] - prev["seconds"],
+                        "self_seconds": entry["self_seconds"] - prev["self_seconds"],
+                    }
+            return out
+
+        def finish_trace() -> None:
+            """Terminal tracing work: write trace.json and detach a tracer
+            that was passed as a fit argument (a preattached :attr:`tracer`
+            stays; the argument form scopes to this fit)."""
+            if tracing and trace_path is not None:
+                try:
+                    trace.save(trace_path)
+                except OSError as exc:
+                    logger.warning("trace.json not written to %s: %s", trace_path, exc)
+            if tracer_from_arg:
+                self.tracer = prior_tracer
+
         def emit(name: str, step=None, epoch=None, **payload) -> None:
             if run_logger is not None:
                 run_logger.log_event(
                     TrainerEvent(event=name, step=step, epoch=epoch, payload=payload)
                 )
+            if name == "on_fit_end":
+                # every non-raising fit exit path ends in exactly one
+                # on_fit_end; the raising paths call finish_trace themselves
+                finish_trace()
 
         # -- resilience: anomaly detection / recovery / preemption ---------- #
         # host-side anomaly checks cost one device sync per step, so they
@@ -879,6 +1034,10 @@ class Trainer:
         initial_snapshot = None  # rollback target before any checkpoint exists
 
         def do_recovery(reason: str, epoch: int) -> TrainState:
+            with span("recovery", reason=reason):
+                return _do_recovery(reason, epoch)
+
+        def _do_recovery(reason: str, epoch: int) -> TrainState:
             """Roll back to the last checkpoint (else the initial snapshot),
             back the LR off, and return the state to continue from. The batch
             stream is NOT rewound — recovery moves forward through the data."""
@@ -889,6 +1048,9 @@ class Trainer:
             if restarts > recovery.max_restarts:
                 emit("on_recovery", epoch=epoch, reason=reason, restarts=restarts,
                      exhausted=True)
+                # this raise skips on_fit_end: persist the trace NOW — the
+                # rollback timeline is exactly what diagnosing this run needs
+                finish_trace()
                 msg = (
                     f"RecoveryPolicy budget exhausted: {restarts - 1} restarts "
                     f"(max_restarts={recovery.max_restarts}) did not stabilize "
@@ -923,17 +1085,18 @@ class Trainer:
             extra: Dict[str, Any] = {"preempted": True} if preempted else {}
             if self._lr_scale != 1.0:  # recovery backoff survives the resume
                 extra["lr_scale"] = self._lr_scale
-            checkpoint_manager.save(
-                int(state.step),
-                state,
-                history=self.history,
-                metadata={
-                    "mid_epoch": True,
-                    "epoch": epoch,
-                    "step_in_epoch": n_steps,
-                    **extra,
-                },
-            )
+            with span("checkpoint"):
+                checkpoint_manager.save(
+                    int(state.step),
+                    state,
+                    history=self.history,
+                    metadata={
+                        "mid_epoch": True,
+                        "epoch": epoch,
+                        "step_in_epoch": n_steps,
+                        **extra,
+                    },
+                )
             emit("on_checkpoint", step=int(state.step), epoch=epoch,
                  mid_epoch=True, step_in_epoch=n_steps, **extra)
 
@@ -969,6 +1132,11 @@ class Trainer:
             }
             if state is not None:  # sentinel-skipped updates over the run
                 payload["bad_steps"] = int(state.bad_steps)
+            if tracing:
+                # mirror the span layer into the event stream: whole-fit
+                # goodput + THIS fit's per-span totals ride the terminal event
+                payload["goodput"] = trace_window(fit_trace_base, fit_trace_t0)
+                payload["spans"] = fit_spans()
             return payload
 
         emit(
@@ -1038,6 +1206,12 @@ class Trainer:
             return _place_tree(restored, jax.tree.map(self._template_sharding, template))
 
         stopped_early = False
+        # the per-epoch goodput window: opens here and RE-opens right after
+        # each on_epoch_end, so the inter-epoch tail (the end-of-epoch
+        # checkpoint save, best tracking) lands in the NEXT epoch's window —
+        # consecutive windows tile the fit wall-clock with no gaps
+        epoch_trace_base = trace.snapshot(only_current_thread=True) if tracing else {}
+        epoch_trace_t0 = time.perf_counter()
         # profile_stack closes a still-open profiler window on any exit; the
         # preemption handler restores the previous SIGTERM/SIGINT handlers
         with profile_stack, (preemption or contextlib.nullcontext()):
@@ -1055,6 +1229,10 @@ class Trainer:
                     from replay_tpu.data.nn.prefetch import prefetch as _prefetch
 
                     epoch_batches = _prefetch(iter(epoch_batches), depth=prefetch)
+                if tracing:
+                    # times every next() as data_wait — i.e. what the prefetch
+                    # queue could NOT hide from the step loop
+                    epoch_batches = traced_iterator(epoch_batches, trace)
                 for batch in epoch_batches:
                     if state is None:
                         state = self.init_state(batch)
@@ -1084,11 +1262,14 @@ class Trainer:
                         and not profile_active
                         and measured_total == profile_start
                     ):
-                        from replay_tpu.utils.profiling import trace
+                        # aliased: `trace` is the fit-scope Tracer handle
+                        from replay_tpu.utils.profiling import trace as _profiler_trace
 
-                        profile_stack.enter_context(trace(resolved_profile_dir()))
+                        profile_stack.enter_context(_profiler_trace(resolved_profile_dir()))
                         profile_active = True
-                    state, loss_value = self.train_step(state, batch)
+                    # traced: loss-fenced span + compile carve; untraced: the
+                    # plain async-dispatch step
+                    state, loss_value = self.traced_train_step(state, batch)
                     step_metrics = self.last_step_metrics
                     # accumulate on device: float() here would sync every step.
                     # Sentinel-skipped steps contribute 0 (their loss is
@@ -1197,24 +1378,38 @@ class Trainer:
                     streams = (
                         val_batches if isinstance(val_batches, dict) else {"": val_batches}
                     )
-                    for stream_name, factory in streams.items():
-                        stream_metrics = self.validate(
-                            state,
-                            factory(),
-                            metrics=metrics,
-                            top_k=top_k,
-                            item_count=item_count,
-                            postprocessors=postprocessors,
-                        )
-                        prefix = f"{stream_name}/" if stream_name else ""
-                        record.update({f"{prefix}{k}": v for k, v in stream_metrics.items()})
+                    with span("validation"):
+                        for stream_name, factory in streams.items():
+                            stream_metrics = self.validate(
+                                state,
+                                factory(),
+                                metrics=metrics,
+                                top_k=top_k,
+                                item_count=item_count,
+                                postprocessors=postprocessors,
+                            )
+                            prefix = f"{stream_name}/" if stream_name else ""
+                            record.update(
+                                {f"{prefix}{k}": v for k, v in stream_metrics.items()}
+                            )
                     emit("on_validation_end",
                          step=int(state.step) if state is not None else None,
                          epoch=epoch, record=record)
                 self.history.append(record)
+                epoch_payload: Dict[str, Any] = {"record": record}
+                if tracing:
+                    # the goodput contract: phase fractions over this epoch's
+                    # wall clock, summing to 1.0 (docs/performance.md)
+                    epoch_payload["goodput"] = trace_window(
+                        epoch_trace_base, epoch_trace_t0
+                    )
+                    # re-open the window HERE: what follows (this epoch's
+                    # checkpoint save, best tracking) bills to the next epoch
+                    epoch_trace_base = trace.snapshot(only_current_thread=True)
+                    epoch_trace_t0 = time.perf_counter()
                 emit("on_epoch_end",
                      step=int(state.step) if state is not None else None,
-                     epoch=epoch, record=record)
+                     epoch=epoch, **epoch_payload)
                 if not log_every:
                     # log_every=0 silences the per-step prints only — the
                     # per-epoch record line predates the event layer and stays
@@ -1275,14 +1470,15 @@ class Trainer:
                         metadata["lr_scale"] = self._lr_scale
                     if monitor:
                         metadata.update({"best": improved, monitor: value})
-                    checkpoint_manager.save(
-                        int(state.step),
-                        state,
-                        history=self.history,
-                        metadata=metadata,
-                    )
-                    if improved:
-                        checkpoint_manager.mark_best(int(state.step))
+                    with span("checkpoint"):
+                        checkpoint_manager.save(
+                            int(state.step),
+                            state,
+                            history=self.history,
+                            metadata=metadata,
+                        )
+                        if improved:
+                            checkpoint_manager.mark_best(int(state.step))
                     emit("on_checkpoint", step=int(state.step), epoch=epoch,
                          mid_epoch=False, best=bool(improved) if monitor else None)
                 if monitor is not None and patience is not None and stale_epochs >= patience:

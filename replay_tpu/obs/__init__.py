@@ -7,7 +7,10 @@ them); this JAX stack has no Lightning, so the trainer emits structured
 and a collectors layer measures what Lightning never could: jit retraces
 (:class:`CompileTracker`), device memory (:class:`MemoryMonitor`), steady-state
 throughput (:class:`StepTelemetry`) and achieved-vs-peak FLOPs (:mod:`.mfu`).
-Beyond-parity — SURVEY.md §5.
+:mod:`.trace` adds host-side span tracing + goodput accounting (where does
+wall-clock go BETWEEN steps — ``trace.json`` + per-epoch phase fractions), and
+:mod:`.report` is the run-report CLI over the artifacts
+(``python -m replay_tpu.obs.report <run_dir>``). Beyond-parity — SURVEY.md §5.
 """
 
 from .collectors import CompileTracker, MemoryMonitor, StepTelemetry
@@ -20,10 +23,12 @@ from .events import (
     TrainerEvent,
 )
 from .mfu import PEAK_BF16_TFLOPS, cost_analysis, flops_per_step, mfu, peak_tflops
+from .trace import GOODPUT_SPANS, Tracer, goodput_breakdown, traced_iterator
 
 __all__ = [
     "CompileTracker",
     "ConsoleLogger",
+    "GOODPUT_SPANS",
     "JsonlLogger",
     "MemoryMonitor",
     "MultiLogger",
@@ -31,9 +36,12 @@ __all__ = [
     "RunLogger",
     "StepTelemetry",
     "TensorBoardLogger",
+    "Tracer",
     "TrainerEvent",
     "cost_analysis",
     "flops_per_step",
+    "goodput_breakdown",
     "mfu",
     "peak_tflops",
+    "traced_iterator",
 ]

@@ -1,0 +1,447 @@
+"""Run-report CLI: summarize a run's ``events.jsonl`` (+ ``trace.json``).
+
+::
+
+    python -m replay_tpu.obs.report <run_dir | events.jsonl | BENCH.json>
+    python -m replay_tpu.obs.report runs/exp2 --compare runs/exp1 --threshold 0.1
+
+Turns the telemetry artifacts every trainer/bench/dry run leaves behind into
+the one-page answer "Demystifying BERT" (PAPERS.md) says a profile must
+become: throughput, MFU, the goodput breakdown (where wall-clock went between
+steps), retraces, and bad/recovered steps. ``--compare`` diffs two runs —
+either run may be a run directory, a raw ``events.jsonl``, or a single-record
+bench JSON (``BENCH_*.json`` / ``BENCH_TPU_SIDECAR.json``) — and exits
+non-zero when the candidate regresses beyond ``--threshold`` (relative), so
+CI can gate on it.
+
+Import-light by design (stdlib only): the CLI must run in seconds with no
+jax/device involvement, and a malformed artifact must fail loudly (non-zero
+exit) rather than render a partial report — CI uses that as the "our own
+artifacts still parse" check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .trace import GOODPUT_SPANS
+
+__all__ = ["compare_runs", "load_events", "main", "render", "summarize_run"]
+
+
+def _finite(value: Any) -> Optional[float]:
+    """``value`` as a finite float, else None (events.jsonl writes NaN as null)."""
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    value = float(value)
+    return value if math.isfinite(value) else None
+
+
+# --------------------------------------------------------------------------- #
+# loading
+# --------------------------------------------------------------------------- #
+def _resolve(path: str) -> Tuple[str, Optional[str]]:
+    """(events path, trace path or None) for a run directory or a bare file."""
+    if os.path.isdir(path):
+        events = os.path.join(path, "events.jsonl")
+        if not os.path.exists(events):
+            msg = f"{path}: no events.jsonl in run directory"
+            raise FileNotFoundError(msg)
+        trace = os.path.join(path, "trace.json")
+        return events, trace if os.path.exists(trace) else None
+    return path, None
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Records from an ``events.jsonl`` stream or a single-record JSON file."""
+    with open(path) as fh:
+        text = fh.read()
+    records: List[Any]
+    try:
+        payload = json.loads(text)
+        records = [payload] if isinstance(payload, Mapping) else list(payload)
+    except ValueError:
+        records = []
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError as exc:
+                msg = f"{path}:{lineno}: invalid JSON ({exc})"
+                raise ValueError(msg) from exc
+    if not records:
+        msg = f"{path}: no records"
+        raise ValueError(msg)
+    for i, record in enumerate(records):
+        if not isinstance(record, Mapping):
+            msg = f"{path}: record {i} is not a JSON object"
+            raise ValueError(msg)
+    return [dict(r) for r in records]
+
+
+def load_trace(path: str) -> Dict[str, Dict[str, float]]:
+    """Validate Chrome trace-event JSON and aggregate ``{name: {count, seconds}}``.
+
+    The validation IS the contract check CI leans on: every event must carry
+    ``name``/``ph``/``ts`` and a non-negative duration.
+    """
+    with open(path) as fh:
+        payload = json.load(fh)
+    events = payload.get("traceEvents") if isinstance(payload, Mapping) else payload
+    if not isinstance(events, list):
+        msg = f"{path}: no traceEvents list"
+        raise ValueError(msg)
+    spans: Dict[str, Dict[str, float]] = {}
+    for i, event in enumerate(events):
+        if not isinstance(event, Mapping) or not all(
+            key in event for key in ("name", "ph", "ts")
+        ):
+            msg = f"{path}: traceEvents[{i}] missing name/ph/ts"
+            raise ValueError(msg)
+        duration = event.get("dur", 0)
+        if not isinstance(duration, (int, float)) or duration < 0:
+            msg = f"{path}: traceEvents[{i}] has a negative or non-numeric dur"
+            raise ValueError(msg)
+        entry = spans.setdefault(str(event["name"]), {"count": 0, "seconds": 0.0})
+        entry["count"] += 1
+        entry["seconds"] += float(duration) / 1e6
+    return spans
+
+
+# --------------------------------------------------------------------------- #
+# summarizing
+# --------------------------------------------------------------------------- #
+def summarize_run(path: str) -> Dict[str, Any]:
+    events_path, trace_path = _resolve(path)
+    events = load_events(events_path)
+    trace = load_trace(trace_path) if trace_path else None
+    summary = summarize_events(events, source=path)
+    if trace is not None:
+        summary["trace"] = trace
+    return summary
+
+
+def summarize_events(
+    events: Sequence[Mapping[str, Any]], source: str = ""
+) -> Dict[str, Any]:
+    """Fold an event stream into one flat summary record (pure host math)."""
+    steps = [e for e in events if e.get("event") == "on_train_step"]
+    epoch_ends = [e for e in events if e.get("event") == "on_epoch_end"]
+    fit_ends = [e for e in events if e.get("event") == "on_fit_end"]
+    bench = [e for e in events if "metric" in e and "value" in e]
+    dryruns = [e for e in events if e.get("event") == "dryrun_multichip"]
+
+    summary: Dict[str, Any] = {
+        "source": source,
+        "events": len(events),
+        "kind": (
+            "fit" if fit_ends or steps else ("bench" if bench else ("dryrun" if dryruns else "events"))
+        ),
+        "train_steps": len(steps),
+        "epochs": len(epoch_ends),
+        "anomalies": sum(1 for e in events if e.get("event") == "on_anomaly"),
+        "recoveries": sum(1 for e in events if e.get("event") == "on_recovery"),
+        "preemptions": sum(1 for e in events if e.get("event") == "on_preemption"),
+    }
+    summary["backend"] = next(
+        (e["backend"] for e in events if isinstance(e.get("backend"), str)), None
+    )
+
+    fit_end = fit_ends[-1] if fit_ends else {}
+    telemetry = fit_end.get("telemetry") or {}
+    summary["bad_steps"] = fit_end.get("bad_steps")
+
+    # throughput: steady-state fit telemetry > bench headline > step-event mean
+    throughput = _finite(telemetry.get("samples_per_sec"))
+    steps_per_sec = _finite(telemetry.get("steps_per_sec"))
+    throughput_source = "telemetry" if throughput is not None else None
+    if throughput is None and bench:
+        record = bench[-1]
+        if "samples_per_sec" in str(record.get("metric", "")):
+            throughput = _finite(record.get("value"))
+            throughput_source = "bench"
+    if throughput is None and steps:
+        rates = [r for r in (_finite(e.get("samples_per_sec")) for e in steps) if r]
+        if rates:
+            throughput = sum(rates) / len(rates)
+            throughput_source = "steps"
+    if steps_per_sec is None and steps:
+        rates = [r for r in (_finite(e.get("steps_per_sec")) for e in steps) if r]
+        if rates:
+            steps_per_sec = sum(rates) / len(rates)
+    summary["samples_per_sec"] = throughput
+    summary["steps_per_sec"] = steps_per_sec
+    summary["throughput_source"] = throughput_source
+
+    losses = [
+        value
+        for e in epoch_ends
+        for value in [_finite((e.get("record") or {}).get("train_loss"))]
+        if value is not None
+    ]
+    summary["final_train_loss"] = losses[-1] if losses else None
+
+    # compile report: {fn: {traces, compile_seconds}} — retraces beyond the
+    # one sanctioned trace per jitted fn are the static-shapes leak signal
+    compile_report: Mapping[str, Any] = fit_end.get("compile") or {}
+    if not compile_report and dryruns:
+        compile_report = dryruns[-1].get("compile") or {}
+    if compile_report:
+        summary["compile"] = dict(compile_report)
+        summary["retraces"] = sum(
+            max(int(entry.get("traces", 0)) - 1, 0)
+            for entry in compile_report.values()
+            if isinstance(entry, Mapping)
+        )
+        summary["compile_seconds"] = sum(
+            float(entry.get("compile_seconds", 0.0))
+            for entry in compile_report.values()
+            if isinstance(entry, Mapping)
+        )
+    elif bench and _finite(bench[-1].get("compile_seconds")) is not None:
+        summary["compile_seconds"] = float(bench[-1]["compile_seconds"])
+
+    # the latest goodput breakdown (epoch-end beats fit-end: fit-end wall
+    # includes startup/compile, epoch windows are the steady state)
+    goodput = None
+    for event in reversed(list(events)):
+        if event.get("event") == "on_epoch_end" and isinstance(event.get("goodput"), Mapping):
+            goodput = dict(event["goodput"])
+            break
+    if goodput is None:
+        for event in reversed(list(events)):
+            if isinstance(event.get("goodput"), Mapping):
+                goodput = dict(event["goodput"])
+                break
+    summary["goodput"] = goodput
+
+    if bench:
+        record = bench[-1]
+        summary["bench"] = {
+            key: record.get(key)
+            for key in (
+                "metric", "value", "unit", "vs_baseline", "backend", "mfu",
+                "tflops_per_sec", "step_ms", "compile_seconds", "device_kind",
+                "source", "stale",
+            )
+            if key in record
+        }
+        summary["mfu"] = _finite(record.get("mfu"))
+    else:
+        summary["mfu"] = _finite(fit_end.get("mfu"))
+
+    if dryruns:
+        record = dryruns[-1]
+        summary["dryrun"] = {
+            key: record.get(key)
+            for key in ("mesh", "losses", "psum", "sp_ring_err", "spans", "backend")
+            if key in record
+        }
+    return summary
+
+
+# --------------------------------------------------------------------------- #
+# rendering
+# --------------------------------------------------------------------------- #
+def _fmt(value: Optional[float], pattern: str = "{:.1f}", missing: str = "–") -> str:
+    return pattern.format(value) if value is not None else missing
+
+
+def render(summary: Mapping[str, Any]) -> str:
+    lines = [f"Run report — {summary.get('source') or '<events>'}"]
+    backend = f" · backend={summary['backend']}" if summary.get("backend") else ""
+    lines.append(
+        f"  kind: {summary.get('kind')} · events: {summary.get('events')}{backend}"
+    )
+    throughput = summary.get("samples_per_sec")
+    if throughput is not None or summary.get("steps_per_sec") is not None:
+        source = summary.get("throughput_source")
+        lines.append(
+            "  throughput: "
+            f"{_fmt(throughput)} samples/sec"
+            f" ({_fmt(summary.get('steps_per_sec'), '{:.2f}')} steps/sec)"
+            + (f" [{source}]" if source else "")
+            + (f" · MFU {_fmt(summary.get('mfu'), '{:.3f}')}" if summary.get("mfu") is not None else "")
+        )
+    if summary.get("train_steps") or summary.get("epochs"):
+        lines.append(
+            f"  training: {summary.get('epochs', 0)} epoch(s) · "
+            f"{summary.get('train_steps', 0)} step event(s) · "
+            f"final train_loss { _fmt(summary.get('final_train_loss'), '{:.4f}') }"
+        )
+    if "retraces" in summary:
+        per_fn = " · ".join(
+            f"{name}:{entry.get('traces')}x/{entry.get('compile_seconds', 0):.2f}s"
+            for name, entry in sorted(summary.get("compile", {}).items())
+            if isinstance(entry, Mapping)
+        )
+        lines.append(
+            f"  compile: {summary['retraces']} retrace(s), "
+            f"{summary.get('compile_seconds', 0.0):.2f}s total ({per_fn})"
+        )
+    reliability = [
+        f"bad_steps={summary['bad_steps']}" if summary.get("bad_steps") is not None else None,
+        f"anomalies={summary.get('anomalies', 0)}",
+        f"recoveries={summary.get('recoveries', 0)}",
+        f"preemptions={summary.get('preemptions', 0)}",
+    ]
+    lines.append("  reliability: " + " ".join(part for part in reliability if part))
+    goodput = summary.get("goodput")
+    if goodput:
+        fractions = goodput.get("fractions") or {}
+        shown = " · ".join(
+            f"{name} {100.0 * float(fractions.get(name, 0.0)):.1f}%"
+            for name in (*GOODPUT_SPANS, "other")
+            if name in fractions
+        )
+        lines.append(
+            f"  goodput (wall {_fmt(_finite(goodput.get('wall_seconds')), '{:.2f}')}s): {shown}"
+        )
+        starvation = _finite(goodput.get("input_starvation"))
+        if starvation is not None:
+            lines.append(
+                f"  input starvation: {100.0 * starvation:.1f}% of the stepping pipeline"
+            )
+    trace = summary.get("trace")
+    if trace:
+        top = sorted(trace.items(), key=lambda kv: -kv[1]["seconds"])[:8]
+        shown = " · ".join(
+            f"{name} {entry['seconds']:.2f}s x{entry['count']}" for name, entry in top
+        )
+        lines.append(f"  trace.json: {sum(e['count'] for e in trace.values())} span(s): {shown}")
+    dryrun = summary.get("dryrun")
+    if dryrun:
+        lines.append(
+            f"  dryrun_multichip: mesh={dryrun.get('mesh')} losses={dryrun.get('losses')} "
+            f"psum={dryrun.get('psum')} sp_ring_err={dryrun.get('sp_ring_err')}"
+        )
+        if dryrun.get("spans"):
+            shown = " · ".join(
+                f"{name} {entry.get('seconds', 0.0):.2f}s"
+                for name, entry in sorted(dryrun["spans"].items())
+            )
+            lines.append(f"  dryrun spans: {shown}")
+    bench = summary.get("bench")
+    if bench:
+        lines.append(
+            f"  bench: {bench.get('metric')} = {bench.get('value')} {bench.get('unit', '')}"
+            + (f" (vs_baseline {bench.get('vs_baseline')})" if "vs_baseline" in bench else "")
+            + (" [stale sidecar]" if bench.get("stale") else "")
+        )
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------- #
+# comparing
+# --------------------------------------------------------------------------- #
+def compare_runs(
+    candidate: Mapping[str, Any],
+    baseline: Mapping[str, Any],
+    threshold: float = 0.1,
+) -> Tuple[List[str], List[str]]:
+    """(report lines, regression lines) for candidate vs baseline.
+
+    A regression is a relative drop beyond ``threshold`` in throughput or MFU,
+    or new retraces — the three signals TurboGR-style goodput work optimizes.
+    """
+    lines: List[str] = [
+        f"Compare — candidate {candidate.get('source')} vs baseline {baseline.get('source')}"
+    ]
+    regressions: List[str] = []
+
+    def check(name: str, cand: Optional[float], base: Optional[float], unit: str = "") -> None:
+        if cand is None or base is None:
+            lines.append(f"  {name}: candidate={_fmt(cand, '{:.3f}')} baseline={_fmt(base, '{:.3f}')} (not comparable)")
+            return
+        delta = (cand - base) / base if base else 0.0
+        lines.append(
+            f"  {name}: {cand:.3f}{unit} vs {base:.3f}{unit} ({delta:+.1%})"
+        )
+        if base > 0 and cand < base * (1.0 - threshold):
+            regressions.append(f"{name} regressed {-delta:.1%} (> {threshold:.0%} threshold)")
+
+    check("samples_per_sec", candidate.get("samples_per_sec"), baseline.get("samples_per_sec"))
+    check("steps_per_sec", candidate.get("steps_per_sec"), baseline.get("steps_per_sec"))
+    if candidate.get("mfu") is not None and baseline.get("mfu") is not None:
+        check("mfu", candidate.get("mfu"), baseline.get("mfu"))
+    cand_retraces, base_retraces = candidate.get("retraces"), baseline.get("retraces")
+    if cand_retraces is not None and base_retraces is not None:
+        lines.append(f"  retraces: {cand_retraces} vs {base_retraces}")
+        if cand_retraces > base_retraces:
+            regressions.append(
+                f"retraces increased {base_retraces} -> {cand_retraces} (shape leak?)"
+            )
+    cand_gp, base_gp = candidate.get("goodput"), baseline.get("goodput")
+    if cand_gp and base_gp:
+        for name in (*GOODPUT_SPANS, "other"):
+            cand_frac = float((cand_gp.get("fractions") or {}).get(name, 0.0))
+            base_frac = float((base_gp.get("fractions") or {}).get(name, 0.0))
+            if abs(cand_frac - base_frac) >= 0.01:
+                lines.append(
+                    f"  goodput/{name}: {cand_frac:.1%} vs {base_frac:.1%}"
+                )
+    return lines, regressions
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m replay_tpu.obs.report",
+        description="Summarize a run's events.jsonl (+ trace.json) into a run report.",
+    )
+    parser.add_argument(
+        "run", help="run directory, events.jsonl path, or single-record bench JSON"
+    )
+    parser.add_argument(
+        "--compare",
+        metavar="RUN",
+        help="baseline run (same formats); exits non-zero on regression",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.1,
+        help="relative regression threshold for --compare (default 0.1 = 10%%)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the summary as JSON instead of text"
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        summary = summarize_run(args.run)
+    except (OSError, ValueError) as exc:
+        print(f"report: cannot parse {args.run}: {exc}", file=sys.stderr)
+        return 1
+
+    if args.json:
+        print(json.dumps(summary, indent=2, allow_nan=False, default=str))
+    else:
+        print(render(summary))
+
+    if args.compare:
+        try:
+            baseline = summarize_run(args.compare)
+        except (OSError, ValueError) as exc:
+            print(f"report: cannot parse {args.compare}: {exc}", file=sys.stderr)
+            return 1
+        lines, regressions = compare_runs(summary, baseline, threshold=args.threshold)
+        print()
+        print("\n".join(lines))
+        if regressions:
+            for regression in regressions:
+                print(f"REGRESSION: {regression}", file=sys.stderr)
+            return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,340 @@
+"""Host-side span tracing + goodput accounting (the profiler/Timer replacement).
+
+Parity target: PyTorch Lightning meters a run with its profiler connector and
+``Timer`` callback (replay's Lightning stack gets both for free); this layer
+does the same job for the JAX trainer and goes further — it answers the
+question Lightning never could: *where does wall-clock go between optimizer
+steps?* TurboGR-style goodput accounting (PAPERS.md) splits a run into
+``data_wait`` / ``h2d`` / ``compile`` / ``train_step`` / ``validation`` /
+``checkpoint`` / ``recovery`` phases whose fractions sum to 1.0, so "is the
+TPU idle because of the host?" is a one-line answer.
+
+Design:
+
+* :class:`Tracer` records nestable spans via ``with tracer.span(name):``.
+  Thread-safe (per-thread nesting stacks, one lock on the event list) so the
+  prefetch thread's ``batch_build`` spans coexist with the fit loop's spans.
+  Disabled tracers return a shared null context — near-zero overhead, safe to
+  leave the instrumentation in hot paths.
+* Exports Chrome trace-event JSON (:meth:`Tracer.save` → ``trace.json``),
+  loadable in Perfetto / ``chrome://tracing`` next to a ``jax.profiler``
+  device trace; wrap device-side blocks in ``jax.named_scope`` so the two
+  correlate by name.
+* :meth:`Tracer.summary` aggregates per-name **inclusive** and **exclusive**
+  (self) time; :func:`goodput_breakdown` turns an exclusive-time snapshot
+  diff into the epoch/fit goodput record carried by ``on_epoch_end`` /
+  ``on_fit_end`` events.
+
+The module is import-light on purpose (no jax, no numpy): the report CLI and
+the core-tier tests run it host-only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, Iterator, List, Mapping, Optional
+
+__all__ = [
+    "GOODPUT_SPANS",
+    "Tracer",
+    "goodput_breakdown",
+    "traced_iterator",
+]
+
+# the phases of the goodput breakdown, in display order. "other" (derived) is
+# everything the instrumentation did not attribute: python loop overhead,
+# event emission, metric host work between steps. "batch_build" is the
+# batcher's assembly work (SequenceBatcher(tracer=...)): when the batcher runs
+# on the consuming thread its spans nest inside data_wait — listing it here
+# keeps that time counted as input time rather than leaking into "other".
+GOODPUT_SPANS = (
+    "data_wait",
+    "batch_build",
+    "h2d",
+    "compile",
+    "train_step",
+    "validation",
+    "checkpoint",
+    "recovery",
+)
+
+# the spans that make up the stepping pipeline: the denominator of the
+# input-starvation metric (time the step loop spent waiting on the batcher
+# as a fraction of the loop's total productive+waiting time)
+_STEP_PIPELINE = ("data_wait", "batch_build", "h2d", "compile", "train_step")
+
+# the numerator: total input-side wait (blocking on the iterator + the batch
+# assembly that happened inside that wait)
+_INPUT_SPANS = ("data_wait", "batch_build")
+
+_NULL_CONTEXT = contextlib.nullcontext()
+
+
+class _Span:
+    """One live span: a reusable-context-manager-shaped frame.
+
+    Returned by :meth:`Tracer.span`; keeps a reference to its recorded event
+    dict after exit so :meth:`Tracer.carve` can re-attribute part of its self
+    time (the compile-inside-first-step case).
+    """
+
+    __slots__ = ("_tracer", "name", "args", "start", "child_seconds", "record")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict[str, Any]) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self.start = 0.0
+        self.child_seconds = 0.0
+        self.record: Optional[Dict[str, Any]] = None
+
+    def __enter__(self) -> "_Span":
+        self._tracer._push(self)
+        self.start = self._tracer._clock()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        end = self._tracer._clock()
+        self._tracer._pop(self, end)
+
+
+class Tracer:
+    """Collects host-side spans; exports Chrome trace JSON and summaries.
+
+    :param enabled: ``False`` turns every :meth:`span` into a shared null
+        context manager — the instrumentation stays in place at near-zero cost.
+    """
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = bool(enabled)
+        self._clock = time.perf_counter
+        self._t0 = self._clock()
+        self._wall0 = time.time()
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._local = threading.local()
+
+    # -- span recording ----------------------------------------------------- #
+    def _stack(self) -> List[_Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        return stack
+
+    def _push(self, span: _Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: _Span, end: float) -> None:
+        stack = self._stack()
+        # tolerate misnesting (a span closed out of order) instead of raising
+        # from telemetry code: drop frames down to (and including) this span
+        while stack:
+            frame = stack.pop()
+            if frame is span:
+                break
+        duration = max(end - span.start, 0.0)
+        record = {
+            "name": span.name,
+            "tid": threading.get_ident(),
+            "start": span.start - self._t0,
+            "dur": duration,
+            "self": max(duration - span.child_seconds, 0.0),
+            "args": span.args,
+        }
+        span.record = record
+        if stack:
+            stack[-1].child_seconds += duration
+        with self._lock:
+            self._events.append(record)
+
+    def span(self, name: str, **args: Any):
+        """Context manager timing the enclosed block as span ``name``.
+
+        Nested spans subtract from the parent's exclusive ("self") time, so
+        summary totals over sibling categories never double-count.
+        """
+        if not self.enabled:
+            return _NULL_CONTEXT
+        return _Span(self, name, args)
+
+    def add_span(
+        self, name: str, start_seconds: float, duration_seconds: float, **args: Any
+    ) -> None:
+        """Record a synthetic span measured outside ``with`` blocks (``start``
+        relative to the tracer's epoch, i.e. another span's ``record['start']``)."""
+        if not self.enabled:
+            return
+        duration = max(float(duration_seconds), 0.0)
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "tid": threading.get_ident(),
+                    "start": float(start_seconds),
+                    "dur": duration,
+                    "self": duration,
+                    "args": args,
+                }
+            )
+
+    def carve(self, span: _Span, name: str, seconds: float, **args: Any) -> None:
+        """Re-attribute ``seconds`` of a finished span's self time to ``name``.
+
+        The carved span is recorded nested at the parent's start (Chrome trace
+        renders it inside), and the parent's exclusive time shrinks by the
+        same amount — used to split compile wall-time out of the step that
+        triggered the (re)trace.
+        """
+        if not self.enabled or span is None or span.record is None:
+            return
+        seconds = max(min(float(seconds), span.record["self"]), 0.0)
+        if seconds <= 0.0:
+            return
+        with self._lock:
+            span.record["self"] -= seconds
+            self._events.append(
+                {
+                    "name": name,
+                    "tid": span.record["tid"],
+                    "start": span.record["start"],
+                    "dur": seconds,
+                    "self": seconds,
+                    "args": args,
+                }
+            )
+
+    # -- aggregation -------------------------------------------------------- #
+    def wall_seconds(self) -> float:
+        """Seconds since this tracer was created."""
+        return self._clock() - self._t0
+
+    def summary(self, only_current_thread: bool = False) -> Dict[str, Dict[str, float]]:
+        """``{name: {count, seconds, self_seconds}}`` over recorded spans
+        (``seconds`` inclusive of children, ``self_seconds`` exclusive).
+
+        ``only_current_thread`` restricts to spans recorded on the calling
+        thread — what a wall-clock decomposition of THAT thread's time may
+        count (work on other threads, e.g. a prefetch worker's
+        ``batch_build``, overlaps it rather than consuming it).
+        """
+        tid = threading.get_ident() if only_current_thread else None
+        with self._lock:
+            events = list(self._events)
+        out: Dict[str, Dict[str, float]] = {}
+        for event in events:
+            if tid is not None and event["tid"] != tid:
+                continue
+            entry = out.setdefault(
+                event["name"], {"count": 0, "seconds": 0.0, "self_seconds": 0.0}
+            )
+            entry["count"] += 1
+            entry["seconds"] += event["dur"]
+            entry["self_seconds"] += event["self"]
+        return out
+
+    def snapshot(self, only_current_thread: bool = False) -> Dict[str, float]:
+        """Per-name exclusive-seconds totals — diff two snapshots to window a
+        breakdown over an epoch (see :func:`goodput_breakdown`)."""
+        return {
+            name: entry["self_seconds"]
+            for name, entry in self.summary(only_current_thread).items()
+        }
+
+    # -- export ------------------------------------------------------------- #
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (the ``chrome://tracing`` / Perfetto format):
+        complete events (``ph="X"``) with microsecond ``ts``/``dur``."""
+        with self._lock:
+            events = list(self._events)
+        pid = os.getpid()
+        trace_events = []
+        for event in sorted(events, key=lambda e: e["start"]):
+            record = {
+                "name": event["name"],
+                "cat": "host",
+                "ph": "X",
+                "ts": round(event["start"] * 1e6, 3),
+                "dur": round(event["dur"] * 1e6, 3),
+                "pid": pid,
+                "tid": event["tid"],
+            }
+            if event["args"]:
+                record["args"] = {str(k): v for k, v in event["args"].items()}
+            trace_events.append(record)
+        return {
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            "otherData": {"trace_epoch_unix": self._wall0},
+        }
+
+    def save(self, path: str) -> str:
+        """Write ``trace.json`` (Chrome trace-event JSON) to ``path``."""
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(self.to_chrome_trace(), fh)
+        return path
+
+
+def traced_iterator(
+    batches: Iterable[Any], tracer: Tracer, name: str = "data_wait"
+) -> Iterator[Any]:
+    """Yield from ``batches``, timing every ``next()`` as a ``name`` span.
+
+    This is how the fit loop attributes host input time: the span covers
+    exactly the wait for the batcher (prefetch queue pops included), not the
+    consumer's work on the yielded batch.
+    """
+    iterator = iter(batches)
+    while True:
+        with tracer.span(name):
+            try:
+                batch = next(iterator)
+            except StopIteration:
+                return
+        yield batch
+
+
+def goodput_breakdown(
+    span_self_seconds: Mapping[str, float], wall_seconds: float
+) -> Dict[str, Any]:
+    """Fold an exclusive-time snapshot (diff) into the goodput record.
+
+    Returns ``{"wall_seconds", "fractions", "input_starvation"}`` where
+    ``fractions`` maps every :data:`GOODPUT_SPANS` phase plus the derived
+    ``other`` to its share of ``wall_seconds`` — summing to 1.0 by
+    construction — and ``input_starvation`` is the fraction of the stepping
+    pipeline (data_wait + batch_build + h2d + compile + train_step) spent on
+    the input side (waiting on the iterator + same-thread batch assembly).
+    """
+    wall = max(float(wall_seconds), 0.0)
+    fractions: Dict[str, float] = {}
+    tracked = 0.0
+    for name in GOODPUT_SPANS:
+        seconds = max(float(span_self_seconds.get(name, 0.0)), 0.0)
+        tracked += seconds
+        fractions[name] = seconds / wall if wall > 0 else 0.0
+    if wall > 0 and tracked > wall:
+        # spans from concurrent threads can overlap the window; renormalize so
+        # the contract (fractions sum to 1.0) survives
+        for name in GOODPUT_SPANS:
+            fractions[name] *= wall / tracked
+        tracked = wall
+    fractions["other"] = (wall - tracked) / wall if wall > 0 else 1.0
+    pipeline = sum(
+        max(float(span_self_seconds.get(name, 0.0)), 0.0) for name in _STEP_PIPELINE
+    )
+    input_side = sum(
+        max(float(span_self_seconds.get(name, 0.0)), 0.0) for name in _INPUT_SPANS
+    )
+    starvation = input_side / pipeline if pipeline > 0 else 0.0
+    return {
+        "wall_seconds": wall,
+        "fractions": fractions,
+        "input_starvation": starvation,
+    }

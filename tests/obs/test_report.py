@@ -1,0 +1,242 @@
+"""Run-report CLI (obs.report): summaries, compare mode, exit codes.
+
+Core tier, no jax: the CLI is import-light by contract. Fixtures mimic the
+three artifact shapes it must digest — a fit run's ``events.jsonl`` (+
+``trace.json``), a ``dryrun_multichip`` record, and a single-record bench
+JSON — plus a malformed stream that must fail loudly (CI's "our artifacts
+still parse" gate).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from replay_tpu.obs.report import compare_runs, load_events, main, summarize_run
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _write_fit_run(path, samples_per_sec=1000.0, retraces=0, goodput_train=0.8):
+    os.makedirs(path, exist_ok=True)
+    spans = {
+        "data_wait": 0.05,
+        "h2d": 0.02,
+        "compile": 0.05,
+        "train_step": goodput_train,
+        "validation": 0.0,
+        "checkpoint": 0.0,
+        "recovery": 0.0,
+    }
+    goodput = {
+        "wall_seconds": 1.0,
+        "fractions": {**spans, "other": 1.0 - sum(spans.values())},
+        "input_starvation": 0.05,
+    }
+    events = [
+        {"event": "on_fit_start", "time": 1.0, "epoch": 0, "epochs": 1},
+        *(
+            {
+                "event": "on_train_step", "time": 1.0 + i, "step": i + 1, "epoch": 0,
+                "loss": 2.5 - 0.1 * i, "lr": 1e-3,
+                "samples_per_sec": samples_per_sec, "steps_per_sec": samples_per_sec / 8,
+                "step_seconds": 8 / samples_per_sec,
+            }
+            for i in range(3)
+        ),
+        {"event": "on_anomaly", "time": 4.5, "step": 3, "epoch": 0, "loss": None,
+         "grad_norm": None, "consecutive_bad": 1},
+        {"event": "on_epoch_end", "time": 5.0, "step": 3, "epoch": 0,
+         "record": {"epoch": 0, "train_loss": 2.31}, "goodput": goodput},
+        {"event": "on_fit_end", "time": 6.0, "step": 3,
+         "telemetry": {"steps": 2.0, "elapsed_seconds": 0.5,
+                       "steps_per_sec": samples_per_sec / 8,
+                       "samples_per_sec": samples_per_sec},
+         "compile": {"train_step": {"traces": 1 + retraces, "compile_seconds": 0.9}},
+         "peak_memory_bytes": None, "history_len": 1, "bad_steps": 1,
+         "goodput": goodput},
+    ]
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+    return path
+
+
+def _write_trace(path, names=("data_wait", "train_step")):
+    payload = {
+        "traceEvents": [
+            {"name": name, "cat": "host", "ph": "X", "ts": 10.0 * i, "dur": 5.0,
+             "pid": 1, "tid": 1}
+            for i, name in enumerate(names)
+        ],
+        "displayTimeUnit": "ms",
+    }
+    with open(os.path.join(path, "trace.json"), "w") as fh:
+        json.dump(payload, fh)
+
+
+# --------------------------------------------------------------------------- #
+# summaries
+# --------------------------------------------------------------------------- #
+def test_summarize_fit_run(tmp_path):
+    run = _write_fit_run(str(tmp_path / "run"))
+    _write_trace(run)
+    summary = summarize_run(run)
+    assert summary["kind"] == "fit"
+    assert summary["samples_per_sec"] == pytest.approx(1000.0)
+    assert summary["throughput_source"] == "telemetry"
+    assert summary["final_train_loss"] == pytest.approx(2.31)
+    assert summary["retraces"] == 0 and summary["bad_steps"] == 1
+    assert summary["anomalies"] == 1
+    assert summary["goodput"]["fractions"]["train_step"] == pytest.approx(0.8)
+    assert summary["trace"]["train_step"]["count"] == 1
+
+
+def test_report_cli_renders_fit_run(tmp_path, capsys):
+    run = _write_fit_run(str(tmp_path / "run"))
+    _write_trace(run)
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "throughput" in out and "1000.0 samples/sec" in out
+    assert "goodput" in out and "input starvation" in out
+    assert "trace.json" in out
+
+
+def test_report_cli_renders_dryrun_record(tmp_path, capsys):
+    run = tmp_path / "dry"
+    run.mkdir()
+    record = {
+        "event": "dryrun_multichip", "time": 1.0, "backend": "cpu",
+        "mesh": {"data": 4, "model": 2}, "losses": [3.9, 3.7], "psum": 28.0,
+        "sp_ring_err": 3.6e-07,
+        "compile": {"train_step": {"traces": 1, "compile_seconds": 0.77}},
+        "peak_memory_bytes": None,
+        "spans": {"train_step": {"count": 2, "seconds": 1.4, "self_seconds": 0.5}},
+    }
+    (run / "events.jsonl").write_text(json.dumps(record) + "\n")
+    assert main([str(run)]) == 0
+    out = capsys.readouterr().out
+    assert "dryrun_multichip" in out and "mesh={'data': 4, 'model': 2}" in out
+    assert "dryrun spans" in out
+
+
+def test_report_cli_reads_bench_json(tmp_path, capsys):
+    bench = tmp_path / "BENCH.json"
+    bench.write_text(json.dumps({
+        "metric": "sasrec_train_samples_per_sec", "value": 5668.0,
+        "unit": "samples/sec", "vs_baseline": 1.0, "backend": "tpu",
+        "mfu": 0.41, "compile_seconds": 12.0, "device_kind": "TPU v5e",
+    }))
+    assert main([str(bench)]) == 0
+    out = capsys.readouterr().out
+    assert "5668.0 samples/sec" in out and "[bench]" in out
+    assert "MFU 0.410" in out
+
+
+def test_report_json_flag_emits_json(tmp_path, capsys):
+    run = _write_fit_run(str(tmp_path / "run"))
+    assert main([run, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["samples_per_sec"] == pytest.approx(1000.0)
+
+
+# --------------------------------------------------------------------------- #
+# failure modes: a report that cannot parse its own artifacts must exit non-zero
+# --------------------------------------------------------------------------- #
+def test_report_malformed_events_fails(tmp_path, capsys):
+    run = tmp_path / "bad"
+    run.mkdir()
+    (run / "events.jsonl").write_text('{"event": "on_fit_start"}\nnot json{{{\n')
+    assert main([str(run)]) == 1
+    assert "cannot parse" in capsys.readouterr().err
+
+
+def test_report_missing_run_fails(tmp_path, capsys):
+    assert main([str(tmp_path / "nope")]) == 1
+
+
+def test_report_invalid_trace_fails(tmp_path, capsys):
+    run = _write_fit_run(str(tmp_path / "run"))
+    with open(os.path.join(run, "trace.json"), "w") as fh:
+        json.dump({"traceEvents": [{"ph": "X", "ts": 0}]}, fh)  # no name
+    assert main([run]) == 1
+    assert "name/ph/ts" in capsys.readouterr().err
+
+
+def test_load_events_rejects_empty(tmp_path):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("\n\n")
+    with pytest.raises(ValueError, match="no records"):
+        load_events(str(path))
+
+
+# --------------------------------------------------------------------------- #
+# compare mode
+# --------------------------------------------------------------------------- #
+def test_compare_flags_throughput_regression(tmp_path, capsys):
+    baseline = _write_fit_run(str(tmp_path / "base"), samples_per_sec=1000.0)
+    candidate = _write_fit_run(str(tmp_path / "cand"), samples_per_sec=700.0)
+    rc = main([candidate, "--compare", baseline])
+    captured = capsys.readouterr()
+    assert rc != 0  # ≥20% throughput regression must fail the invocation
+    assert "REGRESSION" in captured.err and "samples_per_sec" in captured.err
+
+
+def test_compare_passes_within_threshold(tmp_path, capsys):
+    baseline = _write_fit_run(str(tmp_path / "base"), samples_per_sec=1000.0)
+    candidate = _write_fit_run(str(tmp_path / "cand"), samples_per_sec=950.0)
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+def test_compare_threshold_is_tunable(tmp_path):
+    baseline = _write_fit_run(str(tmp_path / "base"), samples_per_sec=1000.0)
+    candidate = _write_fit_run(str(tmp_path / "cand"), samples_per_sec=700.0)
+    assert main([candidate, "--compare", baseline, "--threshold", "0.5"]) == 0
+
+
+def test_compare_improvement_passes(tmp_path):
+    baseline = _write_fit_run(str(tmp_path / "base"), samples_per_sec=700.0)
+    candidate = _write_fit_run(str(tmp_path / "cand"), samples_per_sec=1000.0)
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+def test_compare_flags_new_retraces(tmp_path, capsys):
+    baseline = _write_fit_run(str(tmp_path / "base"))
+    candidate = _write_fit_run(str(tmp_path / "cand"), retraces=3)
+    rc = main([candidate, "--compare", baseline])
+    assert rc != 0
+    assert "retraces increased" in capsys.readouterr().err
+
+
+def test_compare_against_bench_json(tmp_path):
+    """The --compare operand may be a bench record, not a run directory."""
+    candidate = _write_fit_run(str(tmp_path / "cand"), samples_per_sec=700.0)
+    bench = tmp_path / "BENCH.json"
+    bench.write_text(json.dumps({
+        "metric": "sasrec_train_samples_per_sec_cpu_fallback", "value": 1000.0,
+        "unit": "samples/sec", "vs_baseline": 0.18, "backend": "cpu",
+    }))
+    assert main([candidate, "--compare", str(bench)]) != 0
+
+
+def test_compare_runs_api_reports_goodput_shift(tmp_path):
+    baseline = summarize_run(_write_fit_run(str(tmp_path / "base"), goodput_train=0.8))
+    candidate = summarize_run(_write_fit_run(str(tmp_path / "cand"), goodput_train=0.5))
+    lines, regressions = compare_runs(candidate, baseline)
+    assert any("goodput/train_step" in line for line in lines)
+    assert regressions == []  # goodput shifts inform; throughput/mfu/retraces gate
+
+
+# --------------------------------------------------------------------------- #
+# module entrypoint
+# --------------------------------------------------------------------------- #
+def test_python_dash_m_entrypoint(tmp_path):
+    run = _write_fit_run(str(tmp_path / "run"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "replay_tpu.obs.report", run],
+        capture_output=True, text=True, timeout=120, cwd=REPO, check=False,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "Run report" in proc.stdout

@@ -1,0 +1,475 @@
+"""Span tracing + goodput accounting (obs.trace).
+
+Core tier: the Tracer is pure host code — nesting/exclusive-time math, Chrome
+trace-event export, thread safety, and the input-starvation accounting against
+a deliberately slow (and a fast) fake batcher. The jax smoke test drives a
+traced ``Trainer.fit`` end-to-end: valid ``trace.json``, goodput fractions
+summing to 1.0 on every epoch-end/fit-end event — the PR's acceptance gate.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from replay_tpu.obs import GOODPUT_SPANS, Tracer, goodput_breakdown, traced_iterator
+
+
+# --------------------------------------------------------------------------- #
+# tracer core (host-only)
+# --------------------------------------------------------------------------- #
+def test_nested_spans_split_inclusive_and_exclusive_time():
+    tracer = Tracer()
+    with tracer.span("outer"):
+        time.sleep(0.02)
+        with tracer.span("inner"):
+            time.sleep(0.02)
+    summary = tracer.summary()
+    assert summary["outer"]["count"] == 1 and summary["inner"]["count"] == 1
+    # inclusive outer covers the inner; exclusive outer does not
+    assert summary["outer"]["seconds"] >= summary["inner"]["seconds"]
+    assert summary["outer"]["self_seconds"] == pytest.approx(
+        summary["outer"]["seconds"] - summary["inner"]["seconds"], abs=1e-6
+    )
+    assert summary["inner"]["self_seconds"] == pytest.approx(
+        summary["inner"]["seconds"], abs=1e-9
+    )
+
+
+def test_disabled_tracer_records_nothing_and_reuses_null_context():
+    tracer = Tracer(enabled=False)
+    ctx_a = tracer.span("x")
+    ctx_b = tracer.span("y", attr=1)
+    assert ctx_a is ctx_b  # one shared null context: near-zero overhead
+    with ctx_a:
+        pass
+    tracer.add_span("z", 0.0, 1.0)
+    assert tracer.summary() == {}
+    assert tracer.to_chrome_trace()["traceEvents"] == []
+
+
+def test_span_args_reach_chrome_trace(tmp_path):
+    tracer = Tracer()
+    with tracer.span("step", index=3, phase="train"):
+        pass
+    path = tracer.save(str(tmp_path / "trace.json"))
+    payload = json.load(open(path))
+    (event,) = payload["traceEvents"]
+    assert event["name"] == "step" and event["ph"] == "X"
+    assert event["args"] == {"index": 3, "phase": "train"}
+
+
+def test_chrome_trace_is_valid(tmp_path):
+    tracer = Tracer()
+    for i in range(3):
+        with tracer.span("step"):
+            with tracer.span("inner"):
+                pass
+    tracer.add_span("synthetic", 0.0, 0.001)
+    path = tracer.save(str(tmp_path / "trace.json"))
+    payload = json.load(open(path))
+    events = payload["traceEvents"]
+    assert len(events) == 7
+    for event in events:
+        # the acceptance contract: name/ph/ts present, durations non-negative
+        assert "name" in event and "ph" in event and "ts" in event
+        assert event["ph"] == "X"
+        assert event["dur"] >= 0 and event["ts"] >= 0
+    # events are time-sorted for chrome/perfetto friendliness
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    assert payload["displayTimeUnit"] == "ms"
+
+
+def test_threaded_spans_all_recorded():
+    tracer = Tracer()
+
+    def work(i):
+        for _ in range(25):
+            with tracer.span(f"thread_{i}"):
+                with tracer.span("inner"):
+                    pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    summary = tracer.summary()
+    assert summary["inner"]["count"] == 100
+    for i in range(4):
+        assert summary[f"thread_{i}"]["count"] == 25
+        # nesting stacks are per-thread: each thread's inner nested under ITS span
+        assert summary[f"thread_{i}"]["self_seconds"] <= summary[f"thread_{i}"]["seconds"]
+
+
+def test_carve_reattributes_self_time():
+    tracer = Tracer()
+    with tracer.span("train_step") as span:
+        time.sleep(0.03)
+    before = tracer.summary()["train_step"]
+    tracer.carve(span, "compile", 0.02)
+    summary = tracer.summary()
+    assert summary["compile"]["self_seconds"] == pytest.approx(0.02, abs=1e-9)
+    assert summary["train_step"]["self_seconds"] == pytest.approx(
+        before["self_seconds"] - 0.02, abs=1e-9
+    )
+    # inclusive step duration unchanged: the carved span nests inside it
+    assert summary["train_step"]["seconds"] == pytest.approx(before["seconds"], abs=1e-9)
+    # carving more than the span's remaining self time clamps, never negative
+    tracer.carve(span, "compile", 99.0)
+    assert tracer.summary()["train_step"]["self_seconds"] >= 0.0
+
+
+# --------------------------------------------------------------------------- #
+# goodput math (host-only)
+# --------------------------------------------------------------------------- #
+def test_goodput_fractions_sum_to_one():
+    spans = {"data_wait": 0.2, "train_step": 0.5, "compile": 0.1, "unrelated": 9.0}
+    record = goodput_breakdown(spans, wall_seconds=1.0)
+    fractions = record["fractions"]
+    assert set(fractions) == {*GOODPUT_SPANS, "other"}
+    assert sum(fractions.values()) == pytest.approx(1.0, abs=1e-9)
+    assert fractions["other"] == pytest.approx(0.2, abs=1e-9)  # unrelated excluded
+    assert record["input_starvation"] == pytest.approx(0.2 / 0.8, abs=1e-9)
+
+
+def test_goodput_overlapping_spans_renormalize():
+    # concurrent-thread spans can exceed the wall window; the sum-to-1.0
+    # contract must survive
+    record = goodput_breakdown({"data_wait": 2.0, "train_step": 2.0}, wall_seconds=1.0)
+    assert sum(record["fractions"].values()) == pytest.approx(1.0, abs=1e-9)
+    assert record["fractions"]["other"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_goodput_zero_wall_degrades():
+    record = goodput_breakdown({}, wall_seconds=0.0)
+    assert record["fractions"]["other"] == 1.0
+    assert record["input_starvation"] == 0.0
+
+
+def _goodput_of_loop(batch_delay: float, step_delay: float, n: int = 8):
+    """The fit loop's accounting shape, minus jax: a traced iterator feeding a
+    fake train step, folded through the same helpers Trainer.fit uses."""
+    tracer = Tracer()
+
+    def batcher():
+        for _ in range(n):
+            if batch_delay:
+                time.sleep(batch_delay)
+            yield {}
+
+    start = time.perf_counter()
+    for _ in traced_iterator(batcher(), tracer):
+        with tracer.span("train_step"):
+            time.sleep(step_delay)
+    return goodput_breakdown(tracer.snapshot(), time.perf_counter() - start)
+
+
+def test_slow_batcher_shows_input_starvation():
+    """A batcher injecting 20ms/batch against a 2ms step must attribute the
+    bulk of the pipeline to data_wait — the 'is the TPU idle because of the
+    host?' one-liner."""
+    record = _goodput_of_loop(batch_delay=0.02, step_delay=0.002)
+    expected = 0.02 / (0.02 + 0.002)  # ≈ 0.91 of the stepping pipeline
+    assert record["input_starvation"] > 0.7
+    assert record["input_starvation"] == pytest.approx(expected, abs=0.15)
+    assert record["fractions"]["data_wait"] > 0.6
+    assert sum(record["fractions"].values()) == pytest.approx(1.0, abs=1e-9)
+
+
+def test_fast_batcher_shows_no_starvation():
+    record = _goodput_of_loop(batch_delay=0.0, step_delay=0.01)
+    assert record["input_starvation"] < 0.1
+    assert record["fractions"]["train_step"] > 0.6
+
+
+def test_same_thread_batch_build_counts_as_input_time():
+    """A batcher sharing the consumer's tracer nests batch_build inside
+    data_wait; that assembly time must count toward starvation (input side),
+    not leak into 'other'."""
+    tracer = Tracer()
+
+    def batcher():
+        for _ in range(6):
+            with tracer.span("batch_build"):
+                time.sleep(0.01)
+            yield {}
+
+    start = time.perf_counter()
+    for _ in traced_iterator(batcher(), tracer):
+        with tracer.span("train_step"):
+            time.sleep(0.002)
+    record = goodput_breakdown(tracer.snapshot(), time.perf_counter() - start)
+    assert record["fractions"]["other"] < 0.2
+    assert record["input_starvation"] > 0.6  # ≈ 10/12 of the pipeline
+    assert sum(record["fractions"].values()) == pytest.approx(1.0, abs=1e-9)
+
+
+def test_snapshot_only_current_thread_excludes_worker_spans():
+    tracer = Tracer()
+    with tracer.span("train_step"):
+        pass
+    def record_span():
+        with tracer.span("batch_build"):
+            pass
+
+    worker = threading.Thread(target=record_span)
+    worker.start()
+    worker.join()
+    assert "batch_build" in tracer.snapshot()
+    assert "batch_build" not in tracer.snapshot(only_current_thread=True)
+    assert "train_step" in tracer.snapshot(only_current_thread=True)
+
+
+def test_sequence_batcher_records_batch_build_spans():
+    """SequenceBatcher(tracer=...) times every batch assembly."""
+    import pandas as pd
+
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import (
+        SequenceBatcher,
+        SequentialDataset,
+        TensorFeatureInfo,
+        TensorSchema,
+    )
+
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=100)
+    )
+    frame = pd.DataFrame(
+        {"query_id": np.arange(7), "item_id": [np.arange(1 + i) for i in range(7)]}
+    )
+    dataset = SequentialDataset(schema, "query_id", "item_id", frame)
+    tracer = Tracer()
+    batcher = SequenceBatcher(dataset, batch_size=2, max_sequence_length=4, tracer=tracer)
+    batches = list(batcher)
+    summary = tracer.summary()
+    assert summary["batch_build"]["count"] == len(batches) == 4
+    # tracing must not perturb the batches themselves
+    plain = list(SequenceBatcher(dataset, batch_size=2, max_sequence_length=4))
+    for traced, untraced in zip(batches, plain):
+        np.testing.assert_array_equal(traced["item_id"], untraced["item_id"])
+
+
+# --------------------------------------------------------------------------- #
+# traced fit end-to-end (jax smoke) — the CI trace.json artifact producer
+# --------------------------------------------------------------------------- #
+def _run_dir(tmp_path, name):
+    base = os.environ.get("REPLAY_TPU_RUN_DIR")
+    return os.path.join(base, name) if base else str(tmp_path / name)
+
+
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_traced_fit_writes_valid_trace_and_goodput(tmp_path):
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.obs import JsonlLogger
+
+    num_items, seq_len, batch_size = 12, 8, 8
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+                          embedding_dim=16)
+    )
+    model = SasRec(schema=schema, embedding_dim=16, num_blocks=1, num_heads=1,
+                   max_sequence_length=seq_len)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(learning_rate=1e-2), mesh=make_mesh())
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        items = rng.integers(0, num_items, size=(batch_size, seq_len + 1)).astype(np.int32)
+        mask = np.ones((batch_size, seq_len), dtype=bool)
+        return {
+            "feature_tensors": {"item_id": items[:, :-1]},
+            "padding_mask": mask,
+            "positive_labels": items[:, 1:, None],
+            "target_padding_mask": mask[:, :, None],
+        }
+
+    batches = [make_batch() for _ in range(3)]
+
+    def val_batches():
+        batch = dict(batches[0])
+        batch["ground_truth"] = batches[0]["positive_labels"][:, -1, :].astype(np.int32)
+        return [batch]
+
+    run_dir = _run_dir(tmp_path, "trace_smoke")
+    # mode="w": REPLAY_TPU_RUN_DIR is a fixed path in CI — re-runs must not append
+    with JsonlLogger(run_dir, mode="w") as sink:
+        trainer.fit(lambda: iter(batches), epochs=2, loggers=sink, tracer=True,
+                    val_batches=val_batches, metrics=("ndcg",), top_k=(5,))
+
+    # trace.json: valid Chrome trace-event JSON next to events.jsonl
+    trace_path = os.path.join(run_dir, "trace.json")
+    payload = json.load(open(trace_path))
+    events = payload["traceEvents"]
+    assert events, "traced fit recorded no spans"
+    for event in events:
+        assert "name" in event and "ph" in event and "ts" in event
+        assert event["dur"] >= 0
+    names = {event["name"] for event in events}
+    assert {"data_wait", "h2d", "train_step", "compile", "validation"} <= names
+
+    # goodput: every epoch-end and the fit-end carry fractions summing to 1.0
+    lines = [json.loads(line) for line in open(os.path.join(run_dir, "events.jsonl"))]
+    epoch_ends = [line for line in lines if line["event"] == "on_epoch_end"]
+    fit_end = lines[-1]
+    assert fit_end["event"] == "on_fit_end"
+    assert len(epoch_ends) == 2
+    for record in (*epoch_ends, fit_end):
+        goodput = record["goodput"]
+        fractions = goodput["fractions"]
+        assert sum(fractions.values()) == pytest.approx(1.0, abs=0.05)
+        assert all(value >= 0 for value in fractions.values())
+        assert 0.0 <= goodput["input_starvation"] <= 1.0
+    # the first epoch pays the train-step compile; the second must not
+    assert epoch_ends[0]["goodput"]["fractions"]["compile"] > 0
+    assert epoch_ends[1]["goodput"]["fractions"]["compile"] == pytest.approx(0.0, abs=1e-9)
+    # span summaries mirrored into the event stream
+    assert fit_end["spans"]["train_step"]["count"] == 6
+    # tracing leaves the static-shapes invariant intact
+    assert trainer.compile_tracker.traces["train_step"] == 1
+
+
+def _tiny_trainer(embedding_dim=8):
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec import SasRec
+
+    num_items, seq_len = 12, 8
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+                          embedding_dim=embedding_dim)
+    )
+    model = SasRec(schema=schema, embedding_dim=embedding_dim, num_blocks=1,
+                   num_heads=1, max_sequence_length=seq_len)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(learning_rate=1e-2), mesh=make_mesh())
+
+    def make_batch(seed):
+        rng = np.random.default_rng(seed)
+        items = rng.integers(0, num_items, size=(8, seq_len + 1)).astype(np.int32)
+        mask = np.ones((8, seq_len), dtype=bool)
+        return {
+            "feature_tensors": {"item_id": items[:, :-1]},
+            "padding_mask": mask,
+            "positive_labels": items[:, 1:, None],
+            "target_padding_mask": mask[:, :, None],
+        }
+
+    return trainer, make_batch
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def log_event(self, event):
+        self.events.append(event)
+
+
+@pytest.mark.jax
+def test_fit_argument_tracer_scopes_to_that_fit():
+    """fit(tracer=True) must not leave the trainer permanently tracing: the
+    next fit runs untraced (no per-step loss fence, no goodput payloads)."""
+    trainer, make_batch = _tiny_trainer()
+    trainer.fit(lambda: iter([make_batch(0), make_batch(1)]), epochs=1, tracer=True)
+    assert trainer.tracer is None  # detached at fit end
+    recorder = _Recorder()
+    trainer.fit(lambda: iter([make_batch(2), make_batch(3)]), epochs=1, loggers=recorder)
+    for event in recorder.events:
+        assert "goodput" not in event.payload and "spans" not in event.payload
+
+
+@pytest.mark.jax
+def test_preattached_tracer_reports_per_fit_spans():
+    """A Trainer-attached tracer accumulates across fits (one timeline), but
+    each fit-end `spans` payload covers only THAT fit's spans."""
+    trainer, make_batch = _tiny_trainer()
+    trainer.tracer = Tracer()
+    first, second = _Recorder(), _Recorder()
+    trainer.fit(lambda: iter([make_batch(0), make_batch(1)]), epochs=1, loggers=first)
+    trainer.fit(lambda: iter([make_batch(2), make_batch(3)]), epochs=1, loggers=second)
+    assert trainer.tracer is not None  # preattached: stays for every fit
+    spans_a = first.events[-1].payload["spans"]
+    spans_b = second.events[-1].payload["spans"]
+    assert spans_a["train_step"]["count"] == 2
+    assert spans_b["train_step"]["count"] == 2  # not 4: earlier fits subtracted
+    # the shared timeline still holds everything
+    assert trainer.tracer.summary()["train_step"]["count"] == 4
+
+
+@pytest.mark.jax
+def test_epoch_end_checkpoint_bills_to_next_epoch_window(tmp_path):
+    """Goodput windows tile the fit: epoch N's end-of-epoch checkpoint save
+    must show up in epoch N+1's `checkpoint` fraction, not vanish between
+    windows."""
+    from replay_tpu.utils.checkpoint import CheckpointManager
+
+    trainer, make_batch = _tiny_trainer()
+    manager = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    recorder = _Recorder()
+    trainer.fit(lambda epoch: [make_batch(10 * epoch + i) for i in range(2)],
+                epochs=2, loggers=recorder, tracer=True, checkpoint_manager=manager)
+    epoch_ends = [e for e in recorder.events if e.event == "on_epoch_end"]
+    assert len(epoch_ends) == 2
+    # epoch 0's save happened after epoch 0's window closed -> epoch 1 sees it
+    assert epoch_ends[1].payload["goodput"]["fractions"]["checkpoint"] > 0
+    # fit-end window covers the final save
+    fit_end = recorder.events[-1]
+    assert fit_end.payload["goodput"]["fractions"]["checkpoint"] > 0
+    assert fit_end.payload["spans"]["checkpoint"]["count"] == 2
+
+
+@pytest.mark.jax
+def test_untraced_fit_emits_no_goodput():
+    """tracer=None keeps the event schema exactly as before (additive change)."""
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.obs import RunLogger
+
+    class Recorder(RunLogger):
+        def __init__(self):
+            self.events = []
+
+        def log_event(self, event):
+            self.events.append(event)
+
+    num_items, seq_len = 12, 8
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+                          embedding_dim=8)
+    )
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, num_heads=1,
+                   max_sequence_length=seq_len)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(learning_rate=1e-2), mesh=make_mesh())
+    rng = np.random.default_rng(1)
+    items = rng.integers(0, num_items, size=(8, seq_len + 1)).astype(np.int32)
+    mask = np.ones((8, seq_len), dtype=bool)
+    batch = {
+        "feature_tensors": {"item_id": items[:, :-1]},
+        "padding_mask": mask,
+        "positive_labels": items[:, 1:, None],
+        "target_padding_mask": mask[:, :, None],
+    }
+    recorder = Recorder()
+    trainer.fit(lambda: iter([batch, batch]), epochs=1, loggers=recorder)
+    for event in recorder.events:
+        assert "goodput" not in event.payload and "spans" not in event.payload
