@@ -37,6 +37,10 @@ from .train import (
     make_mesh,
 )
 
+# re-exported next to Trainer/RecoveryPolicy for the common attach pattern
+# (Trainer(health=HealthConfig(...)), the obs.health diagnostics layer)
+from replay_tpu.obs.health import HealthConfig, HealthWatcher
+
 __all__ = [
     "create_activation",
     "CategoricalEmbedding",
@@ -44,6 +48,8 @@ __all__ = [
     "ConcatAggregator",
     "DefaultAttentionMask",
     "EmbeddingTyingHead",
+    "HealthConfig",
+    "HealthWatcher",
     "IdentityEmbedding",
     "LRSchedulerFactory",
     "MultiHeadAttention",

@@ -27,7 +27,12 @@ def dot_product_attention(
     use_flash=False,  # False | True (single-block kernel) | "tiled" (long L)
     padding_mask: jnp.ndarray = None,  # [B, L] bool, required for "tiled"
     causal: bool = True,
+    return_weights: bool = False,  # also return the [B, H, L, L] softmax weights
 ) -> jnp.ndarray:
+    if return_weights and use_flash:
+        # the flash kernels never materialize the weights — that is the point
+        msg = "return_weights=True requires the standard (use_flash=False) route"
+        raise ValueError(msg)
     if use_flash == "tiled":
         # length-tiled kernel: O(L·block) memory, mask computed in-kernel from
         # (causal, padding) — callers skip building the [B, 1, L, L] tensor
@@ -58,7 +63,10 @@ def dot_product_attention(
     scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], dtype=q.dtype))
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + mask.astype(q.dtype)
     weights = nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    if return_weights:
+        return out, weights
+    return out
 
 
 class MultiHeadAttention(nn.Module):
@@ -95,10 +103,35 @@ class MultiHeadAttention(nn.Module):
             return proj.reshape(*x.shape[:-1], self.num_heads, head_dim).swapaxes(-3, -2)
 
         q, k, v = split("query"), split("key"), split("value")
-        out = dot_product_attention(
-            q, k, v, mask, use_flash=self.use_flash,
-            padding_mask=padding_mask, causal=causal,
-        )
+        # model-health capture (replay_tpu.obs.health): when the caller made
+        # the `intermediates` collection mutable AND the standard einsum route
+        # runs (the flash kernels never materialize the weights), sow the
+        # per-head mean attention entropy. Python-level guard: the disabled
+        # step lowers to byte-identical HLO; the sowed [H] vector is dead code
+        # (DCE'd by XLA) for consumers that capture but drop it.
+        if not self.use_flash and self.is_mutable_collection("intermediates"):
+            out, weights = dot_product_attention(
+                q, k, v, mask, causal=causal, return_weights=True
+            )
+            w32 = weights.astype(jnp.float32)
+            entropy = -jnp.sum(w32 * jnp.log(w32 + 1e-9), axis=-1)  # [B, H, L]
+            if padding_mask is not None:
+                # mean over VALID query rows only: padded rows are forced
+                # one-hot by the diagonal rescue (entropy 0) and would drag
+                # the signal toward the "collapsed attention" reading on
+                # heavily padded batches
+                valid = padding_mask.astype(w32.dtype)  # [B, L]
+                per_head = jnp.sum(entropy * valid[:, None, :], axis=(0, 2)) / jnp.maximum(
+                    jnp.sum(valid), 1.0
+                )
+            else:
+                per_head = jnp.mean(entropy, axis=(0, 2))
+            self.sow("intermediates", "attention_entropy", per_head)
+        else:
+            out = dot_product_attention(
+                q, k, v, mask, use_flash=self.use_flash,
+                padding_mask=padding_mask, causal=causal,
+            )
         out = out.swapaxes(-3, -2).reshape(*x.shape[:-1], dim)
         out = nn.Dense(dim, dtype=self.dtype, name="out")(out)
         return nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)

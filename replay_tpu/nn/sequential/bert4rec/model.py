@@ -30,6 +30,7 @@ from replay_tpu.data.nn.schema import TensorMap, TensorSchema
 from replay_tpu.nn.embedding import SequenceEmbedding
 from replay_tpu.nn.head import EmbeddingTyingHead
 from replay_tpu.nn.mask import attention_mask_for_route
+from replay_tpu.obs.health import sow_stage_stats
 
 from ..sasrec.transformer import SasRecTransformerLayer
 
@@ -107,6 +108,8 @@ class Bert4RecBody(nn.Module):
             total.dtype
         )
         x = self.input_dropout(self.input_norm(x), deterministic=deterministic)
+        # model-health stage stats (no-op unless `intermediates` is mutable)
+        sow_stage_stats(self, "embed", x)
         attention_mask = attention_mask_for_route(
             self.use_flash, padding_mask, causal=False,
             deterministic=deterministic, dtype=self.dtype,
@@ -116,7 +119,9 @@ class Bert4RecBody(nn.Module):
                 x, attention_mask, padding_mask,
                 deterministic=deterministic, causal=False,
             )
-        return self.final_norm(x)
+        out = self.final_norm(x)
+        sow_stage_stats(self, "final_norm", out)
+        return out
 
 
 class Bert4Rec(nn.Module):

@@ -27,6 +27,7 @@ from replay_tpu.nn.agg import PositionAwareAggregator
 from replay_tpu.nn.embedding import SequenceEmbedding
 from replay_tpu.nn.head import EmbeddingTyingHead
 from replay_tpu.nn.mask import attention_mask_for_route
+from replay_tpu.obs.health import sow_stage_stats
 
 from .transformer import DiffTransformerLayer, SasRecTransformerLayer
 
@@ -101,10 +102,12 @@ class SasRecBody(nn.Module):
         deterministic: bool = True,
     ) -> jnp.ndarray:
         # named scopes label the HLO per stage so device profiles line up with
-        # the host-side Tracer spans (obs.trace) by name
+        # the host-side Tracer spans (obs.trace) by name; sow_stage_stats only
+        # fires when a health-enabled step made `intermediates` mutable
         with jax.named_scope("embed"):
             embeddings = self.embedder(feature_tensors)
             x = self.aggregator(embeddings, deterministic=deterministic)
+            sow_stage_stats(self, "embed", x)
         with jax.named_scope("encoder"):
             attention_mask = attention_mask_for_route(
                 self.use_flash, padding_mask, causal=True,
@@ -112,7 +115,9 @@ class SasRecBody(nn.Module):
             )
             x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
         with jax.named_scope("final_norm"):
-            return self.final_norm(x)
+            out = self.final_norm(x)
+            sow_stage_stats(self, "final_norm", out)
+            return out
 
 
 class SasRec(nn.Module):

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from replay_tpu.nn.attention import MultiHeadAttention, MultiHeadDifferentialAttention, RMSNorm
 from replay_tpu.nn.ffn import PointWiseFeedForward, SwiGLU
+from replay_tpu.obs.health import sow_stage_stats
 
 
 class _SasRecBlock(nn.Module):
@@ -79,12 +80,14 @@ class SasRecTransformerLayer(nn.Module):
         causal: bool = True,
     ) -> jnp.ndarray:
         keep = padding_mask[..., None].astype(x.dtype)
-        tiled = self.use_flash == "tiled"
         block_cls = (
             # deterministic and causal are python-level flags
             nn.remat(_SasRecBlock, static_argnums=(4, 6)) if self.remat else _SasRecBlock
         )
         for i in range(self.num_blocks):
+            # padding_mask rides along on every route: the tiled kernel builds
+            # its mask from it, and the health capture weights the attention
+            # entropy by it (unused — and DCE'd — otherwise)
             x = block_cls(
                 num_heads=self.num_heads,
                 hidden_dim=self.hidden_dim,
@@ -93,8 +96,9 @@ class SasRecTransformerLayer(nn.Module):
                 use_flash=self.use_flash,
                 dtype=self.dtype,
                 name=f"block_{i}",
-            )(x, attention_mask, keep, deterministic,
-              padding_mask if tiled else None, causal)
+            )(x, attention_mask, keep, deterministic, padding_mask, causal)
+            # model-health stage stats (no-op unless `intermediates` is mutable)
+            sow_stage_stats(self, f"block_{i}", x)
         return x
 
 

@@ -48,6 +48,7 @@ from replay_tpu.metrics.builder import MetricsBuilder
 from replay_tpu.obs import (
     CompileTracker,
     ConsoleLogger,
+    HealthConfig,
     JsonlLogger,
     MemoryMonitor,
     MultiLogger,
@@ -58,6 +59,7 @@ from replay_tpu.obs import (
     goodput_breakdown,
     traced_iterator,
 )
+from replay_tpu.obs.health import health_metrics
 
 logger = logging.getLogger("replay_tpu")
 
@@ -344,12 +346,40 @@ def _place_tree(tree: Any, shardings: Any) -> Any:
 
 
 def _local_rows(array: jnp.ndarray) -> np.ndarray:
-    """This process's rows of a batch-dim-sharded global array (identity in
-    single-process runs, where every array is fully addressable)."""
+    """This process's rows of a batch-dim global array (identity in
+    single-process runs, where every array is fully addressable).
+
+    The output sharding of an eagerly-applied op (e.g. ``lax.top_k`` on the
+    jitted eval logits) is XLA's choice, not ours: it may keep the row
+    sharding OR replicate. Shards are therefore deduplicated by their global
+    row offset (replicated layouts repeat the same rows on every device), and
+    a fully-replicated result is cut back to the contiguous row range this
+    process contributed (``make_array_from_process_local_data`` lays the
+    global batch out in process order)."""
     if jax.process_count() == 1 or getattr(array, "is_fully_addressable", True):
         return np.asarray(array)
-    shards = sorted(array.addressable_shards, key=lambda s: s.index[0].start or 0)
-    return np.concatenate([np.asarray(shard.data) for shard in shards], axis=0)
+    by_offset: Dict[int, Any] = {}
+    for shard in array.addressable_shards:
+        by_offset.setdefault(shard.index[0].start or 0, shard)
+    rows = np.concatenate(
+        [np.asarray(by_offset[start].data) for start in sorted(by_offset)], axis=0
+    )
+    per_process = array.shape[0] // jax.process_count()
+    if rows.shape[0] == array.shape[0]:
+        # replicated output: every process sees the whole batch — keep only
+        # the rows this process fed in (local x process_count == global)
+        start = jax.process_index() * per_process
+        rows = rows[start : start + per_process]
+    if rows.shape[0] != per_process:
+        # a partially-replicated layout XLA might invent would silently
+        # duplicate/drop users in the metric accumulation — fail loudly
+        msg = (
+            f"_local_rows: addressable shards of a [{array.shape[0]}, ...] array "
+            f"with sharding {array.sharding} cover {rows.shape[0]} distinct rows; "
+            f"expected this process's {per_process} — unsupported output layout"
+        )
+        raise ValueError(msg)
+    return rows
 
 
 def _globalize_scalars(mesh: Mesh, tree: Any) -> Any:
@@ -420,6 +450,13 @@ class Trainer:
     # checkpoint/recovery spans, a trace.json Chrome trace and per-epoch
     # goodput breakdowns; None = tracing off, the span hooks cost ~nothing
     tracer: Optional[Tracer] = None
+    # in-graph model-health diagnostics (obs.health): a HealthConfig here
+    # extends the jitted train step with per-group grad/param/update norms,
+    # update ratios, activation stats, attention entropy, logits stats and
+    # embedding coverage — all device-resident, fetched every `cadence` steps
+    # by fit and emitted as a `health` payload (docs/performance.md "Model
+    # health"). None = the step lowers exactly as before (no extra HLO).
+    health: Optional[HealthConfig] = None
 
     def __post_init__(self) -> None:
         if isinstance(self.loss, str):
@@ -448,6 +485,9 @@ class Trainer:
         self._query_embeddings_fn = None
         self._catalog_fn = None
         self.last_step_metrics: Optional[Dict[str, Any]] = None
+        # the most recent host-fetched health record (python scalars/lists),
+        # refreshed by fit every health.cadence steps
+        self.last_health: Optional[Dict[str, Any]] = None
         self._lr_scale = 1.0  # RecoveryPolicy backoff multiplier (1.0 = none)
         self._forward_params = _signature_names(type(self.model).__call__)
         self._inference_params = (
@@ -520,7 +560,7 @@ class Trainer:
         return {name: pool[name] for name in self._forward_params if name in pool}
 
     # -- train ------------------------------------------------------------- #
-    def _build_train_step(self):
+    def _build_train_step(self, health: Optional[HealthConfig] = None):
         model, loss, tx = self.model, self.loss, self._tx
         if getattr(loss, "needs_item_embeddings", False) and not hasattr(
             type(model), "get_item_weights"
@@ -544,6 +584,11 @@ class Trainer:
         label_f, tmask_f, neg_f = self.label_field, self.target_mask_field, self.negative_field
         pad_f = self.padding_mask_field
 
+        # `health` branches below are python-static (resolved at trace time,
+        # like the models' sow guards): health=None lowers to byte-identical
+        # HLO as the pre-health step — golden-tested — while a HealthConfig
+        # yields the ONE sanctioned extra compiled variant with an auxiliary
+        # `health` pytree of device scalars in the metrics (obs.health).
         def train_step(state: TrainState, batch: Batch):
             rng, dropout_rng, loss_rng = jax.random.split(state.rng, 3)
             # batch-padding rows (fixed-shape final batch) get zero loss weight:
@@ -563,9 +608,21 @@ class Trainer:
                 # named scopes label the lowered HLO so a jax.profiler device
                 # trace correlates with the host-side Tracer spans by name
                 with jax.named_scope("forward"):
-                    hidden = model.apply(
-                        {"params": params}, rngs={"dropout": dropout_rng}, **kwargs
-                    )
+                    if health is not None and health.capture_intermediates:
+                        # mutable `intermediates`: the bodies' sow sites
+                        # (stage stats, attention entropy) become live
+                        hidden, variables = model.apply(
+                            {"params": params},
+                            rngs={"dropout": dropout_rng},
+                            mutable=["intermediates"],
+                            **kwargs,
+                        )
+                        intermediates = variables.get("intermediates", {})
+                    else:
+                        hidden = model.apply(
+                            {"params": params}, rngs={"dropout": dropout_rng}, **kwargs
+                        )
+                        intermediates = {}
                 logits_extra = {
                     name: batch[name] for name in self._logits_extra_params if name in batch
                 }
@@ -580,7 +637,7 @@ class Trainer:
                 if getattr(loss, "needs_rng", False):
                     loss.rng = loss_rng
                 with jax.named_scope("loss"):
-                    return loss(
+                    loss_value = loss(
                         hidden,
                         batch.get("feature_tensors", {}),
                         batch[label_f],
@@ -588,8 +645,16 @@ class Trainer:
                         batch[pad_f],
                         target_mask,
                     )
+                if health is None:
+                    return loss_value
+                return loss_value, (hidden, intermediates)
 
-            loss_value, grads = jax.value_and_grad(loss_fn)(state.params)
+            if health is None:
+                loss_value, grads = jax.value_and_grad(loss_fn)(state.params)
+            else:
+                (loss_value, (hidden, intermediates)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params)
             # non-finite sentinel: one fused flag decides, in-jit, whether this
             # update may touch the state. A NaN/Inf loss or gradient norm keeps
             # the previous params/opt_state (jnp.where select — no host round
@@ -599,6 +664,33 @@ class Trainer:
             good = jnp.isfinite(loss_value) & jnp.isfinite(grad_norm)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+
+            metrics = {"loss": loss_value, "good": good, "grad_norm": grad_norm}
+            if health is not None:
+                logits = None
+                if health.logits_stats and hasattr(type(model), "get_logits"):
+                    # last-position scoring-head stats (the catalog logits the
+                    # inference path serves) — cheap next to the loss's scoring
+                    last_hidden = hidden[:, -1, :] if hidden.ndim == 3 else hidden
+                    logits_extra = {
+                        name: batch[name]
+                        for name in self._logits_extra_params
+                        if name in batch
+                    }
+                    with jax.named_scope("health_logits"):
+                        logits = model.apply(
+                            {"params": state.params},
+                            last_hidden,
+                            None,
+                            method=type(model).get_logits,
+                            **logits_extra,
+                        )
+                with jax.named_scope("health"):
+                    health_tree = health_metrics(
+                        health, state.params, grads, updates, intermediates, logits
+                    )
+                health_tree["grad_norm_global"] = grad_norm
+                metrics["health"] = health_tree
 
             def keep(new, old):
                 return jnp.where(good, new, old)
@@ -610,7 +702,6 @@ class Trainer:
                 rng=rng,
                 bad_steps=state.bad_steps + (~good).astype(jnp.int32),
             )
-            metrics = {"loss": loss_value, "good": good, "grad_norm": grad_norm}
             return new_state, metrics
 
         return train_step
@@ -653,7 +744,7 @@ class Trainer:
         """
         if self._train_step is None:
             self._train_step = jax.jit(
-                self.compile_tracker.wrap(self._build_train_step(), "train_step"),
+                self.compile_tracker.wrap(self._build_train_step(self.health), "train_step"),
                 donate_argnums=0,
             )
         with self._h2d_span():
@@ -674,7 +765,11 @@ class Trainer:
         to K :meth:`train_step` calls.
         """
         if self._train_scan is None:
-            step_fn = self._build_train_step()
+            # the scan path stays health-free: stacking K per-step health
+            # pytrees would multiply the metrics payload by K for a path whose
+            # whole point is minimal host involvement (use train_step + a
+            # HealthConfig when diagnosing)
+            step_fn = self._build_train_step(None)
             self._train_scan = jax.jit(
                 self.compile_tracker.wrap(
                     lambda s, stacked: jax.lax.scan(step_fn, s, stacked), "train_scan"
@@ -827,6 +922,20 @@ class Trainer:
         it off for maximum-throughput runs. Epoch windows tile the run: each
         closes at its ``on_epoch_end`` emission, so the end-of-epoch
         checkpoint save lands in the NEXT epoch's window.
+
+        Model health (docs/performance.md "Model health"): a
+        :class:`~replay_tpu.obs.HealthConfig` on :attr:`health` makes the
+        jitted step also compute per-group gradient/parameter/update norms and
+        update ratios, activation RMS/absmax per stage, per-head attention
+        entropy, logits stats and embedding-row coverage — all on device. Fit
+        fetches the record every ``cadence`` steps (one device_get), attaches
+        it as a ``health`` payload to the next ``on_train_step`` and to every
+        ``on_epoch_end``, and — when the config carries a ``HealthWatcher`` —
+        emits ``on_health_warning`` on an EWMA blowup of the grad norm or max
+        update ratio, *before* the non-finite sentinel trips; with
+        ``trigger_recovery=True`` and a ``recovery`` policy the warning rolls
+        back immediately. Enabling health is exactly one compiled train-step
+        variant; the cadence is host-side, so no retraces after step 1.
         """
         if checkpoint_manager is not None and not self.history:
             # resume: prior epoch records survive the restart (metric-history
@@ -1033,6 +1142,19 @@ class Trainer:
         consecutive_bad, restarts = 0, 0
         initial_snapshot = None  # rollback target before any checkpoint exists
 
+        # -- model-health diagnostics (replay_tpu.obs.health) --------------- #
+        # the jitted step computes the health pytree every step (device-only);
+        # the host fetches it every cadence steps — one small device_get —
+        # attaches it to the next emitted on_train_step / the epoch-end event,
+        # and feeds the early-warning watcher
+        health_cfg = self.health
+        health_watcher = health_cfg.watcher if health_cfg is not None else None
+        pending_health: Optional[Dict[str, Any]] = None
+        last_grad_norm = None  # device scalar; float()ed once per epoch
+        # per-fit scope: a second fit must not attach the PREVIOUS fit's last
+        # record to its epoch-end events (cadence may exceed a short epoch)
+        self.last_health = None
+
         def do_recovery(reason: str, epoch: int) -> TrainState:
             with span("recovery", reason=reason):
                 return _do_recovery(reason, epoch)
@@ -1042,9 +1164,16 @@ class Trainer:
             back the LR off, and return the state to continue from. The batch
             stream is NOT rewound — recovery moves forward through the data."""
             nonlocal restarts, consecutive_bad, step_base
+            nonlocal pending_health, last_grad_norm
             restarts += 1
             consecutive_bad = 0
             step_base = None  # state.step jumps backward: refetch the base
+            # the discarded trajectory's records must not be attributed to the
+            # restored one: drop the un-emitted health record, the last grad
+            # norm, and the watcher's EWMA baseline (pre-blowup norms resume)
+            pending_health, last_grad_norm, self.last_health = None, None, None
+            if health_watcher is not None:
+                health_watcher.reset()
             if restarts > recovery.max_restarts:
                 emit("on_recovery", epoch=epoch, reason=reason, restarts=restarts,
                      exhausted=True)
@@ -1280,6 +1409,36 @@ class Trainer:
                     epoch_good = good_flag if epoch_good is None else epoch_good + good_flag
                     n_steps += 1
                     measured_total += 1
+                    last_grad_norm = step_metrics["grad_norm"]
+                    if (
+                        health_cfg is not None
+                        and "health" in step_metrics
+                        and measured_total % health_cfg.cadence == 0
+                    ):
+                        # THE health sync: one device_get of the small health
+                        # pytree — it blocks on the step's outputs, so the
+                        # record is loss-fenced like a StepTelemetry tick
+                        fetched = jax.device_get(step_metrics["health"])
+                        health_record = jax.tree.map(
+                            lambda x: x.tolist() if getattr(x, "ndim", 0) else float(x),
+                            fetched,
+                        )
+                        self.last_health = health_record
+                        pending_health = health_record
+                        if health_watcher is not None:
+                            warning = health_watcher.observe(health_record)
+                            if warning is not None:
+                                if step_base is None:
+                                    step_base = int(state.step) - measured_total
+                                emit(
+                                    "on_health_warning",
+                                    step=step_base + measured_total,
+                                    epoch=epoch,
+                                    **warning,
+                                )
+                                if health_watcher.trigger_recovery and recovery is not None:
+                                    state = do_recovery("health_warning", epoch)
+                                    epoch_loss, epoch_good = None, None
                     if check_anomalies or recovery is not None:
                         # a recovery policy must see every bad step even when
                         # detect_anomalies=False silenced the event emission
@@ -1327,7 +1486,11 @@ class Trainer:
                             samples_per_sec=tick["samples_per_sec"],
                             steps_per_sec=tick["steps_per_sec"],
                             step_seconds=tick["step_seconds"],
+                            # a health record fetched since the last emission
+                            # rides the next step event (cadences may differ)
+                            **({"health": pending_health} if pending_health is not None else {}),
                         )
+                        pending_health = None
                     boundary_saved = False
                     if (
                         checkpoint_every
@@ -1397,6 +1560,16 @@ class Trainer:
                          epoch=epoch, record=record)
                 self.history.append(record)
                 epoch_payload: Dict[str, Any] = {"record": record}
+                if state is not None:
+                    # reliability rollups: obs.report --compare gates on the
+                    # cumulative sentinel count, not just throughput/MFU
+                    epoch_payload["bad_steps"] = int(state.bad_steps)
+                if last_grad_norm is not None:
+                    # the last executed step's global grad norm (one scalar
+                    # sync per epoch; non-finite serializes as JSON null)
+                    epoch_payload["grad_norm"] = float(last_grad_norm)
+                if health_cfg is not None and self.last_health is not None:
+                    epoch_payload["health"] = self.last_health
                 if tracing:
                     # the goodput contract: phase fractions over this epoch's
                     # wall clock, summing to 1.0 (docs/performance.md)

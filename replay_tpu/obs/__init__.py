@@ -8,12 +8,15 @@ and a collectors layer measures what Lightning never could: jit retraces
 (:class:`CompileTracker`), device memory (:class:`MemoryMonitor`), steady-state
 throughput (:class:`StepTelemetry`) and achieved-vs-peak FLOPs (:mod:`.mfu`).
 :mod:`.trace` adds host-side span tracing + goodput accounting (where does
-wall-clock go BETWEEN steps — ``trace.json`` + per-epoch phase fractions), and
-:mod:`.report` is the run-report CLI over the artifacts
+wall-clock go BETWEEN steps — ``trace.json`` + per-epoch phase fractions),
+:mod:`.health` computes in-graph model-health diagnostics (per-group norms and
+update ratios, activation stats, attention entropy, the ``HealthWatcher``
+early warning), and :mod:`.report` is the run-report CLI over the artifacts
 (``python -m replay_tpu.obs.report <run_dir>``). Beyond-parity — SURVEY.md §5.
 """
 
 from .collectors import CompileTracker, MemoryMonitor, StepTelemetry
+from .health import HealthConfig, HealthWatcher, flatten_health, health_metrics
 from .events import (
     ConsoleLogger,
     JsonlLogger,
@@ -29,6 +32,8 @@ __all__ = [
     "CompileTracker",
     "ConsoleLogger",
     "GOODPUT_SPANS",
+    "HealthConfig",
+    "HealthWatcher",
     "JsonlLogger",
     "MemoryMonitor",
     "MultiLogger",
@@ -39,8 +44,10 @@ __all__ = [
     "Tracer",
     "TrainerEvent",
     "cost_analysis",
+    "flatten_health",
     "flops_per_step",
     "goodput_breakdown",
+    "health_metrics",
     "mfu",
     "peak_tflops",
     "traced_iterator",

@@ -7,7 +7,11 @@ trainer emits :class:`TrainerEvent` records to :class:`RunLogger` sinks.
 Event flow emitted by ``replay_tpu.nn.Trainer.fit``::
 
     on_fit_start
-      on_train_step*          (loss, lr, samples_per_sec, step_seconds)
+      on_train_step*          (loss, lr, samples_per_sec, step_seconds;
+                               + a `health` record every HealthConfig.cadence
+                               steps — obs.health)
+      on_health_warning*      (HealthWatcher EWMA blowup of grad norm /
+                               update ratio, BEFORE the sentinel trips)
       on_anomaly*             (a non-finite step the sentinel skipped:
                                loss, grad_norm, consecutive_bad)
       on_recovery*            (RecoveryPolicy rollback: reason, restored_step,
@@ -153,11 +157,14 @@ def _load_summary_writer():
 
 
 class TensorBoardLogger(RunLogger):
-    """Scalar writer over an optional TensorBoard backend.
+    """Scalar + histogram writer over an optional TensorBoard backend.
 
     Missing backend → a warning once, then every call is a no-op: attaching
     this logger can never break a training run (the optional-dependency rule
-    of utils/types.py applied to observability).
+    of utils/types.py applied to observability). ``health`` payloads
+    (obs.health) are routed specially: scalar leaves become ``health/...``
+    scalars, vector leaves (per-head attention entropies) become real
+    histograms via :meth:`log_histogram`.
     """
 
     def __init__(self, log_dir: str) -> None:
@@ -184,13 +191,43 @@ class TensorBoardLogger(RunLogger):
             elif not isinstance(value, bool) and isinstance(value, (int, float)):
                 yield key, value
 
+    def log_histogram(self, tag: str, values: Any, step: int = 0) -> None:
+        """Write one histogram; a no-op when no backend (or an ancient writer
+        without ``add_histogram``) is installed — same never-break contract
+        as the scalar path."""
+        if self._writer is None or not hasattr(self._writer, "add_histogram"):
+            return
+        import numpy as np
+
+        array = np.asarray(values, dtype=np.float64).reshape(-1)
+        array = array[np.isfinite(array)]
+        if array.size:
+            self._writer.add_histogram(tag, array, global_step=int(step))
+
+    def _log_health(self, health: Mapping[str, Any], step: int) -> None:
+        from .health import flatten_health
+
+        for tag, value in flatten_health(health).items():
+            if isinstance(value, (list, tuple)):
+                self.log_histogram(tag, value, step)
+            elif not isinstance(value, bool) and isinstance(value, (int, float)):
+                self._writer.add_scalar(tag, float(value), global_step=step)
+
     def log_event(self, event: TrainerEvent) -> None:
         if self._writer is None:
             return
         step = int(event.step) if event.step is not None else 0
-        for key, value in self._scalars(event.payload):
+        # `health` is routed whole through _log_health (scalars + histograms);
+        # letting _scalars flatten it too would double-log its top level
+        payload = {k: v for k, v in event.payload.items() if k != "health"}
+        for key, value in self._scalars(payload):
             tag = key if event.event == "on_train_step" else f"{event.event}/{key}"
             self._writer.add_scalar(tag, float(value), global_step=step)
+        health = event.payload.get("health")
+        if isinstance(health, Mapping) and event.event == "on_train_step":
+            # epoch-end events repeat the last fetched record — logging it
+            # again would double-count the histogram timeline
+            self._log_health(health, step)
 
     def close(self) -> None:
         if self._writer is not None:
@@ -232,6 +269,15 @@ class ConsoleLogger(RunLogger):
                     event.step,
                     event.payload.get("loss", float("nan")),
                 )
+        elif event.event == "on_health_warning":
+            logger.warning(
+                "health warning at step %s: %s blew up to %.3g (%.1fx its EWMA %.3g)",
+                event.step,
+                event.payload.get("signal"),
+                event.payload.get("value", float("nan")),
+                event.payload.get("factor", float("nan")),
+                event.payload.get("ewma", float("nan")),
+            )
         elif event.event == "on_anomaly":
             logger.warning(
                 "anomaly at step %s: non-finite loss/grads, update skipped "

@@ -1,0 +1,368 @@
+"""In-graph model-health diagnostics: layer-wise norms, update ratios,
+attention entropy, and an early-warning watcher.
+
+Beyond-parity (SURVEY.md §5): the reference stack leans on Lightning's
+``track_grad_norm`` / per-layer logging callbacks to catch silent divergence;
+large-batch transformer practice (LAMB, PAPERS.md) treats the layer-wise
+update-to-weight ratio as THE stability signal, and the PaLM run report
+credits loss-spike recovery to monitoring internals, not loss. Here all of it
+is computed *inside* the jitted train step:
+
+* per-parameter-group gradient / parameter / update norms and the
+  update-to-param ratio — groups derived from the param tree
+  (``embeddings`` / ``block_<i>`` / ``head``);
+* activation RMS + absmax per named stage and per-head attention entropy,
+  captured via flax ``sow`` on the SASRec/BERT4Rec bodies (the modules sow
+  only when the ``intermediates`` collection is mutable, so the
+  health-disabled step lowers to byte-identical HLO);
+* logits stats (last-position scoring head) and an embedding-row-coverage
+  counter (fraction of embedding rows touched by this batch's gradients).
+
+Everything stays on device as scalars/small vectors inside the step's
+``metrics`` pytree; the trainer fetches the ``health`` subtree every
+``cadence`` steps (one loss-fenced transfer, like ``StepTelemetry``) and
+routes it through the ``on_train_step`` / ``on_epoch_end`` events —
+TensorBoard sinks render the vector leaves as real histograms, jsonl keeps
+the summaries, and ``python -m replay_tpu.obs.report`` renders the
+"model health" section. :class:`HealthWatcher` turns the stream into an
+early warning: an EWMA blowup of the gradient norm or max update ratio emits
+``on_health_warning`` *before* the non-finite sentinel trips, optionally
+triggering the RecoveryPolicy rollback path (docs/robustness.md).
+
+Static-shape discipline: enabling health is exactly ONE compiled train-step
+variant (the groups and sow sites are resolved at trace time); ``cadence``
+is purely host-side, so there are no retraces after step 1.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "HealthConfig",
+    "HealthWatcher",
+    "flatten_health",
+    "health_metrics",
+    "param_group_key",
+    "sow_stage_stats",
+]
+
+_BLOCK_RE = re.compile(r"(block_\d+)")
+_EPS = 1e-12
+
+
+# --------------------------------------------------------------------------- #
+# configuration
+# --------------------------------------------------------------------------- #
+@dataclass(frozen=True)
+class HealthConfig:
+    """What the health-enabled train step computes and how often it is fetched.
+
+    ``cadence`` is the HOST fetch/emit period in steps — the device-side
+    computation runs every step (cheap scalar reductions fused into the step)
+    so changing the cadence never retraces. ``groups`` controls the per-group
+    norm/ratio block; ``activation_stats`` the sowed per-stage RMS/absmax;
+    ``attention_entropy`` the per-head entropies; ``logits_stats`` the
+    last-position scoring-head stats; ``embedding_coverage`` the fraction of
+    embedding rows with non-zero gradient this batch. ``watcher`` attaches an
+    early-warning :class:`HealthWatcher` evaluated at every fetch.
+    """
+
+    cadence: int = 10
+    groups: bool = True
+    activation_stats: bool = True
+    attention_entropy: bool = True
+    logits_stats: bool = True
+    embedding_coverage: bool = True
+    watcher: Optional["HealthWatcher"] = None
+
+    def __post_init__(self) -> None:
+        if self.cadence < 1:
+            msg = "cadence must be >= 1 (steps between host fetches)"
+            raise ValueError(msg)
+
+    @property
+    def capture_intermediates(self) -> bool:
+        """Whether the train step must run the forward with the
+        ``intermediates`` collection mutable (sow capture)."""
+        return self.activation_stats or self.attention_entropy
+
+
+# --------------------------------------------------------------------------- #
+# early warning
+# --------------------------------------------------------------------------- #
+@dataclass
+class HealthWatcher:
+    """EWMA blowup detector over the health stream (host-side, O(1) state).
+
+    Tracks an exponentially-weighted moving average of the global gradient
+    norm and the max per-group update ratio; a finite observation exceeding
+    ``blowup_factor`` × its EWMA (after ``warmup`` clean observations) is a
+    warning — fired through ``on_health_warning`` *before* loss/grads go
+    non-finite, because norms grow geometrically for several steps before
+    they overflow. Warned values are NOT folded into the EWMA (the baseline
+    must not chase the blowup), and :meth:`reset` clears the state after a
+    RecoveryPolicy rollback (the restored trajectory has pre-blowup norms).
+
+    ``trigger_recovery=True`` asks ``fit`` to treat a warning like a sentinel
+    trigger: the RecoveryPolicy (when attached) rolls back immediately
+    instead of waiting for ``max_consecutive_bad`` non-finite steps.
+    """
+
+    alpha: float = 0.3
+    blowup_factor: float = 5.0
+    warmup: int = 3
+    trigger_recovery: bool = False
+    _ewma: Dict[str, float] = field(default_factory=dict, repr=False)
+    _seen: int = field(default=0, repr=False)
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.alpha <= 1.0:
+            msg = "alpha must be in (0, 1]"
+            raise ValueError(msg)
+        if self.blowup_factor <= 1.0:
+            msg = "blowup_factor must be > 1"
+            raise ValueError(msg)
+        if self.warmup < 1:
+            msg = "warmup must be >= 1"
+            raise ValueError(msg)
+
+    @staticmethod
+    def _signals(record: Mapping[str, Any]) -> Dict[str, float]:
+        signals: Dict[str, float] = {}
+        # "grad_norm" proper is the per-GROUP dict; the global norm rides the
+        # health record as grad_norm_global (the trainer reuses the sentinel's)
+        value = record.get("grad_norm_global", record.get("grad_norm"))
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            signals["grad_norm"] = float(value)
+        ratios = record.get("update_ratio")
+        if isinstance(ratios, Mapping):
+            finite = [
+                float(v)
+                for v in ratios.values()
+                if isinstance(v, (int, float)) and math.isfinite(float(v))
+            ]
+            if finite:
+                signals["update_ratio_max"] = max(finite)
+        return signals
+
+    def observe(self, record: Mapping[str, Any]) -> Optional[Dict[str, Any]]:
+        """Fold one fetched health record in; a blowup returns the warning
+        payload (signal, value, ewma, factor), a clean record returns None.
+        Non-finite values are ignored — once loss/grads are NaN the sentinel
+        already owns the incident; the watcher's job is the steps before."""
+        warning: Optional[Dict[str, Any]] = None
+        signals = self._signals(record)
+        clean = True
+        for name, value in signals.items():
+            if not math.isfinite(value):
+                continue
+            baseline = self._ewma.get(name)
+            # each signal's blowup is judged independently: when two blow up
+            # on the same fetch, the first becomes THE warning but the second
+            # must not slip into its EWMA either (a poisoned baseline would
+            # mask that signal's next real warning)
+            blown = (
+                baseline is not None
+                and self._seen >= self.warmup
+                and baseline > 0.0
+                and value > self.blowup_factor * baseline
+            )
+            if blown:
+                clean = False
+                if warning is None:
+                    warning = {
+                        "signal": name,
+                        "value": value,
+                        "ewma": baseline,
+                        "factor": value / baseline,
+                        "blowup_factor": self.blowup_factor,
+                    }
+                continue  # a blowing-up value must not become the baseline
+            self._ewma[name] = (
+                value if baseline is None else self.alpha * value + (1 - self.alpha) * baseline
+            )
+        if signals and clean:
+            self._seen += 1
+        return warning
+
+    def reset(self) -> None:
+        """Forget the baseline (call after a rollback: the restored
+        trajectory's norms are pre-blowup)."""
+        self._ewma.clear()
+        self._seen = 0
+
+
+# --------------------------------------------------------------------------- #
+# in-graph computation (called from inside the jitted train step)
+# --------------------------------------------------------------------------- #
+def param_group_key(path_str: str) -> str:
+    """Parameter-group key for one param-tree path: ``block_<i>`` for encoder
+    blocks, ``embeddings`` for any embedding table (feature/positional/mask),
+    ``head`` for everything else (norms, aggregator projections, towers)."""
+    match = _BLOCK_RE.search(path_str)
+    if match:
+        return match.group(1)
+    if "embed" in path_str.lower():
+        return "embeddings"
+    return "head"
+
+
+def _grouped_leaves(tree: Any) -> Dict[str, List[Tuple[str, Any]]]:
+    import jax
+
+    groups: Dict[str, List[Tuple[str, Any]]] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        path_str = jax.tree_util.keystr(path)
+        groups.setdefault(param_group_key(path_str), []).append((path_str, leaf))
+    return groups
+
+
+def _group_norm(leaves: List[Tuple[str, Any]]):
+    import jax.numpy as jnp
+
+    total = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for _, leaf in leaves)
+    return jnp.sqrt(total)
+
+
+def sow_stage_stats(module, name: str, x) -> None:
+    """Sow ``<name>_rms`` / ``<name>_absmax`` scalars for one named stage.
+
+    A no-op unless the caller made the ``intermediates`` collection mutable
+    (the health-enabled train step); the guard is python-level, so the
+    disabled forward lowers to byte-identical HLO.
+    """
+    if not module.is_mutable_collection("intermediates"):
+        return
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    module.sow("intermediates", f"{name}_rms", jnp.sqrt(jnp.mean(jnp.square(x32))))
+    module.sow("intermediates", f"{name}_absmax", jnp.max(jnp.abs(x32)))
+
+
+def _iter_sowed(tree: Any, prefix: str = ""):
+    """Flatten a flax ``intermediates`` collection into (path, values) pairs;
+    sow stores each site as a tuple (one entry per call — e.g. BERT4Rec's
+    ``num_passes_over_block`` repeats), surfaced here as a list."""
+    if isinstance(tree, Mapping):
+        for key, value in tree.items():
+            yield from _iter_sowed(value, f"{prefix}/{key}" if prefix else str(key))
+    else:
+        values = list(tree) if isinstance(tree, (tuple, list)) else [tree]
+        yield prefix, values
+
+
+def _mean_of(values):
+    import jax.numpy as jnp
+
+    if len(values) == 1:
+        return values[0]
+    return jnp.mean(jnp.stack(values), axis=0)
+
+
+def health_metrics(
+    config: HealthConfig,
+    params: Any,
+    grads: Any,
+    updates: Any,
+    intermediates: Optional[Mapping[str, Any]] = None,
+    logits: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """The in-graph ``health`` pytree for one train step (device scalars and
+    small vectors only — nothing here forces a host transfer).
+
+    ``params``/``grads``/``updates`` are the step's pre-update parameters,
+    raw gradients and optimizer-produced updates; ``intermediates`` is the
+    captured flax collection (stage stats + attention entropies sowed by the
+    model bodies); ``logits`` is an optional already-computed logits tensor
+    for the logits-stats block.
+    """
+    import jax.numpy as jnp
+
+    health: Dict[str, Any] = {}
+    param_groups = _grouped_leaves(params)
+    if config.groups:
+        grad_groups = _grouped_leaves(grads)
+        update_groups = _grouped_leaves(updates)
+        health["grad_norm"] = {g: _group_norm(leaves) for g, leaves in grad_groups.items()}
+        health["param_norm"] = {g: _group_norm(leaves) for g, leaves in param_groups.items()}
+        health["update_norm"] = {
+            g: _group_norm(leaves) for g, leaves in update_groups.items()
+        }
+        health["update_ratio"] = {
+            g: health["update_norm"][g] / (health["param_norm"][g] + _EPS)
+            for g in health["update_norm"]
+            if g in health["param_norm"]
+        }
+    if config.embedding_coverage:
+        # feature VOCAB tables only — the "embedding_<feature>" naming
+        # convention _params_shardings shards by. Positional/mask tables are
+        # touched every batch and would inflate the fraction-of-catalog-rows
+        # signal this exists to provide (meaningful under sampled losses).
+        def is_vocab_table(path_str: str, leaf) -> bool:
+            return "embedding_" in path_str and getattr(leaf, "ndim", 0) == 2
+
+        tables = [
+            leaf for path, leaf in param_groups.get("embeddings", []) if is_vocab_table(path, leaf)
+        ]
+        grad_tables = [
+            leaf
+            for path, leaf in _grouped_leaves(grads).get("embeddings", [])
+            if is_vocab_table(path, leaf)
+        ]
+        if grad_tables:
+            touched = sum(
+                jnp.sum(jnp.any(g != 0, axis=tuple(range(1, g.ndim)))) for g in grad_tables
+            )
+            total_rows = sum(t.shape[0] for t in tables) or 1
+            health["embedding_coverage"] = touched.astype(jnp.float32) / float(total_rows)
+    if intermediates is not None and (config.activation_stats or config.attention_entropy):
+        activations: Dict[str, Dict[str, Any]] = {}
+        entropies: Dict[str, Any] = {}
+        for path, values in _iter_sowed(intermediates):
+            leaf_name = path.rsplit("/", 1)[-1]
+            if config.attention_entropy and leaf_name == "attention_entropy":
+                match = _BLOCK_RE.search(path)
+                entropies[match.group(1) if match else path] = _mean_of(values)
+            elif config.activation_stats and leaf_name.endswith(("_rms", "_absmax")):
+                stage, _, stat = leaf_name.rpartition("_")
+                activations.setdefault(stage, {})[stat] = _mean_of(values)
+        if activations:
+            health["activations"] = activations
+        if entropies:
+            health["attention_entropy"] = entropies  # {block: [H] nats}
+            health["attention_entropy_mean"] = jnp.mean(
+                jnp.concatenate([jnp.ravel(e) for e in entropies.values()])
+            )
+    if config.logits_stats and logits is not None:
+        logits32 = logits.astype(jnp.float32)
+        health["logits"] = {
+            "mean": jnp.mean(logits32),
+            "absmax": jnp.max(jnp.abs(logits32)),
+            "std": jnp.std(logits32),
+        }
+    return health
+
+
+# --------------------------------------------------------------------------- #
+# host-side helpers (event payloads / report rendering)
+# --------------------------------------------------------------------------- #
+def flatten_health(record: Mapping[str, Any], prefix: str = "health") -> Dict[str, Any]:
+    """Flatten a fetched health record to ``{tag: scalar-or-vector}`` — the
+    TensorBoard routing shape (scalars → ``add_scalar``, vectors →
+    ``add_histogram``)."""
+    flat: Dict[str, Any] = {}
+
+    def walk(node: Any, tag: str) -> None:
+        if isinstance(node, Mapping):
+            for key, value in node.items():
+                walk(value, f"{tag}/{key}")
+        else:
+            flat[tag] = node
+
+    walk(record, prefix)
+    return flat

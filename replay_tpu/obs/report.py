@@ -8,7 +8,9 @@
 Turns the telemetry artifacts every trainer/bench/dry run leaves behind into
 the one-page answer "Demystifying BERT" (PAPERS.md) says a profile must
 become: throughput, MFU, the goodput breakdown (where wall-clock went between
-steps), retraces, and bad/recovered steps. ``--compare`` diffs two runs —
+steps), retraces, bad/recovered steps, and the model-health record
+(obs.health: per-group norms/update ratios, activation stats, attention
+entropy, early warnings). ``--compare`` diffs two runs —
 either run may be a run directory, a raw ``events.jsonl``, or a single-record
 bench JSON (``BENCH_*.json`` / ``BENCH_TPU_SIDECAR.json``) — and exits
 non-zero when the candidate regresses beyond ``--threshold`` (relative), so
@@ -148,6 +150,9 @@ def summarize_events(
         "anomalies": sum(1 for e in events if e.get("event") == "on_anomaly"),
         "recoveries": sum(1 for e in events if e.get("event") == "on_recovery"),
         "preemptions": sum(1 for e in events if e.get("event") == "on_preemption"),
+        "health_warnings": sum(
+            1 for e in events if e.get("event") == "on_health_warning"
+        ),
     }
     summary["backend"] = next(
         (e["backend"] for e in events if isinstance(e.get("backend"), str)), None
@@ -156,6 +161,28 @@ def summarize_events(
     fit_end = fit_ends[-1] if fit_ends else {}
     telemetry = fit_end.get("telemetry") or {}
     summary["bad_steps"] = fit_end.get("bad_steps")
+    if summary["bad_steps"] is None:
+        # crashed/killed runs have no on_fit_end: the epoch-end rollup is the
+        # next best sentinel evidence
+        summary["bad_steps"] = next(
+            (e["bad_steps"] for e in reversed(epoch_ends) if "bad_steps" in e), None
+        )
+    summary["last_grad_norm"] = next(
+        (
+            value
+            for e in reversed(epoch_ends)
+            for value in [_finite(e.get("grad_norm"))]
+            if value is not None
+        ),
+        None,
+    )
+
+    # the latest model-health record (obs.health): rides on_train_step /
+    # on_epoch_end events from health-enabled fits, and dryrun_multichip records
+    summary["health"] = next(
+        (dict(e["health"]) for e in reversed(list(events)) if isinstance(e.get("health"), Mapping)),
+        None,
+    )
 
     # throughput: steady-state fit telemetry > bench headline > step-event mean
     throughput = _finite(telemetry.get("samples_per_sec"))
@@ -290,8 +317,55 @@ def render(summary: Mapping[str, Any]) -> str:
         f"anomalies={summary.get('anomalies', 0)}",
         f"recoveries={summary.get('recoveries', 0)}",
         f"preemptions={summary.get('preemptions', 0)}",
+        (
+            f"last_grad_norm={summary['last_grad_norm']:.3g}"
+            if summary.get("last_grad_norm") is not None
+            else None
+        ),
     ]
     lines.append("  reliability: " + " ".join(part for part in reliability if part))
+    health = summary.get("health")
+    if health:
+        parts = []
+        value = _finite(health.get("grad_norm_global"))
+        if value is not None:
+            parts.append(f"grad_norm {value:.3g}")
+        ratios = health.get("update_ratio")
+        if isinstance(ratios, Mapping):
+            finite = {
+                name: v for name, v in ((n, _finite(r)) for n, r in ratios.items()) if v is not None
+            }
+            if finite:
+                worst = max(finite, key=finite.get)
+                parts.append(f"max update_ratio {finite[worst]:.3g} ({worst})")
+        value = _finite(health.get("attention_entropy_mean"))
+        if value is not None:
+            parts.append(f"attn entropy {value:.3f} nats")
+        value = _finite(health.get("embedding_coverage"))
+        if value is not None:
+            parts.append(f"emb coverage {100.0 * value:.0f}%")
+        logits = health.get("logits")
+        if isinstance(logits, Mapping) and _finite(logits.get("absmax")) is not None:
+            parts.append(f"logits absmax {_finite(logits.get('absmax')):.3g}")
+        parts.append(f"warnings {summary.get('health_warnings', 0)}")
+        lines.append("  model health: " + " · ".join(parts))
+        norms = health.get("grad_norm")
+        if isinstance(norms, Mapping) and norms:
+            shown = " · ".join(
+                f"{name} {_fmt(_finite(v), '{:.3g}')}" for name, v in sorted(norms.items())
+            )
+            lines.append(f"    group grad norms: {shown}")
+        activations = health.get("activations")
+        if isinstance(activations, Mapping) and activations:
+            shown = " · ".join(
+                f"{stage} rms {_fmt(_finite(stats.get('rms')), '{:.3g}')}"
+                f"/max {_fmt(_finite(stats.get('absmax')), '{:.3g}')}"
+                for stage, stats in sorted(activations.items())
+                if isinstance(stats, Mapping)
+            )
+            lines.append(f"    activations: {shown}")
+    elif summary.get("health_warnings"):
+        lines.append(f"  model health: warnings {summary['health_warnings']}")
     goodput = summary.get("goodput")
     if goodput:
         fractions = goodput.get("fractions") or {}
@@ -377,6 +451,25 @@ def compare_runs(
             regressions.append(
                 f"retraces increased {base_retraces} -> {cand_retraces} (shape leak?)"
             )
+    # anomaly-count gates: a run that skips more steps (or warns more) than
+    # its baseline regressed in stability even when throughput held
+    for name, label in (
+        ("bad_steps", "bad_steps"),
+        ("anomalies", "anomalies"),
+        ("health_warnings", "health warnings"),
+    ):
+        cand_count, base_count = candidate.get(name), baseline.get(name)
+        if (
+            isinstance(cand_count, int)
+            and isinstance(base_count, int)
+            and not isinstance(cand_count, bool)
+            and not isinstance(base_count, bool)
+        ):
+            lines.append(f"  {label}: {cand_count} vs {base_count}")
+            if cand_count > base_count:
+                regressions.append(
+                    f"{label} increased {base_count} -> {cand_count} (model-health regression)"
+                )
     cand_gp, base_gp = candidate.get("goodput"), baseline.get("goodput")
     if cand_gp and base_gp:
         for name in (*GOODPUT_SPANS, "other"):
