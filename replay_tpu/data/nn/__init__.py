@@ -9,7 +9,7 @@ from .iterator import (
 from .module import DataModule
 from .parquet import ParquetBatcher, write_sequence_parquet
 from .partitioning import Partitioning, ReplicasInfo
-from .prefetch import prefetch
+from .prefetch import DevicePrefetcher, prefetch
 from .schema import TensorFeatureInfo, TensorFeatureSource, TensorMap, TensorSchema
 from .sequence_tokenizer import SequenceTokenizer
 from .sequential_dataset import SequentialDataset
@@ -31,6 +31,7 @@ __all__ = [
     "Partitioning",
     "ReplicasInfo",
     "SequenceBatcher",
+    "DevicePrefetcher",
     "prefetch",
     "SequenceTokenizer",
     "SequentialDataset",

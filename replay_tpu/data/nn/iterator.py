@@ -67,6 +67,7 @@ class SequenceBatcher:
         length (the SURVEY §7 padding-waste mitigation). XLA compiles one
         program per distinct shape — a handful of buckets, not per-batch
         dynamic shapes. ``max_sequence_length`` remains the top bucket.
+        Incompatible with the scan-chunked fit (see :attr:`scan_compatible`).
     :param tracer: optional :class:`replay_tpu.obs.Tracer`: every batch
         assembly is recorded as a ``batch_build`` span. Share the trainer's
         tracer to see, inside its ``data_wait`` phase, how much is THIS
@@ -161,6 +162,15 @@ class SequenceBatcher:
     def set_epoch(self, epoch: int) -> None:
         """Advance the shuffle epoch (folds into the partitioning seed)."""
         self.epoch = epoch
+
+    @property
+    def scan_compatible(self) -> bool:
+        """Whether every emitted batch shares ONE ``[B, L]`` shape — the
+        precondition for the scan-chunked fit (``Trainer.fit(scan_chunk=...)``
+        stacks K batches into one ``[K, B, L]`` program input). Length
+        bucketing emits a SET of widths, so a bucketed batcher is not scan
+        compatible; ``Trainer.fit`` rejects the combination at fit start."""
+        return not self.bucket_boundaries
 
     def _entry_order(self) -> np.ndarray:
         part = self.partitioning or Partitioning(shuffle=self.shuffle, seed=self.seed)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import inspect
+import itertools
 import logging
 import math
 import os
@@ -412,6 +413,47 @@ def _params_shardings(mesh: Mesh, params: Any, shard_vocab: bool) -> Any:
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def _chunk_schedule(
+    batches: Iterable[Batch],
+    chunk: int,
+    health_every: Optional[int] = None,
+    start: int = 0,
+):
+    """Group an executable batch stream into scan chunks and single steps.
+
+    Yields ``("scan", [batch] * chunk)`` for full groups and
+    ``("step", batch)`` otherwise. A step whose 1-based executed position
+    (counted from ``start``, i.e. the fit's ``measured_total``) lands on a
+    ``health_every`` cadence boundary is emitted singly — it must run through
+    the health-instrumented per-step program, not the health-free scan — as
+    are the (< chunk) leftovers before such a boundary and the epoch's short
+    tail. Order is always the stream order; only the dispatch granularity
+    changes. With ``health_every ≡ 1 (mod chunk)`` every inter-health gap
+    packs into full chunks (docs/performance.md "Closing the dispatch gap").
+    """
+    buffered: List[Batch] = []
+    position = start
+
+    def flush():
+        # leftovers shorter than a full chunk run per-step: ONE compiled scan
+        # length + the per-step program, never a zoo of chunk-length variants
+        for leftover in buffered:
+            yield ("step", leftover)
+        buffered.clear()
+
+    for batch in batches:
+        position += 1
+        if health_every and position % health_every == 0:
+            yield from flush()
+            yield ("step", batch)
+            continue
+        buffered.append(batch)
+        if len(buffered) == chunk:
+            yield ("scan", list(buffered))
+            buffered.clear()
+    yield from flush()
+
+
 # --------------------------------------------------------------------------- #
 # Trainer
 # --------------------------------------------------------------------------- #
@@ -754,6 +796,51 @@ class Trainer:
         self.last_step_metrics = metrics
         return new_state, metrics["loss"]
 
+    def _ensure_train_scan(self):
+        """The jitted K-step ``lax.scan`` program, built lazily (and rebuilt
+        after anything that invalidates the per-step program: an LR-backoff
+        rollback, a vocabulary resize).
+
+        The scan path stays health-free: stacking K per-step health pytrees
+        would multiply the metrics payload by K for a path whose whole point
+        is minimal host involvement — ``fit(scan_chunk=...)`` interleaves
+        health-instrumented single steps at the fetch cadence instead.
+
+        Donation contract (the device feed leans on this): ONLY the TrainState
+        argument is donated. The ``[K, ...]`` batch chunk is never donated, so
+        a chunk pre-placed by :class:`~replay_tpu.data.nn.DevicePrefetcher`
+        while the previous chunk executes cannot alias buffers this dispatch
+        will invalidate.
+        """
+        if self._train_scan is None:
+            step_fn = self._build_train_step(None)
+            self._train_scan = jax.jit(
+                self.compile_tracker.wrap(
+                    lambda s, stacked: jax.lax.scan(step_fn, s, stacked), "train_scan"
+                ),
+                donate_argnums=0,
+            )
+        return self._train_scan
+
+    @staticmethod
+    def _stack_chunk(batches: Sequence[Batch]) -> Batch:
+        """K same-shape host batches stacked into one ``[K, ...]`` pytree (the
+        scan program's ``xs``), with a clear error for the one sanctioned
+        shape relaxation that cannot feed a scan."""
+        try:
+            return jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *list(batches)
+            )
+        except ValueError as exc:
+            msg = (
+                "scan chunking stacks every batch of a chunk into one fixed "
+                f"[K, ...] program input, but stacking failed: {exc}. All "
+                "batches must share one shape and key structure — length-"
+                "bucketed batchers (SequenceBatcher(bucket_boundaries=...)) "
+                "emit a SET of widths and cannot drive fit(scan_chunk=...)."
+            )
+            raise ValueError(msg) from exc
+
     def train_steps(
         self, state: TrainState, batches: Sequence[Batch]
     ) -> Tuple[TrainState, np.ndarray]:
@@ -762,27 +849,16 @@ class Trainer:
         Amortizes host→device dispatch latency over K steps — the TPU stays busy
         while the host is out of the loop (one compiled program per chunk
         length). Returns the per-step losses as a ``[K]`` array. Identical math
-        to K :meth:`train_step` calls.
+        to K :meth:`train_step` calls. ``fit(scan_chunk=K)`` drives this path
+        end-to-end with a device-feed stage overlapping the H2D copies
+        (docs/performance.md "Closing the dispatch gap").
         """
-        if self._train_scan is None:
-            # the scan path stays health-free: stacking K per-step health
-            # pytrees would multiply the metrics payload by K for a path whose
-            # whole point is minimal host involvement (use train_step + a
-            # HealthConfig when diagnosing)
-            step_fn = self._build_train_step(None)
-            self._train_scan = jax.jit(
-                self.compile_tracker.wrap(
-                    lambda s, stacked: jax.lax.scan(step_fn, s, stacked), "train_scan"
-                ),
-                donate_argnums=0,
-            )
-        stacked = jax.tree.map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]), *list(batches)
-        )
+        scan_fn = self._ensure_train_scan()
+        stacked = self._stack_chunk(batches)
         with self._h2d_span():
             placed = self._put_stacked(stacked)
         with self.compile_tracker.observe("train_scan"):
-            new_state, metrics = self._train_scan(state, placed)
+            new_state, metrics = scan_fn(state, placed)
         # per-step [K] arrays (loss / sentinel good flags / grad norms)
         self.last_step_metrics = metrics
         return new_state, np.asarray(metrics["loss"])
@@ -813,6 +889,34 @@ class Trainer:
 
         return jax.tree.map(place, stacked)
 
+    def _chunk_placer(self, tracer: Optional[Tracer]):
+        """The device-feed ``place`` callable for the scan-chunked fit: stack
+        + place a chunk on the FEEDER thread, so the next chunk's H2D copy
+        overlaps the running chunk's compute. Single-step items pass through
+        unplaced — the per-step path places its own batch (pre-placing would
+        make ``_put_batch``'s ``np.asarray`` round-trip them back to host).
+        The ``h2d`` span lands on the feeder thread's timeline: ``trace.json``
+        shows the overlap, while the fit thread's goodput fractions count only
+        what the feed could NOT hide."""
+
+        def place(item):
+            kind, payload = item
+            if kind != "scan":
+                return None
+            span = (
+                tracer.span("h2d", steps=len(payload))
+                if tracer is not None and tracer.enabled
+                else contextlib.nullcontext()
+            )
+            with span:
+                placed = self._put_stacked(self._stack_chunk(payload))
+                # fence on the feeder thread: the span times the real copy,
+                # and the consumer dispatches on already-resident buffers
+                jax.block_until_ready(placed)
+            return placed
+
+        return place
+
     def fit(
         self,
         train_batches: Iterable[Batch] | Callable[[], Iterable[Batch]],
@@ -833,6 +937,8 @@ class Trainer:
         patience: Optional[int] = None,
         mode: str = "max",
         prefetch: int = 0,
+        scan_chunk: Optional[int] = None,
+        device_feed: bool = True,
         loggers: Optional[RunLogger | Sequence[RunLogger]] = None,
         profile_steps: Optional[Tuple[int, int]] = None,
         profile_dir: Optional[str] = None,
@@ -884,6 +990,35 @@ class Trainer:
         checkpoint and fast-forwards the (deterministic, epoch-seeded) batch
         stream to that exact position, so a killed run continues with the same
         loss curve as an uninterrupted one.
+
+        Dispatch amortization (docs/performance.md "Closing the dispatch
+        gap"): ``scan_chunk=K`` drives the :meth:`train_steps` ``lax.scan``
+        path end-to-end — each epoch's batches are grouped into fixed-K
+        chunks dispatched as ONE XLA program (bitwise-identical math to K
+        per-step calls), with the short tail on the existing per-step path
+        (exactly one extra compiled variant, no dynamic shapes). In front of
+        it, ``device_feed=True`` (the default) runs a
+        :class:`~replay_tpu.data.nn.DevicePrefetcher`: a feeder thread
+        stacks the NEXT chunk and issues its ``device_put`` /
+        ``make_array_from_process_local_data`` while the current chunk is
+        still executing, so the host→device copy overlaps compute
+        (donation-safe: the scan donates only the TrainState, never the
+        chunk). Per-step accounting is preserved exactly: the chunk's ``[K]``
+        loss/sentinel/grad-norm arrays come to host once per chunk and fan
+        back out through the same bookkeeping as the per-step loop —
+        ``on_train_step`` cadence, exact ``on_anomaly`` step indices and
+        ``bad_steps`` totals, epoch-loss averaging. What moves to chunk
+        granularity: ``checkpoint_every`` boundaries crossed inside a chunk
+        save once at the chunk end (the state only exists at chunk
+        boundaries), preemption exits at the next chunk boundary, and a
+        recovery rollback triggered by a mid-chunk step discards the rest of
+        that chunk's (already-executed, pre-rollback) accounting while the
+        stream position still advances. With a :class:`HealthConfig`
+        attached, every ``cadence``-th step is interleaved as a
+        health-instrumented single step (the per-step program — no silent
+        health loss; pick ``cadence ≡ 1 (mod scan_chunk)`` to keep full
+        chunks between them). Requires ONE fixed batch shape:
+        ``SequenceBatcher(bucket_boundaries=...)`` is rejected at fit start.
 
         Resilience (docs/robustness.md): the train step's non-finite sentinel
         always protects the state — a NaN/Inf loss or gradient norm discards
@@ -962,6 +1097,30 @@ class Trainer:
         if patience is not None and patience < 1:
             msg = "patience must be >= 1 (it counts consecutive non-improving epochs)"
             raise ValueError(msg)
+        def reject_bucketed(source) -> None:
+            """Bucketed batchers cannot feed the scan: fail up front with the
+            real reason, not an opaque np.stack error mid-epoch. Checked on
+            the fit argument AND on what a factory callable returns (the
+            factory object itself carries no batcher attributes)."""
+            if getattr(source, "bucket_boundaries", None) or (
+                hasattr(source, "scan_compatible") and not source.scan_compatible
+            ):
+                msg = (
+                    "fit(scan_chunk=...) stacks K batches into one compiled "
+                    "[K, B, L] scan program, which requires ONE fixed batch "
+                    f"shape; {type(source).__name__}(bucket_boundaries=...) "
+                    "emits a set of widths. Drop the bucketing or the "
+                    "scan_chunk (docs/performance.md 'Closing the dispatch "
+                    "gap')."
+                )
+                raise ValueError(msg)
+
+        if scan_chunk is not None:
+            scan_chunk = int(scan_chunk)
+            if scan_chunk < 1:
+                msg = "scan_chunk must be >= 1 (optimizer steps per lax.scan dispatch)"
+                raise ValueError(msg)
+            reject_bucketed(train_batches)
 
         start_epoch, skip_steps, pending_restore_step = 0, 0, None
         resumed_best_step = None
@@ -1149,6 +1308,20 @@ class Trainer:
         # and feeds the early-warning watcher
         health_cfg = self.health
         health_watcher = health_cfg.watcher if health_cfg is not None else None
+        # the scan program is health-free — chunking must not silently drop
+        # the diagnostics, so every cadence-th step runs as an interleaved
+        # health-instrumented single step (_chunk_schedule breaks chunks there)
+        health_every = (
+            health_cfg.cadence if (scan_chunk and health_cfg is not None) else None
+        )
+        if health_every:
+            logger.info(
+                "scan_chunk=%d with health cadence %d: every %dth step runs "
+                "the health-instrumented per-step program (no silent health "
+                "loss); cadence ≡ 1 (mod scan_chunk) keeps full chunks "
+                "between health steps",
+                scan_chunk, health_every, health_every,
+            )
         pending_health: Optional[Dict[str, Any]] = None
         last_grad_norm = None  # device scalar; float()ed once per epoch
         # per-fit scope: a second fit must not attach the PREVIOUS fit's last
@@ -1334,6 +1507,142 @@ class Trainer:
                  note="resume: run already complete", **fit_end_payload())
             return _place_tree(restored, jax.tree.map(self._template_sharding, template))
 
+        def account_step(
+            batch: Batch,
+            loss_value,
+            step_metrics: Mapping[str, Any],
+            epoch: int,
+            step_id: Optional[int] = None,
+            bad_total: Optional[int] = None,
+            on_host: bool = False,
+        ) -> bool:
+            """Post-execution bookkeeping for ONE optimizer step — epoch
+            loss/sentinel accumulation, health fetch + watcher, anomaly
+            events, profiler-window close, per-step event emission — shared
+            verbatim by the per-step loop and the scan fan-out. The fan-out
+            passes host numpy metrics (``on_host=True``; the chunk's [K]
+            arrays were already fetched in one sync) plus explicit
+            ``step_id``/``bad_total``, because ``state.step``/``bad_steps``
+            already sit at the chunk END during fan-out. Returns True when a
+            recovery rollback fired, so a chunked caller discards the rest of
+            its chunk's pre-rollback steps.
+            """
+            nonlocal epoch_loss, epoch_good, n_steps, measured_total
+            nonlocal last_grad_norm, pending_health, consecutive_bad, step_base
+            nonlocal state, profile_active
+            rolled_back = False
+            good = step_metrics["good"]
+            if on_host:
+                # same IEEE f32 adds as the device accumulation below, on the
+                # already-fetched values — bitwise-identical epoch averages
+                safe_loss = np.float32(loss_value) if bool(good) else np.float32(0.0)
+                good_flag = np.int32(bool(good))
+                if epoch_loss is not None and not isinstance(epoch_loss, np.generic):
+                    # an interleaved device-accumulated step (health single
+                    # step) made the accumulator a device scalar: fold it back
+                    # to host ONCE — its value is already fenced by that
+                    # step's health fetch — so the chunk fan-out below never
+                    # dispatches K tiny device adds per chunk
+                    epoch_loss = np.float32(epoch_loss)
+                    epoch_good = np.int32(epoch_good)
+            else:
+                # accumulate on device: float() here would sync every step.
+                # Sentinel-skipped steps contribute 0 (their loss is
+                # non-finite and would poison the epoch average).
+                safe_loss = jnp.where(good, loss_value, 0.0)
+                good_flag = good.astype(jnp.int32)
+            epoch_loss = safe_loss if epoch_loss is None else epoch_loss + safe_loss
+            epoch_good = good_flag if epoch_good is None else epoch_good + good_flag
+            n_steps += 1
+            measured_total += 1
+            last_grad_norm = step_metrics["grad_norm"]
+            if (
+                health_cfg is not None
+                and "health" in step_metrics
+                and measured_total % health_cfg.cadence == 0
+            ):
+                # THE health sync: one device_get of the small health
+                # pytree — it blocks on the step's outputs, so the
+                # record is loss-fenced like a StepTelemetry tick
+                fetched = jax.device_get(step_metrics["health"])
+                health_record = jax.tree.map(
+                    lambda x: x.tolist() if getattr(x, "ndim", 0) else float(x),
+                    fetched,
+                )
+                self.last_health = health_record
+                pending_health = health_record
+                if health_watcher is not None:
+                    warning = health_watcher.observe(health_record)
+                    if warning is not None:
+                        if step_base is None:
+                            step_base = int(state.step) - measured_total
+                        emit(
+                            "on_health_warning",
+                            step=step_base + measured_total,
+                            epoch=epoch,
+                            **warning,
+                        )
+                        if health_watcher.trigger_recovery and recovery is not None:
+                            state = do_recovery("health_warning", epoch)
+                            epoch_loss, epoch_good = None, None
+                            rolled_back = True
+            if check_anomalies or recovery is not None:
+                # a recovery policy must see every bad step even when
+                # detect_anomalies=False silenced the event emission
+                if not bool(step_metrics["good"]):
+                    consecutive_bad += 1
+                    if check_anomalies:
+                        emit(
+                            "on_anomaly",
+                            step=int(state.step) if step_id is None else step_id,
+                            epoch=epoch,
+                            loss=float(loss_value),
+                            grad_norm=float(step_metrics["grad_norm"]),
+                            consecutive_bad=consecutive_bad,
+                            bad_steps_total=(
+                                int(state.bad_steps) if bad_total is None else bad_total
+                            ),
+                        )
+                    if (
+                        recovery is not None
+                        and consecutive_bad >= recovery.max_consecutive_bad
+                    ):
+                        state = do_recovery("consecutive_bad_steps", epoch)
+                        # the epoch average must describe the RESTORED
+                        # trajectory, not the discarded one
+                        epoch_loss, epoch_good = None, None
+                        rolled_back = True
+                else:
+                    consecutive_bad = 0
+            if profile_active and measured_total >= profile_stop:
+                profile_stack.close()
+                profile_active = False
+            if event_every and measured_total % event_every == 0:
+                if step_base is None:
+                    # one-time base fetch: state.step then advances in
+                    # lockstep with measured_total within this fit
+                    step_base = int(state.step) - measured_total
+                emit_step = step_base + measured_total
+                loss_f = float(loss_value)  # THE per-event device sync
+                tick = telemetry_tick(batch)
+                emit(
+                    "on_train_step",
+                    step=emit_step,
+                    epoch=epoch,
+                    loss=loss_f,
+                    # the rate the optimizer APPLIED: optax schedules
+                    # are indexed by steps completed before the update
+                    lr=current_lr(emit_step - 1),
+                    samples_per_sec=tick["samples_per_sec"],
+                    steps_per_sec=tick["steps_per_sec"],
+                    step_seconds=tick["step_seconds"],
+                    # a health record fetched since the last emission
+                    # rides the next step event (cadences may differ)
+                    **({"health": pending_health} if pending_health is not None else {}),
+                )
+                pending_health = None
+            return rolled_back
+
         stopped_early = False
         # the per-epoch goodput window: opens here and RE-opens right after
         # each on_epoch_end, so the inter-epoch tail (the end-of-epoch
@@ -1351,17 +1660,227 @@ class Trainer:
                 # passed the sentinel on THIS process
                 epoch_loss, epoch_good, n_steps = None, None, 0
                 skipped = 0
+                last_batch = None
                 epoch_needs_mark = True  # re-mark per epoch: discounts the
                 # inter-epoch validation/checkpoint gap from the telemetry window
                 epoch_batches = batches_for(epoch)
+                if scan_chunk:
+                    # a factory callable hid its batcher from the fit-start
+                    # check: reject what it actually returned, before any
+                    # step of this epoch runs
+                    reject_bucketed(epoch_batches)
                 if prefetch:
                     from replay_tpu.data.nn.prefetch import prefetch as _prefetch
 
                     epoch_batches = _prefetch(iter(epoch_batches), depth=prefetch)
-                if tracing:
+                if tracing and not scan_chunk:
                     # times every next() as data_wait — i.e. what the prefetch
-                    # queue could NOT hide from the step loop
+                    # queue could NOT hide from the step loop. (Chunked, the
+                    # stream is consumed on the FEEDER thread; the fit
+                    # thread's data_wait is its wait on the feed, below.)
                     epoch_batches = traced_iterator(epoch_batches, trace)
+                if scan_chunk:
+                    # ---- scan-chunked epoch: K steps per XLA dispatch, fed by
+                    # a device-prefetch stage (docs/performance.md "Closing
+                    # the dispatch gap") -------------------------------------
+                    from replay_tpu.data.nn.prefetch import DevicePrefetcher
+
+                    batch_iter = iter(epoch_batches)
+                    first_batch = None
+                    for batch in batch_iter:
+                        # the per-step loop's per-batch preamble (state init /
+                        # restore / recovery snapshot / resume fast-forward),
+                        # run on the fit thread BEFORE the feeder takes over
+                        if state is None:
+                            state = self.init_state(batch)
+                            if pending_restore_step is not None:
+                                restored = checkpoint_manager.restore(
+                                    state, step=pending_restore_step
+                                )
+                                state = _place_tree(
+                                    restored, jax.tree.map(self._template_sharding, state)
+                                )
+                                pending_restore_step = None
+                        if recovery is not None and initial_snapshot is None:
+                            # rollback target until the first checkpoint lands;
+                            # .copy() detaches from the donation chain
+                            initial_snapshot = jax.tree.map(lambda x: x.copy(), state)
+                        if epoch == start_epoch and skipped < skip_steps:
+                            skipped += 1
+                            n_steps += 1
+                            continue
+                        first_batch = batch
+                        break
+                    if first_batch is not None:
+                        stream = itertools.chain([first_batch], batch_iter)
+                        items = _chunk_schedule(
+                            stream, scan_chunk, health_every, start=measured_total
+                        )
+                        feed = (
+                            DevicePrefetcher(items, self._chunk_placer(trace), depth=1)
+                            if device_feed
+                            # feed off: items pass through unplaced and the
+                            # scan branch below places them on the FIT thread
+                            # (h2d lands in the goodput fractions — the A/B
+                            # shows exactly what the feed would have hidden)
+                            else ((item, None) for item in items)
+                        )
+                        feed_stream = traced_iterator(feed, trace) if tracing else feed
+                        try:
+                            for item, placed in feed_stream:
+                                if epoch_needs_mark:
+                                    telemetry.mark()
+                                    epoch_needs_mark = False
+                                kind, payload = item
+                                steps_before = n_steps
+                                if kind == "step":
+                                    # health-cadence / short-tail single step
+                                    # through the existing per-step program
+                                    # (the health-instrumented variant when a
+                                    # HealthConfig is attached)
+                                    if (
+                                        profile_steps is not None
+                                        and not profile_active
+                                        and measured_total == profile_start
+                                    ):
+                                        from replay_tpu.utils.profiling import (
+                                            trace as _profiler_trace,
+                                        )
+
+                                        profile_stack.enter_context(
+                                            _profiler_trace(resolved_profile_dir())
+                                        )
+                                        profile_active = True
+                                    state, loss_value = self.traced_train_step(
+                                        state, payload
+                                    )
+                                    account_step(
+                                        payload, loss_value, self.last_step_metrics, epoch
+                                    )
+                                    last_batch = payload
+                                else:  # "scan": K optimizer steps in ONE dispatch
+                                    chunk = payload
+                                    k = len(chunk)
+                                    if (
+                                        profile_steps is not None
+                                        and not profile_active
+                                        and measured_total <= profile_start < measured_total + k
+                                    ):
+                                        # the window rounds out to chunk boundaries
+                                        from replay_tpu.utils.profiling import (
+                                            trace as _profiler_trace,
+                                        )
+
+                                        profile_stack.enter_context(
+                                            _profiler_trace(resolved_profile_dir())
+                                        )
+                                        profile_active = True
+                                    scan_fn = self._ensure_train_scan()
+                                    if placed is None:
+                                        # device_feed=False: synchronous
+                                        # stack + placement on the fit thread
+                                        with self._h2d_span():
+                                            placed = self._put_stacked(
+                                                self._stack_chunk(chunk)
+                                            )
+                                    compile_before = (
+                                        self.compile_tracker.total_compile_seconds
+                                    )
+                                    span_cm = (
+                                        trace.span("train_step", steps=k)
+                                        if tracing
+                                        else contextlib.nullcontext()
+                                    )
+                                    with span_cm as step_span:
+                                        with self.compile_tracker.observe("train_scan"):
+                                            state, chunk_metrics = scan_fn(state, placed)
+                                        # ONE host sync per chunk: the [K]
+                                        # per-step metrics fence the span and
+                                        # feed the fan-out accounting below
+                                        losses = np.asarray(chunk_metrics["loss"])
+                                        goods = np.asarray(chunk_metrics["good"])
+                                        grad_norms = np.asarray(chunk_metrics["grad_norm"])
+                                    if tracing:
+                                        compile_delta = (
+                                            self.compile_tracker.total_compile_seconds
+                                            - compile_before
+                                        )
+                                        if compile_delta > 0:
+                                            trace.carve(step_span, "compile", compile_delta)
+                                    self.last_step_metrics = chunk_metrics
+                                    if step_base is None:
+                                        # state.step already sits at the chunk END
+                                        step_base = int(state.step) - (measured_total + k)
+                                    bad_in_chunk = np.cumsum(~goods)
+                                    bad_before = None
+                                    if (
+                                        check_anomalies or recovery is not None
+                                    ) and bad_in_chunk[-1]:
+                                        bad_before = int(state.bad_steps) - int(
+                                            bad_in_chunk[-1]
+                                        )
+                                    for i in range(k):
+                                        rolled_back = account_step(
+                                            chunk[i],
+                                            losses[i],
+                                            {
+                                                "loss": losses[i],
+                                                "good": goods[i],
+                                                "grad_norm": grad_norms[i],
+                                            },
+                                            epoch,
+                                            step_id=step_base + measured_total + 1,
+                                            bad_total=(
+                                                bad_before + int(bad_in_chunk[i])
+                                                if bad_before is not None
+                                                else None
+                                            ),
+                                            on_host=True,
+                                        )
+                                        if rolled_back:
+                                            # the rest of the chunk belongs to
+                                            # the DISCARDED trajectory: its
+                                            # batches stay consumed (the stream
+                                            # position advances, keeping
+                                            # checkpoint/resume alignment) but
+                                            # are not accounted
+                                            n_steps += k - (i + 1)
+                                            measured_total += k - (i + 1)
+                                            break
+                                    last_batch = chunk[-1]
+                                boundary_saved = False
+                                if (
+                                    checkpoint_every
+                                    and checkpoint_manager is not None
+                                    and n_steps // checkpoint_every
+                                    > steps_before // checkpoint_every
+                                ):
+                                    # a checkpoint_every boundary crossed INSIDE
+                                    # the chunk saves once at the chunk end —
+                                    # the only point this state exists; the
+                                    # recorded position is the current n_steps
+                                    save_mid_epoch()
+                                    boundary_saved = True
+                                if preemption is not None and preemption.requested:
+                                    # chunk-boundary preemption exit (same
+                                    # contract as the per-step path)
+                                    if checkpoint_manager is not None and not boundary_saved:
+                                        save_mid_epoch(preempted=True)
+                                    emit("on_preemption", step=int(state.step),
+                                         epoch=epoch, signal=preemption.signal_name)
+                                    logger.warning(
+                                        "preemption: checkpoint saved at step %d; "
+                                        "exiting fit",
+                                        int(state.step),
+                                    )
+                                    emit("on_fit_end", step=int(state.step),
+                                         epoch=epoch, preempted=True,
+                                         **fit_end_payload())
+                                    return state
+                        finally:
+                            if isinstance(feed, DevicePrefetcher):
+                                feed.close()
+                    epoch_batches = ()  # the per-step loop below is skipped
                 for batch in epoch_batches:
                     if state is None:
                         state = self.init_state(batch)
@@ -1399,98 +1918,8 @@ class Trainer:
                     # traced: loss-fenced span + compile carve; untraced: the
                     # plain async-dispatch step
                     state, loss_value = self.traced_train_step(state, batch)
-                    step_metrics = self.last_step_metrics
-                    # accumulate on device: float() here would sync every step.
-                    # Sentinel-skipped steps contribute 0 (their loss is
-                    # non-finite and would poison the epoch average).
-                    safe_loss = jnp.where(step_metrics["good"], loss_value, 0.0)
-                    epoch_loss = safe_loss if epoch_loss is None else epoch_loss + safe_loss
-                    good_flag = step_metrics["good"].astype(jnp.int32)
-                    epoch_good = good_flag if epoch_good is None else epoch_good + good_flag
-                    n_steps += 1
-                    measured_total += 1
-                    last_grad_norm = step_metrics["grad_norm"]
-                    if (
-                        health_cfg is not None
-                        and "health" in step_metrics
-                        and measured_total % health_cfg.cadence == 0
-                    ):
-                        # THE health sync: one device_get of the small health
-                        # pytree — it blocks on the step's outputs, so the
-                        # record is loss-fenced like a StepTelemetry tick
-                        fetched = jax.device_get(step_metrics["health"])
-                        health_record = jax.tree.map(
-                            lambda x: x.tolist() if getattr(x, "ndim", 0) else float(x),
-                            fetched,
-                        )
-                        self.last_health = health_record
-                        pending_health = health_record
-                        if health_watcher is not None:
-                            warning = health_watcher.observe(health_record)
-                            if warning is not None:
-                                if step_base is None:
-                                    step_base = int(state.step) - measured_total
-                                emit(
-                                    "on_health_warning",
-                                    step=step_base + measured_total,
-                                    epoch=epoch,
-                                    **warning,
-                                )
-                                if health_watcher.trigger_recovery and recovery is not None:
-                                    state = do_recovery("health_warning", epoch)
-                                    epoch_loss, epoch_good = None, None
-                    if check_anomalies or recovery is not None:
-                        # a recovery policy must see every bad step even when
-                        # detect_anomalies=False silenced the event emission
-                        if not bool(step_metrics["good"]):
-                            consecutive_bad += 1
-                            if check_anomalies:
-                                emit(
-                                    "on_anomaly",
-                                    step=int(state.step),
-                                    epoch=epoch,
-                                    loss=float(loss_value),
-                                    grad_norm=float(step_metrics["grad_norm"]),
-                                    consecutive_bad=consecutive_bad,
-                                    bad_steps_total=int(state.bad_steps),
-                                )
-                            if (
-                                recovery is not None
-                                and consecutive_bad >= recovery.max_consecutive_bad
-                            ):
-                                state = do_recovery("consecutive_bad_steps", epoch)
-                                # the epoch average must describe the RESTORED
-                                # trajectory, not the discarded one
-                                epoch_loss, epoch_good = None, None
-                        else:
-                            consecutive_bad = 0
-                    if profile_active and measured_total >= profile_stop:
-                        profile_stack.close()
-                        profile_active = False
-                    if event_every and measured_total % event_every == 0:
-                        if step_base is None:
-                            # one-time base fetch: state.step then advances in
-                            # lockstep with measured_total within this fit
-                            step_base = int(state.step) - measured_total
-                        step_id = step_base + measured_total
-                        loss_f = float(loss_value)  # THE per-event device sync
-                        tick = telemetry_tick(batch)
-                        emit(
-                            "on_train_step",
-                            step=step_id,
-                            epoch=epoch,
-                            loss=loss_f,
-                            # the rate the optimizer APPLIED: optax schedules
-                            # are indexed by steps completed before the update
-                            lr=current_lr(step_id - 1),
-                            samples_per_sec=tick["samples_per_sec"],
-                            steps_per_sec=tick["steps_per_sec"],
-                            step_seconds=tick["step_seconds"],
-                            # a health record fetched since the last emission
-                            # rides the next step event (cadences may differ)
-                            **({"health": pending_health} if pending_health is not None else {}),
-                        )
-                        pending_health = None
+                    account_step(batch, loss_value, self.last_step_metrics, epoch)
+                    last_batch = batch
                     boundary_saved = False
                     if (
                         checkpoint_every
@@ -1529,12 +1958,12 @@ class Trainer:
                         float(epoch_loss) / good_count if good_count else float("nan")
                     ),
                 }
-                if event_every and measured_total > last_emitted_at:
+                if event_every and measured_total > last_emitted_at and last_batch is not None:
                     # flush the tail steps into the telemetry window HERE —
                     # float(epoch_loss) above already fenced them, and ticking
                     # after validation would dilute the steady-state rate;
                     # fits shorter than the event cadence get real numbers
-                    telemetry_tick(batch)
+                    telemetry_tick(last_batch)
                 if val_batches is not None:
                     # several validation streams (the reference's sequential
                     # CombinedLoader): a dict of factories gets per-stream prefixes

@@ -9,7 +9,9 @@ Event flow emitted by ``replay_tpu.nn.Trainer.fit``::
     on_fit_start
       on_train_step*          (loss, lr, samples_per_sec, step_seconds;
                                + a `health` record every HealthConfig.cadence
-                               steps — obs.health)
+                               steps — obs.health. The cadence holds under
+                               fit(scan_chunk=K) too: the chunk's [K] metrics
+                               fan back out into per-step events)
       on_health_warning*      (HealthWatcher EWMA blowup of grad norm /
                                update ratio, BEFORE the sentinel trips)
       on_anomaly*             (a non-finite step the sentinel skipped:

@@ -126,6 +126,11 @@ def summarize_run(path: str) -> Dict[str, Any]:
     summary = summarize_events(events, source=path)
     if trace is not None:
         summary["trace"] = trace
+        # total h2d time ACROSS threads: in a chunked run the device feed
+        # places chunks on a feeder thread, so most of this never shows up in
+        # the fit thread's goodput fractions — the delta IS the overlap win
+        if "h2d" in trace:
+            summary["h2d_seconds"] = float(trace["h2d"]["seconds"])
     return summary
 
 
@@ -254,14 +259,23 @@ def summarize_events(
             key: record.get(key)
             for key in (
                 "metric", "value", "unit", "vs_baseline", "backend", "mfu",
-                "tflops_per_sec", "step_ms", "compile_seconds", "device_kind",
-                "source", "stale",
+                "tflops_per_sec", "step_ms", "dispatch_step_ms", "scan_k",
+                "compile_seconds", "device_kind", "source", "stale",
+                # the end-to-end Trainer.fit(scan_chunk=...) loop and its
+                # variant flags (a fit measured with a different chunk size or
+                # the feed disabled must not read as the baseline)
+                "fit_samples_per_sec", "fit_step_ms", "fit_scan_chunk",
+                "fit_device_feed", "dispatch_gap_closed",
             )
             if key in record
         }
         summary["mfu"] = _finite(record.get("mfu"))
+        # first-class so --compare can gate on the PRODUCTION loop's
+        # throughput, not only the hand-rolled microbench number
+        summary["fit_samples_per_sec"] = _finite(record.get("fit_samples_per_sec"))
     else:
         summary["mfu"] = _finite(fit_end.get("mfu"))
+        summary["fit_samples_per_sec"] = None
 
     if dryruns:
         record = dryruns[-1]
@@ -382,6 +396,18 @@ def render(summary: Mapping[str, Any]) -> str:
             lines.append(
                 f"  input starvation: {100.0 * starvation:.1f}% of the stepping pipeline"
             )
+        h2d_seconds = _finite(summary.get("h2d_seconds"))
+        wall = _finite(goodput.get("wall_seconds"))
+        if h2d_seconds is not None and wall:
+            # chunked runs place chunks on the device-feed thread: the share
+            # of h2d NOT in the fit loop's fractions overlapped compute
+            in_loop = float(fractions.get("h2d", 0.0)) * wall
+            overlapped = max(h2d_seconds - in_loop, 0.0)
+            lines.append(
+                f"  h2d: {h2d_seconds:.2f}s across threads — "
+                f"{overlapped:.2f}s overlapped on the device feed, "
+                f"{in_loop:.2f}s in the fit loop"
+            )
     trace = summary.get("trace")
     if trace:
         top = sorted(trace.items(), key=lambda kv: -kv[1]["seconds"])[:8]
@@ -408,6 +434,19 @@ def render(summary: Mapping[str, Any]) -> str:
             + (f" (vs_baseline {bench.get('vs_baseline')})" if "vs_baseline" in bench else "")
             + (" [stale sidecar]" if bench.get("stale") else "")
         )
+        if bench.get("fit_samples_per_sec") is not None:
+            gap = bench.get("dispatch_gap_closed")
+            lines.append(
+                f"  fit loop: {bench['fit_samples_per_sec']} samples/sec "
+                f"({bench.get('fit_step_ms')} ms/step, "
+                f"scan_chunk={bench.get('fit_scan_chunk')}, "
+                f"device_feed={bench.get('fit_device_feed')})"
+                + (
+                    f" · dispatch gap closed {100.0 * float(gap):.0f}%"
+                    if isinstance(gap, (int, float)) and not isinstance(gap, bool)
+                    else ""
+                )
+            )
     return "\n".join(lines)
 
 
@@ -442,6 +481,23 @@ def compare_runs(
 
     check("samples_per_sec", candidate.get("samples_per_sec"), baseline.get("samples_per_sec"))
     check("steps_per_sec", candidate.get("steps_per_sec"), baseline.get("steps_per_sec"))
+    # end-to-end fit-loop throughput (bench records): the production
+    # Trainer.fit(scan_chunk=...) number gates alongside the microbench —
+    # but only between runs measured with the SAME chunk/feed variant
+    cand_fit = candidate.get("fit_samples_per_sec")
+    base_fit = baseline.get("fit_samples_per_sec")
+    if cand_fit is not None or base_fit is not None:
+        cand_bench = candidate.get("bench") or {}
+        base_bench = baseline.get("bench") or {}
+        variant_keys = ("fit_scan_chunk", "fit_device_feed")
+        if any(cand_bench.get(key) != base_bench.get(key) for key in variant_keys):
+            lines.append(
+                "  fit_samples_per_sec: variant flags differ "
+                f"(candidate {[cand_bench.get(k) for k in variant_keys]} vs "
+                f"baseline {[base_bench.get(k) for k in variant_keys]}) — not compared"
+            )
+        else:
+            check("fit_samples_per_sec", cand_fit, base_fit)
     if candidate.get("mfu") is not None and baseline.get("mfu") is not None:
         check("mfu", candidate.get("mfu"), baseline.get("mfu"))
     cand_retraces, base_retraces = candidate.get("retraces"), baseline.get("retraces")

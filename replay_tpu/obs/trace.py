@@ -51,6 +51,10 @@ __all__ = [
 # batcher's assembly work (SequenceBatcher(tracer=...)): when the batcher runs
 # on the consuming thread its spans nest inside data_wait — listing it here
 # keeps that time counted as input time rather than leaking into "other".
+# "h2d" covers device placement; under fit(scan_chunk=...) the device feed
+# records it on the FEEDER thread, so it appears in trace.json but drops out
+# of the fit thread's fractions — the drop is the overlap the feed bought
+# (obs.report renders the across-thread total next to the in-loop share).
 GOODPUT_SPANS = (
     "data_wait",
     "batch_build",

@@ -340,6 +340,97 @@ class TestPrefetch:
         time.sleep(0.3)
         assert produced["n"] == count_after_close  # producer actually stopped
 
+    def test_close_joins_producer_thread(self):
+        """close() joins the producer (blocking-put protocol): abandoned
+        iterators must not leak daemon threads."""
+        import threading
+
+        from replay_tpu.data.nn import prefetch
+
+        def endless():
+            while True:
+                yield 1
+
+        before = {t.ident for t in threading.enumerate()}
+        it = prefetch(endless(), depth=2)
+        assert next(it) == 1
+        spawned = [
+            t
+            for t in threading.enumerate()
+            if t.ident not in before and "prefetch" in t.name
+        ]
+        assert len(spawned) == 1
+        it.close()
+        assert not spawned[0].is_alive()  # joined, not abandoned
+
+
+class TestDevicePrefetcher:
+    def test_orders_and_applies_place_on_feeder_thread(self):
+        import threading
+
+        from replay_tpu.data.nn import DevicePrefetcher
+
+        feeder_tids = set()
+
+        def place(x):
+            feeder_tids.add(threading.get_ident())
+            return x * 10
+
+        with DevicePrefetcher(iter(range(5)), place, depth=2) as feed:
+            assert list(feed) == [(i, i * 10) for i in range(5)]
+        assert feeder_tids and threading.get_ident() not in feeder_tids
+
+    def test_place_errors_relay_to_consumer(self):
+        from replay_tpu.data.nn import DevicePrefetcher
+
+        def bad_place(x):
+            if x == 2:
+                raise RuntimeError("boom")
+            return x
+
+        got = []
+        with pytest.raises(RuntimeError, match="boom"):
+            for item, _ in DevicePrefetcher(iter(range(5)), bad_place, depth=1):
+                got.append(item)
+        assert got == [0, 1]
+
+    def test_close_stops_and_joins_feeder(self):
+        import threading
+        import time
+
+        from replay_tpu.data.nn import DevicePrefetcher
+
+        placed = {"n": 0}
+
+        def place(x):
+            placed["n"] += 1
+            return x
+
+        def endless():
+            while True:
+                yield 1
+
+        before = {t.ident for t in threading.enumerate()}
+        feed = DevicePrefetcher(endless(), place, depth=2)
+        next(feed)
+        spawned = [
+            t
+            for t in threading.enumerate()
+            if t.ident not in before and "device-feed" in t.name
+        ]
+        assert len(spawned) == 1
+        feed.close()
+        assert not spawned[0].is_alive()
+        count_after_close = placed["n"]
+        time.sleep(0.2)
+        assert placed["n"] == count_after_close  # feeder fully stopped
+
+    def test_bad_depth_raises(self):
+        from replay_tpu.data.nn import DevicePrefetcher
+
+        with pytest.raises(ValueError):
+            DevicePrefetcher([1], place=lambda x: x, depth=0)
+
 
 class TestBucketedBatching:
     def make_seq_dataset(self, lengths, num_items=30):

@@ -240,3 +240,71 @@ def test_python_dash_m_entrypoint(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "Run report" in proc.stdout
+
+
+# --------------------------------------------------------------------------- #
+# fit-loop bench fields (scan-chunked fit, docs/performance.md "Closing the
+# dispatch gap") + h2d-overlap surfacing
+# --------------------------------------------------------------------------- #
+def _bench_record(**extra):
+    return {
+        "metric": "sasrec_train_samples_per_sec", "value": 5668.0,
+        "unit": "samples/sec", "vs_baseline": 1.0, "backend": "tpu",
+        "step_ms": 4.1, "dispatch_step_ms": 10.5, "scan_k": 32,
+        **extra,
+    }
+
+
+def _fit_fields(samples=5000.0, chunk=32, feed=True):
+    return {
+        "fit_samples_per_sec": samples, "fit_step_ms": 5.0,
+        "fit_scan_chunk": chunk, "fit_device_feed": feed,
+        "dispatch_gap_closed": 0.86,
+    }
+
+
+def test_bench_fit_loop_fields_summarize_and_render(tmp_path, capsys):
+    bench = tmp_path / "BENCH.json"
+    bench.write_text(json.dumps(_bench_record(**_fit_fields())))
+    summary = summarize_run(str(bench))
+    assert summary["fit_samples_per_sec"] == pytest.approx(5000.0)
+    assert summary["bench"]["fit_scan_chunk"] == 32
+    assert summary["bench"]["fit_device_feed"] is True
+    assert main([str(bench)]) == 0
+    out = capsys.readouterr().out
+    assert "fit loop: 5000.0 samples/sec" in out
+    assert "scan_chunk=32" in out and "device_feed=True" in out
+    assert "dispatch gap closed 86%" in out
+
+
+def test_compare_gates_on_end_to_end_fit_throughput(tmp_path, capsys):
+    cand = tmp_path / "cand.json"
+    base = tmp_path / "base.json"
+    # microbench value holds; only the PRODUCTION fit loop regressed
+    cand.write_text(json.dumps(_bench_record(**_fit_fields(samples=2000.0))))
+    base.write_text(json.dumps(_bench_record(**_fit_fields(samples=5000.0))))
+    assert main([str(cand), "--compare", str(base)]) == 2
+    err = capsys.readouterr().err
+    assert "fit_samples_per_sec" in err
+
+
+def test_compare_skips_fit_gate_across_variants(tmp_path, capsys):
+    cand = tmp_path / "cand.json"
+    base = tmp_path / "base.json"
+    # a different chunk size is a VARIANT run: its fit number must neither
+    # gate nor masquerade as the baseline
+    cand.write_text(json.dumps(_bench_record(**_fit_fields(samples=2000.0, chunk=4))))
+    base.write_text(json.dumps(_bench_record(**_fit_fields(samples=5000.0, chunk=32))))
+    assert main([str(cand), "--compare", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "variant flags differ" in out
+
+
+def test_h2d_overlap_surfaced_from_trace(tmp_path, capsys):
+    run = _write_fit_run(str(tmp_path / "run"))
+    _write_trace(run, names=("data_wait", "train_step", "h2d", "h2d"))
+    summary = summarize_run(run)
+    assert summary["h2d_seconds"] == pytest.approx(2 * 5.0 / 1e6)
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "h2d:" in out and "overlapped" in out and "input starvation" in out

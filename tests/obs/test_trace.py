@@ -473,3 +473,63 @@ def test_untraced_fit_emits_no_goodput():
     trainer.fit(lambda: iter([batch, batch]), epochs=1, loggers=recorder)
     for event in recorder.events:
         assert "goodput" not in event.payload and "spans" not in event.payload
+
+
+# --------------------------------------------------------------------------- #
+# traced scan-chunked fit (jax smoke) — the CI chunked_smoke artifact producer
+# --------------------------------------------------------------------------- #
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_traced_chunked_fit_goodput_sums_and_h2d_overlaps(tmp_path):
+    """A traced fit(scan_chunk=K) with the device feed: goodput fractions
+    still sum to 1.0, the chunk h2d spans land on the FEEDER thread (the
+    overlap trace.json shows next to the fit thread's train_step spans), and
+    chunked train_step spans carry their per-step attribution (steps=K)."""
+    from replay_tpu.obs import JsonlLogger
+
+    trainer, make_batch = _tiny_trainer()
+    batches = [make_batch(i) for i in range(7)]  # two K=3 chunks + one tail step
+
+    run_dir = _run_dir(tmp_path, "chunked_smoke")
+    # mode="w": REPLAY_TPU_RUN_DIR is a fixed path in CI — re-runs must not append
+    with JsonlLogger(run_dir, mode="w") as sink:
+        trainer.fit(lambda: iter(batches), epochs=2, loggers=sink, tracer=True,
+                    scan_chunk=3)
+
+    payload = json.load(open(os.path.join(run_dir, "trace.json")))
+    events = payload["traceEvents"]
+    for event in events:
+        assert "name" in event and "ph" in event and "ts" in event
+        assert event["dur"] >= 0
+    by_name = {}
+    for event in events:
+        by_name.setdefault(event["name"], []).append(event)
+    # chunk dispatches carry per-step attribution; the tail step has none
+    chunk_spans = [e for e in by_name["train_step"] if e.get("args", {}).get("steps")]
+    assert [e["args"]["steps"] for e in chunk_spans] == [3, 3, 3, 3]
+    # h2d overlaps: the device feed places chunks on the feeder thread, a
+    # DIFFERENT tid than the fit thread's train_step spans
+    step_tids = {e["tid"] for e in by_name["train_step"]}
+    h2d_tids = {e["tid"] for e in by_name["h2d"]}
+    assert h2d_tids - step_tids, "no h2d span on the feeder thread"
+    # the fit thread still times its wait on the feed as data_wait
+    assert "data_wait" in by_name
+
+    lines = [json.loads(line) for line in open(os.path.join(run_dir, "events.jsonl"))]
+    epoch_ends = [line for line in lines if line["event"] == "on_epoch_end"]
+    fit_end = lines[-1]
+    assert fit_end["event"] == "on_fit_end"
+    assert len(epoch_ends) == 2
+    for record in (*epoch_ends, fit_end):
+        goodput = record["goodput"]
+        fractions = goodput["fractions"]
+        assert sum(fractions.values()) == pytest.approx(1.0, abs=0.05)
+        assert all(value >= 0 for value in fractions.values())
+        assert 0.0 <= goodput["input_starvation"] <= 1.0
+    # per-step events fan out of the chunk: 7 steps per epoch, losses intact
+    steps = [line for line in lines if line["event"] == "on_train_step"]
+    assert len(steps) == 14
+    assert all(np.isfinite(s["loss"]) for s in steps)
+    # one compiled scan + one compiled per-step program (the tail)
+    assert trainer.compile_tracker.traces["train_scan"] == 1
+    assert trainer.compile_tracker.traces["train_step"] == 1
