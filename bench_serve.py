@@ -1,0 +1,351 @@
+"""Serving benchmark: the online scoring service under closed + open-loop load.
+
+Drives ``replay_tpu.serve.ScoringService`` (micro-batcher → compiled bucket
+executables → per-user state cache → optional MIPS+rerank pipeline) with a
+load generator and prints ONE JSON line in bench.py's sidecar format::
+
+    {"metric": "serve_qps", "value": ..., "unit": "req/s", "qps": ...,
+     "p50_ms": ..., "p95_ms": ..., "p99_ms": ..., "batch_fill_ratio": ...,
+     "cache_hit_rate": ..., "closed_loop_qps": ..., "backend": ...}
+
+Two phases after a cold-seed warmup (every program is AOT-compiled at service
+construction, so the timed phases never trace):
+
+* **closed loop** — ``CLIENTS`` threads issue synchronous requests back to
+  back (the saturation number: how fast can the service go when callers never
+  let it idle);
+* **open loop** — one generator submits with Poisson-exponential gaps at
+  ``RATE`` req/s for ``SECONDS`` (the latency-under-load number: p50/p95/p99
+  from submit to response, measured on completion callbacks, immune to
+  coordinated omission).
+
+Request mix per returning user: mostly pure cache hits, a slice of one-step
+incremental advances, a trickle of cold full-history re-sends — the shape the
+per-user state cache exists for. ``REPLAY_TPU_SERVE_*`` env vars override
+every shape/load knob (CI smoke runs tiny configs, flagged
+``shape_override``), mirroring the ``REPLAY_TPU_BENCH_*`` convention so CI and
+the TPU sidecar share this one entrypoint. Events + trace land in
+``runs/bench_serve/`` (the record itself is appended to events.jsonl, so
+``python -m replay_tpu.obs.report runs/bench_serve`` renders the serving
+section from one artifact, and ``--compare`` gates QPS/p99 regressions).
+
+Backend policy mirrors bench.py: probe the default backend in a throwaway
+subprocess; unhealthy → re-exec on clean CPU (metric renamed
+``serve_qps_cpu_fallback``); healthy TPU runs persist
+``BENCH_SERVE_SIDECAR.json``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+_DEFAULTS = {
+    "SEQ_LEN": 50,
+    "NUM_ITEMS": 3706,
+    "EMBEDDING_DIM": 64,
+    "NUM_BLOCKS": 2,
+    "USERS": 512,
+    "CLIENTS": 8,
+    "CLOSED_REQUESTS": 64,  # per client thread
+    "RATE": 500,  # open-loop arrivals per second
+    "SECONDS": 8,  # open-loop duration
+    "CANDIDATES": 100,  # MIPS retrieval cut; 0 = full-catalog scoring mode
+    "TOPK": 10,
+}
+
+
+def _knob(name: str) -> int:
+    return int(os.environ.get(f"REPLAY_TPU_SERVE_{name}", _DEFAULTS[name]))
+
+
+SEQ_LEN = _knob("SEQ_LEN")
+NUM_ITEMS = _knob("NUM_ITEMS")
+EMBEDDING_DIM = _knob("EMBEDDING_DIM")
+NUM_BLOCKS = _knob("NUM_BLOCKS")
+USERS = _knob("USERS")
+CLIENTS = _knob("CLIENTS")
+CLOSED_REQUESTS = _knob("CLOSED_REQUESTS")
+RATE = _knob("RATE")
+SECONDS = _knob("SECONDS")
+CANDIDATES = _knob("CANDIDATES")
+TOPK = _knob("TOPK")
+MAX_WAIT_MS = float(os.environ.get("REPLAY_TPU_SERVE_MAX_WAIT_MS", "2.0"))
+BATCH_BUCKETS = tuple(
+    int(b) for b in os.environ.get("REPLAY_TPU_SERVE_BATCH_BUCKETS", "1,8,64").split(",")
+)
+LENGTH_BUCKETS = tuple(
+    int(b)
+    for b in os.environ.get("REPLAY_TPU_SERVE_LENGTH_BUCKETS", "").split(",")
+    if b.strip()
+) or None
+SHAPE_OVERRIDE = any(_knob(k) != v for k, v in _DEFAULTS.items())
+
+RUN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "runs", "bench_serve")
+SIDECAR_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVE_SIDECAR.json"
+)
+PROBE_TIMEOUT = float(os.environ.get("REPLAY_TPU_BENCH_PROBE_TIMEOUT", "120"))
+
+
+def _backend_healthy(timeout: float) -> bool:
+    """Probe jax.devices() in a throwaway subprocess (a wedged TPU tunnel
+    blocks where no in-process timeout can reach) — bench.py's policy."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=None if timeout <= 0 else timeout,
+            check=False,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return probe.returncode == 0
+
+
+def _reexec_on_cpu() -> None:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep) if ".axon_site" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["REPLAY_TPU_SERVE_FALLBACK"] = "1"
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _percentile(latencies, q: float) -> float:
+    return float(np.percentile(np.asarray(latencies), q)) if latencies else float("nan")
+
+
+def main() -> None:
+    is_fallback = bool(os.environ.get("REPLAY_TPU_SERVE_FALLBACK"))
+    if not is_fallback and not _backend_healthy(PROBE_TIMEOUT):
+        print(
+            "bench_serve: default backend unavailable; falling back to CPU",
+            file=sys.stderr,
+        )
+        _reexec_on_cpu()
+
+    import jax
+
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.models import MIPSIndex
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.obs import JsonlLogger, Tracer
+    from replay_tpu.scenarios.two_stages import LogisticReranker
+    from replay_tpu.serve import CandidatePipeline, ScoringService
+
+    rng = np.random.default_rng(0)
+    schema = TensorSchema(
+        TensorFeatureInfo(
+            "item_id",
+            FeatureType.CATEGORICAL,
+            is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID,
+            cardinality=NUM_ITEMS,
+            embedding_dim=EMBEDDING_DIM,
+        )
+    )
+    model = SasRec(
+        schema=schema,
+        embedding_dim=EMBEDDING_DIM,
+        num_blocks=NUM_BLOCKS,
+        num_heads=1,
+        max_sequence_length=SEQ_LEN,
+        dropout_rate=0.0,
+    )
+    init_ids = np.zeros((2, SEQ_LEN), np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), {"item_id": init_ids}, np.ones((2, SEQ_LEN), bool)
+    )["params"]
+
+    retrieval = None
+    mode = "full"
+    if CANDIDATES > 0:
+        # the fused candidate->rank path: MIPS over the tying head's item
+        # table + the two-stage scenario's logistic re-rank weights (trained
+        # here on synthetic score/label pairs — the integration is what the
+        # bench exercises, not the weights' quality)
+        item_weights = np.asarray(
+            model.apply({"params": params}, method=SasRec.get_item_weights)
+        )
+        scores = rng.normal(size=(256, 1))
+        labels = (scores[:, 0] + 0.3 * rng.normal(size=256) > 0).astype(np.float64)
+        reranker = LogisticReranker(steps=50).fit(scores, labels)
+        retrieval = CandidatePipeline(
+            MIPSIndex(item_weights),
+            num_candidates=min(CANDIDATES, NUM_ITEMS),
+            top_k=min(TOPK, CANDIDATES, NUM_ITEMS),
+            reranker_weights=reranker.serving_weights,
+        )
+        mode = "retrieval"
+
+    tracer = Tracer()
+    logger = JsonlLogger(RUN_DIR, mode="w")
+    compile_start = time.perf_counter()
+    service = ScoringService(
+        model,
+        params,
+        length_buckets=LENGTH_BUCKETS,
+        batch_buckets=BATCH_BUCKETS,
+        max_wait_ms=MAX_WAIT_MS,
+        cache_capacity=max(USERS * 2, 16),
+        retrieval=retrieval,
+        tracer=tracer,
+        logger=logger,
+        trace_path=os.path.join(RUN_DIR, "trace.json"),
+    )
+    compile_seconds = time.perf_counter() - compile_start
+
+    histories = {
+        u: rng.integers(0, NUM_ITEMS, size=int(rng.integers(1, 2 * SEQ_LEN))).tolist()
+        for u in range(USERS)
+    }
+
+    with service:
+        # seed every user cold (also settles the executables)
+        seed_futures = [
+            service.submit(u, history=histories[u]) for u in range(USERS)
+        ]
+        for future in seed_futures:
+            future.result(timeout=120)
+
+        def one_request(thread_rng, user: int):
+            """The returning-user mix: mostly hits, some advances, rare colds."""
+            draw = thread_rng.random()
+            if draw < 0.7:
+                return service.submit(user)
+            if draw < 0.9:
+                new_item = int(thread_rng.integers(0, NUM_ITEMS))
+                histories[user].append(new_item)
+                return service.submit(user, new_items=[new_item])
+            return service.submit(user, history=histories[user])
+
+        # ---- closed loop: saturation throughput --------------------------- #
+        errors = []
+
+        def client(idx: int) -> None:
+            thread_rng = np.random.default_rng(1000 + idx)
+            for _ in range(CLOSED_REQUESTS):
+                user = int(thread_rng.integers(0, USERS))
+                try:
+                    one_request(thread_rng, user).result(timeout=120)
+                except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+                    errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True) for i in range(CLIENTS)
+        ]
+        closed_start = time.perf_counter()
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        closed_elapsed = time.perf_counter() - closed_start
+        closed_qps = CLIENTS * CLOSED_REQUESTS / closed_elapsed
+
+        # ---- open loop: Poisson arrivals, latency percentiles ------------- #
+        latencies = []
+        latency_lock = threading.Lock()
+        done_count = [0]
+
+        def on_done(submitted_at):
+            def callback(future):
+                latency = time.perf_counter() - submitted_at
+                with latency_lock:
+                    done_count[0] += 1
+                    if future.exception() is None:
+                        latencies.append(latency)
+                    else:
+                        errors.append(repr(future.exception()))
+
+            return callback
+
+        open_rng = np.random.default_rng(7)
+        open_start = time.perf_counter()
+        submitted = 0
+        deadline = open_start + SECONDS
+        while time.perf_counter() < deadline:
+            user = int(open_rng.integers(0, USERS))
+            submitted_at = time.perf_counter()
+            future = one_request(open_rng, user)
+            future.add_done_callback(on_done(submitted_at))
+            submitted += 1
+            gap = float(open_rng.exponential(1.0 / max(RATE, 1)))
+            time.sleep(min(gap, 1.0))
+        while True:
+            with latency_lock:
+                if done_count[0] >= submitted:
+                    break
+            time.sleep(0.005)
+        open_elapsed = time.perf_counter() - open_start
+        open_qps = submitted / open_elapsed
+        stats = service.stats()
+
+    metric = "serve_qps"
+    if jax.default_backend() == "cpu" and is_fallback:
+        metric += "_cpu_fallback"
+    record = {
+        "metric": metric,
+        "value": round(open_qps, 1),
+        "unit": "req/s",
+        "qps": round(open_qps, 1),
+        "closed_loop_qps": round(closed_qps, 1),
+        "p50_ms": round(_percentile(latencies, 50) * 1000.0, 3),
+        "p95_ms": round(_percentile(latencies, 95) * 1000.0, 3),
+        "p99_ms": round(_percentile(latencies, 99) * 1000.0, 3),
+        "batch_fill_ratio": round(stats["batch_fill_ratio"], 4),
+        "cache_hit_rate": round(stats["cache_hit_rate"], 4),
+        "pure_hit_rate": round(stats["pure_hit_rate"], 4),
+        "requests": stats["requests"],
+        "request_errors": len(errors),
+        "mode": mode,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_buckets": list(BATCH_BUCKETS),
+        "length_buckets": list(service.engine.length_buckets),
+        "max_wait_ms": MAX_WAIT_MS,
+        "open_loop_rate": RATE,
+        "open_loop_seconds": SECONDS,
+        "clients": CLIENTS,
+        "users": USERS,
+        "compile_seconds": round(compile_seconds, 2),
+    }
+    if SHAPE_OVERRIDE:
+        record["shape_override"] = {
+            "L": SEQ_LEN,
+            "items": NUM_ITEMS,
+            "d": EMBEDDING_DIM,
+            "blocks": NUM_BLOCKS,
+            "users": USERS,
+        }
+    if errors:
+        record["first_error"] = errors[0]
+    # the record rides the run's events.jsonl too, so the report CLI renders
+    # qps/latency and the service-side totals from one artifact
+    logger.log_record(record)
+    logger.close()
+    if record["backend"] == "tpu" and not SHAPE_OVERRIDE:
+        record["captured_unix"] = int(time.time())
+        try:
+            from replay_tpu.obs import JsonlLogger as _Sidecar
+
+            sidecar = _Sidecar(
+                os.path.dirname(SIDECAR_PATH),
+                filename=os.path.basename(SIDECAR_PATH),
+                mode="w",
+            )
+            sidecar.log_record(record)
+            sidecar.close()
+        except OSError:
+            pass
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

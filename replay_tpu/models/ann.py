@@ -103,14 +103,21 @@ class MIPSIndex:
         self._search_cache[k] = search
         return search
 
-    def search(self, query_vectors: np.ndarray, k: int):
-        """(scores [Q, k], item ids [Q, k]) of the highest inner products."""
+    def search_jax(self, query_vectors, k: int):
+        """(scores [Q, k], item ids [Q, k]) as DEVICE arrays — the fused
+        serving path (``replay_tpu.serve``) hands the encoder's last-hidden
+        state straight in and the candidate ids straight to the re-rank
+        program, no host round-trip between retrieval stages."""
         import jax.numpy as jnp
 
         if k > self.num_items:
             msg = f"k={k} exceeds the catalog size {self.num_items}"
             raise ValueError(msg)
-        values, indices = self._compiled_search(k)(jnp.asarray(query_vectors, jnp.float32))
+        return self._compiled_search(k)(jnp.asarray(query_vectors, jnp.float32))
+
+    def search(self, query_vectors: np.ndarray, k: int):
+        """(scores [Q, k], item ids [Q, k]) of the highest inner products."""
+        values, indices = self.search_jax(query_vectors, k)
         return np.asarray(values), np.asarray(indices)
 
 

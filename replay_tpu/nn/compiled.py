@@ -8,28 +8,99 @@ TPU design: "compilation" is ``jax.jit(...).lower(...).compile()`` — an XLA
 executable specialized to fixed shapes (no tracing, no python dispatch overhead
 at serving time). ``dynamic_batch_size`` keeps a small set of power-of-two
 bucket executables and pads requests up to the nearest bucket — the XLA answer
-to dynamic shapes. ``serialize``/``deserialize`` use ``jax.export`` (StableHLO
-bytes) so a serving process can load the executable without the model code.
+to dynamic shapes. :meth:`CompiledInference.serialize` /
+:meth:`CompiledInference.deserialize` round-trip the WHOLE instance (every
+bucket executable as ``jax.export`` StableHLO bytes + a JSON header with the
+mode/shape metadata) so a serving process can load the executables without the
+model code or the params pytree; the legacy single-executable
+``export_inference`` / ``import_inference`` helpers remain for the one-shape
+case. The ``outputs`` switch serves the online scoring service
+(``replay_tpu.serve``): ``"logits"`` is the classic scoring head, ``"hidden"``
+returns the last-position encoder state (the per-user cached embedding; full
+logits never materialize — retrieval goes through the MIPS index instead), and
+``"both"`` returns ``(logits, hidden)`` in one dispatch.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence
+import io
+import json
+import struct
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 MODES = ("batch", "one_query", "dynamic_batch_size")
+OUTPUTS = ("logits", "hidden", "both")
+
+_MAGIC = b"RTCI\x01"
+
+
+def _flatten_params(params, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested param mapping → ``{"a/b/kernel": array}`` (flax params are
+    string-keyed dict trees, so the flat form is lossless)."""
+    flat: Dict[str, np.ndarray] = {}
+    for key, value in params.items():
+        path = f"{prefix}{key}"
+        if hasattr(value, "items"):
+            flat.update(_flatten_params(value, prefix=f"{path}/"))
+        else:
+            flat[path] = np.asarray(value)
+    return flat
+
+
+def _unflatten_params(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    nested: Dict[str, Any] = {}
+    for path, value in flat.items():
+        node = nested
+        *parents, leaf = path.split("/")
+        for parent in parents:
+            node = node.setdefault(parent, {})
+        node[leaf] = value
+    return nested
 
 
 class CompiledInference:
-    """An AOT-compiled ``forward_inference`` for fixed serving shapes."""
+    """An AOT-compiled ``forward_inference`` for fixed serving shapes.
 
-    def __init__(self, compiled_by_batch: Dict[int, Any], max_sequence_length: int, mode: str):
+    ``_compiled`` maps batch-bucket size → a callable ``(item_ids,
+    padding_mask, candidates_or_None) -> outputs`` with the params already
+    bound (live-compiled executables close over them; deserialized ones carry
+    them baked into the StableHLO as constants). Values may be ``None`` for
+    routing-only instances (bucket-selection tests).
+    """
+
+    def __init__(
+        self,
+        compiled_by_batch: Dict[int, Any],
+        max_sequence_length: int,
+        mode: str,
+        outputs: str = "logits",
+        candidates_count: Optional[int] = None,
+    ):
         self._compiled = compiled_by_batch
         self.max_sequence_length = max_sequence_length
         self.mode = mode
+        self.outputs = outputs
+        self._candidates_count = candidates_count
+        # closure (bucket -> StableHLO bytes), set by compile(); deserialized
+        # instances keep the raw blobs instead so serialize() stays total
+        self._serialize_bucket: Optional[Callable[[int], bytes]] = None
+        self._raw_blobs: Optional[Dict[int, bytes]] = None
+        # the params pytree shipped with the export. Params travel as program
+        # ARGUMENTS, not baked-in constants: constant-folding them would let
+        # XLA re-associate the math and break bitwise parity with the live
+        # executables (the latent issue the round-trip test surfaced).
+        self._export_params: Any = None
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        """The compiled batch-bucket sizes, ascending — the introspection seam
+        the serve micro-batcher sizes its lanes from (no private attribute
+        access)."""
+        return tuple(sorted(self._compiled))
 
     @classmethod
     def compile(
@@ -42,14 +113,24 @@ class CompiledInference:
         candidates_count: Optional[int] = None,
         feature_name: str = "item_id",
         dynamic_buckets: Sequence[int] = (1, 8, 64, 512),
+        outputs: str = "logits",
     ) -> "CompiledInference":
         """Lower + compile the model's ``forward_inference`` for the mode's shapes.
 
         ``batch``: one executable at ``batch_size``; ``one_query``: batch 1;
         ``dynamic_batch_size``: one executable per power-of-two bucket.
+        ``outputs`` selects what each executable returns: ``"logits"``
+        (forward_inference scores), ``"hidden"`` (last-position encoder state,
+        no scoring head), or ``"both"``.
         """
         if mode not in MODES:
             msg = f"mode must be one of {MODES}"
+            raise ValueError(msg)
+        if outputs not in OUTPUTS:
+            msg = f"outputs must be one of {OUTPUTS}"
+            raise ValueError(msg)
+        if outputs == "hidden" and candidates_count:
+            msg = "outputs='hidden' computes no scores; candidates_count is meaningless"
             raise ValueError(msg)
         sizes = {
             "batch": [batch_size],
@@ -57,17 +138,37 @@ class CompiledInference:
             "dynamic_batch_size": sorted(dynamic_buckets),
         }[mode]
 
+        model_cls = type(model)
+
         def forward(params, item_ids, padding_mask, candidates):
-            return model.apply(
+            if outputs == "logits":
+                return model.apply(
+                    {"params": params},
+                    {feature_name: item_ids},
+                    padding_mask,
+                    candidates_to_score=candidates,
+                    method=model_cls.forward_inference,
+                )
+            # the same ops forward_inference runs, split so the last-position
+            # hidden state is a program output (the serve cache's state)
+            hidden = model.apply(
                 {"params": params},
                 {feature_name: item_ids},
                 padding_mask,
-                candidates_to_score=candidates,
-                method=type(model).forward_inference,
+                method=model_cls.__call__,
             )
+            last = hidden[:, -1, :]
+            if outputs == "hidden":
+                return last
+            logits = model.apply(
+                {"params": params},
+                last,
+                candidates_to_score=candidates,
+                method=model_cls.get_logits,
+            )
+            return logits, last
 
-        compiled = {}
-        for size in sizes:
+        def specs(size):
             ids_spec = jax.ShapeDtypeStruct((size, max_sequence_length), jnp.int32)
             mask_spec = jax.ShapeDtypeStruct((size, max_sequence_length), jnp.bool_)
             cand_spec = (
@@ -75,16 +176,140 @@ class CompiledInference:
                 if candidates_count
                 else None
             )
-            compiled[size] = (
+            return ids_spec, mask_spec, cand_spec
+
+        compiled = {}
+        for size in sizes:
+            ids_spec, mask_spec, cand_spec = specs(size)
+            executable = (
                 jax.jit(forward)
                 .lower(params, ids_spec, mask_spec, cand_spec)
                 .compile()
             )
-        out = cls(compiled, max_sequence_length, mode)
-        out._params = params
-        out._candidates_count = candidates_count
+            # bind params so every stored callable shares one convention
+            # (AOT executables demand the exact lowering pytree, None included)
+            compiled[size] = (
+                lambda ids, mask, cands, _ex=executable: _ex(params, ids, mask, cands)
+            )
+        out = cls(
+            compiled,
+            max_sequence_length,
+            mode,
+            outputs=outputs,
+            candidates_count=candidates_count,
+        )
+
+        def serialize_bucket(size: int) -> bytes:
+            from jax import export as jax_export
+
+            ids_spec, mask_spec, cand_spec = specs(size)
+            if cand_spec is not None:
+
+                def bound(params, item_ids, padding_mask, candidates):
+                    return forward(params, item_ids, padding_mask, candidates)
+
+                exported = jax_export.export(jax.jit(bound))(
+                    params, ids_spec, mask_spec, cand_spec
+                )
+            else:
+
+                def bound(params, item_ids, padding_mask):
+                    return forward(params, item_ids, padding_mask, None)
+
+                exported = jax_export.export(jax.jit(bound))(params, ids_spec, mask_spec)
+            return exported.serialize()
+
+        out._serialize_bucket = serialize_bucket
+        out._export_params = params
         return out
 
+    # -- persistence -------------------------------------------------------- #
+    def serialize(self) -> bytes:
+        """The whole instance as portable bytes: a JSON header (mode, shapes,
+        outputs, candidate count, bucket list), the params pytree (npz), and
+        one ``jax.export`` StableHLO payload per bucket — :meth:`deserialize`
+        needs neither the model code nor the checkpoint, and the params stay
+        program arguments so the round-tripped scores are bit-identical."""
+        if self._serialize_bucket is None and self._raw_blobs is None:
+            msg = "This instance holds no executables to serialize (routing-only?)"
+            raise ValueError(msg)
+        header = {
+            "mode": self.mode,
+            "max_sequence_length": int(self.max_sequence_length),
+            "outputs": self.outputs,
+            "candidates_count": self._candidates_count,
+            "buckets": [int(b) for b in self.buckets],
+        }
+        header_bytes = json.dumps(header).encode()
+        params_buf = io.BytesIO()
+        np.savez(params_buf, **_flatten_params(self._export_params))
+        params_bytes = params_buf.getvalue()
+        buf = io.BytesIO()
+        buf.write(_MAGIC)
+        buf.write(struct.pack("<I", len(header_bytes)))
+        buf.write(header_bytes)
+        buf.write(struct.pack("<I", len(params_bytes)))
+        buf.write(params_bytes)
+        for size in self.buckets:
+            blob = (
+                self._raw_blobs[size]
+                if self._raw_blobs is not None
+                else self._serialize_bucket(size)
+            )
+            buf.write(struct.pack("<I", len(blob)))
+            buf.write(blob)
+        return buf.getvalue()
+
+    @classmethod
+    def deserialize(cls, payload: bytes) -> "CompiledInference":
+        """Rebuild a fresh :class:`CompiledInference` from :meth:`serialize`
+        bytes — scores are identical to the live-compiled instance's."""
+        from jax import export as jax_export
+
+        view = memoryview(payload)
+        if bytes(view[: len(_MAGIC)]) != _MAGIC:
+            msg = "Not a CompiledInference payload (bad magic)"
+            raise ValueError(msg)
+        offset = len(_MAGIC)
+        (header_len,) = struct.unpack_from("<I", view, offset)
+        offset += 4
+        header = json.loads(bytes(view[offset : offset + header_len]))
+        offset += header_len
+        (params_len,) = struct.unpack_from("<I", view, offset)
+        offset += 4
+        with np.load(io.BytesIO(bytes(view[offset : offset + params_len]))) as archive:
+            params = _unflatten_params({name: archive[name] for name in archive.files})
+        offset += params_len
+        candidates_count = header["candidates_count"]
+        compiled: Dict[int, Any] = {}
+        blobs: Dict[int, bytes] = {}
+        for size in header["buckets"]:
+            (blob_len,) = struct.unpack_from("<I", view, offset)
+            offset += 4
+            blob = bytes(view[offset : offset + blob_len])
+            offset += blob_len
+            blobs[size] = blob
+            exported = jax_export.deserialize(blob)
+            if candidates_count:
+                compiled[size] = (
+                    lambda ids, mask, cands, _ex=exported: _ex.call(params, ids, mask, cands)
+                )
+            else:
+                compiled[size] = (
+                    lambda ids, mask, cands, _ex=exported: _ex.call(params, ids, mask)
+                )
+        out = cls(
+            compiled,
+            header["max_sequence_length"],
+            header["mode"],
+            outputs=header["outputs"],
+            candidates_count=candidates_count,
+        )
+        out._raw_blobs = blobs
+        out._export_params = params
+        return out
+
+    # -- execution ---------------------------------------------------------- #
     def _bucket_for(self, batch: int) -> int:
         for size in sorted(self._compiled):
             if size >= batch:
@@ -92,8 +317,11 @@ class CompiledInference:
         msg = f"Batch {batch} exceeds the largest compiled bucket {max(self._compiled)}"
         raise ValueError(msg)
 
-    def __call__(self, item_ids, padding_mask, candidates=None) -> jnp.ndarray:
-        """Score [B, L] sequences; pads the batch up to the compiled bucket."""
+    def __call__(self, item_ids, padding_mask, candidates=None):
+        """Score [B, L] sequences; pads the batch up to the compiled bucket.
+
+        Returns logits, hidden, or ``(logits, hidden)`` per the ``outputs``
+        mode, always cut back to the request's row count."""
         item_ids = np.asarray(item_ids, np.int32)
         padding_mask = np.asarray(padding_mask, bool)
         batch = item_ids.shape[0]
@@ -117,7 +345,6 @@ class CompiledInference:
         if self._candidates_count and candidates is None:
             msg = f"Compiled for {self._candidates_count} candidates; none given."
             raise ValueError(msg)
-        args = [self._params, item_ids, padding_mask]
         if self._candidates_count:
             candidates = np.asarray(candidates, np.int32)
             if candidates.shape != (self._candidates_count,):
@@ -126,15 +353,18 @@ class CompiledInference:
                     f"({self._candidates_count},)"
                 )
                 raise ValueError(msg)
-            args.append(candidates)
-        else:
-            args.append(None)
-        logits = self._compiled[bucket](*args)
-        return logits[:batch]
+        out = self._compiled[bucket](item_ids, padding_mask, candidates)
+        if self.outputs == "both":
+            logits, hidden = out
+            return logits[:batch], hidden[:batch]
+        return out[:batch]
 
 def export_inference(model, params, max_sequence_length: int, batch_size: int,
                      feature_name: str = "item_id") -> bytes:
-    """Serialize forward_inference to portable StableHLO bytes (jax.export)."""
+    """Serialize forward_inference to portable StableHLO bytes (jax.export).
+
+    One shape, logits only — :meth:`CompiledInference.serialize` is the
+    full-instance (all buckets/modes/outputs) round-trip."""
     from jax import export as jax_export
 
     def forward(item_ids, padding_mask):

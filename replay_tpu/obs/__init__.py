@@ -26,7 +26,14 @@ from .events import (
     TrainerEvent,
 )
 from .mfu import PEAK_BF16_TFLOPS, cost_analysis, flops_per_step, mfu, peak_tflops
-from .trace import GOODPUT_SPANS, Tracer, goodput_breakdown, traced_iterator
+from .trace import (
+    GOODPUT_SPANS,
+    SERVE_GOODPUT_SPANS,
+    Tracer,
+    goodput_breakdown,
+    lifecycle_span,
+    traced_iterator,
+)
 
 __all__ = [
     "CompileTracker",
@@ -39,6 +46,7 @@ __all__ = [
     "MultiLogger",
     "PEAK_BF16_TFLOPS",
     "RunLogger",
+    "SERVE_GOODPUT_SPANS",
     "StepTelemetry",
     "TensorBoardLogger",
     "Tracer",
@@ -48,6 +56,7 @@ __all__ = [
     "flops_per_step",
     "goodput_breakdown",
     "health_metrics",
+    "lifecycle_span",
     "mfu",
     "peak_tflops",
     "traced_iterator",

@@ -26,10 +26,19 @@ Event flow emitted by ``replay_tpu.nn.Trainer.fit``::
     on_fit_end                (telemetry summary, compile report, peak memory,
                                sentinel bad_steps total)
 
+The serving stack (``replay_tpu.serve.ScoringService``) reuses the same sinks
+with its own event family::
+
+    on_serve_start            (mode, bucket ladders, max_wait, cache capacity)
+      on_serve_batch*         (one per dispatched micro-batch: lane, rows,
+                               bucket, fill, max queue wait)
+    on_serve_end              (request totals, cache hit rate, batch fill
+                               ratio, queue-wait stats, serve goodput)
+
 Every event flattens to one JSON-able dict (``event`` + ``time`` + optional
 ``step``/``epoch`` + the payload), so a run directory's ``events.jsonl`` is a
-self-describing artifact shared by training runs, ``bench.py`` records and the
-CPU-mesh dry runs.
+self-describing artifact shared by training runs, ``bench.py`` /
+``bench_serve.py`` records and the CPU-mesh dry runs.
 """
 
 from __future__ import annotations
@@ -303,6 +312,15 @@ class ConsoleLogger(RunLogger):
             )
         elif event.event == "on_epoch_end":
             logger.info("epoch %s: %s", event.epoch, event.payload.get("record"))
+        elif event.event == "on_serve_end":
+            logger.info(
+                "serve complete: %s request(s), cache hit rate %.1f%%, "
+                "batch fill %.1f%%, mean queue wait %.2f ms",
+                event.payload.get("requests"),
+                100.0 * (event.payload.get("cache_hit_rate") or 0.0),
+                100.0 * (event.payload.get("batch_fill_ratio") or 0.0),
+                event.payload.get("queue_wait_ms_mean") or 0.0,
+            )
         elif event.event == "on_fit_end":
             summary = {
                 k: event.payload.get(k)

@@ -8,9 +8,11 @@
 Turns the telemetry artifacts every trainer/bench/dry run leaves behind into
 the one-page answer "Demystifying BERT" (PAPERS.md) says a profile must
 become: throughput, MFU, the goodput breakdown (where wall-clock went between
-steps), retraces, bad/recovered steps, and the model-health record
+steps), retraces, bad/recovered steps, the model-health record
 (obs.health: per-group norms/update ratios, activation stats, attention
-entropy, early warnings). ``--compare`` diffs two runs —
+entropy, early warnings), and the serving summary (replay_tpu.serve /
+bench_serve.py: QPS, latency percentiles, batch fill, cache hit rate —
+gated on QPS drops and p99 growth). ``--compare`` diffs two runs —
 either run may be a run directory, a raw ``events.jsonl``, or a single-record
 bench JSON (``BENCH_*.json`` / ``BENCH_TPU_SIDECAR.json``) — and exits
 non-zero when the candidate regresses beyond ``--threshold`` (relative), so
@@ -31,7 +33,7 @@ import os
 import sys
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
-from .trace import GOODPUT_SPANS
+from .trace import GOODPUT_SPANS, SERVE_GOODPUT_SPANS
 
 __all__ = ["compare_runs", "load_events", "main", "render", "summarize_run"]
 
@@ -143,12 +145,24 @@ def summarize_events(
     fit_ends = [e for e in events if e.get("event") == "on_fit_end"]
     bench = [e for e in events if "metric" in e and "value" in e]
     dryruns = [e for e in events if e.get("event") == "dryrun_multichip"]
+    serve_ends = [e for e in events if e.get("event") == "on_serve_end"]
+    serve_batches = [e for e in events if e.get("event") == "on_serve_batch"]
 
     summary: Dict[str, Any] = {
         "source": source,
         "events": len(events),
         "kind": (
-            "fit" if fit_ends or steps else ("bench" if bench else ("dryrun" if dryruns else "events"))
+            "fit"
+            if fit_ends or steps
+            else (
+                "bench"
+                if bench
+                else (
+                    "serve"
+                    if serve_ends or serve_batches
+                    else ("dryrun" if dryruns else "events")
+                )
+            )
         ),
         "train_steps": len(steps),
         "epochs": len(epoch_ends),
@@ -284,6 +298,38 @@ def summarize_events(
             for key in ("mesh", "losses", "psum", "sp_ring_err", "spans", "backend")
             if key in record
         }
+
+    # the serving summary (replay_tpu.serve): service-side totals from the
+    # on_serve_end event, load-side qps/latency percentiles from the
+    # bench_serve.py record — either alone still renders a section
+    serve: Dict[str, Any] = {}
+    if serve_ends:
+        record = serve_ends[-1]
+        serve.update(
+            {
+                key: record.get(key)
+                for key in (
+                    "mode", "requests", "answered", "errors", "cache_hit_rate",
+                    "pure_hit_rate", "batch_fill_ratio", "queue_wait_ms_mean",
+                    "queue_wait_ms_max",
+                )
+                if key in record
+            }
+        )
+        serve["batches"] = len(serve_batches)
+    if bench and "serve" in str(bench[-1].get("metric", "")):
+        record = bench[-1]
+        serve.update(
+            {
+                key: record.get(key)
+                for key in (
+                    "qps", "p50_ms", "p95_ms", "p99_ms", "batch_fill_ratio",
+                    "cache_hit_rate", "closed_loop_qps", "requests", "mode",
+                )
+                if key in record
+            }
+        )
+    summary["serve"] = serve or None
     return summary
 
 
@@ -383,9 +429,16 @@ def render(summary: Mapping[str, Any]) -> str:
     goodput = summary.get("goodput")
     if goodput:
         fractions = goodput.get("fractions") or {}
+        # training and serving breakdowns carry different span sets; show
+        # whichever phases this run recorded, in canonical order
+        phase_order = (
+            *GOODPUT_SPANS,
+            *(n for n in SERVE_GOODPUT_SPANS if n not in GOODPUT_SPANS),
+            "other",
+        )
         shown = " · ".join(
             f"{name} {100.0 * float(fractions.get(name, 0.0)):.1f}%"
-            for name in (*GOODPUT_SPANS, "other")
+            for name in phase_order
             if name in fractions
         )
         lines.append(
@@ -447,6 +500,31 @@ def render(summary: Mapping[str, Any]) -> str:
                     else ""
                 )
             )
+    serve = summary.get("serve")
+    if serve:
+        parts = []
+        if _finite(serve.get("qps")) is not None:
+            parts.append(f"{serve['qps']:.1f} qps")
+        if _finite(serve.get("p50_ms")) is not None:
+            parts.append(
+                f"latency p50/p95/p99 {_fmt(_finite(serve.get('p50_ms')), '{:.2f}')}"
+                f"/{_fmt(_finite(serve.get('p95_ms')), '{:.2f}')}"
+                f"/{_fmt(_finite(serve.get('p99_ms')), '{:.2f}')} ms"
+            )
+        if serve.get("requests") is not None:
+            answered = serve.get("answered")
+            parts.append(
+                f"requests {serve['requests']}"
+                + (f" ({answered} answered)" if answered is not None else "")
+            )
+        if _finite(serve.get("batch_fill_ratio")) is not None:
+            parts.append(f"batch fill {100.0 * serve['batch_fill_ratio']:.0f}%")
+        if _finite(serve.get("cache_hit_rate")) is not None:
+            parts.append(f"cache hits {100.0 * serve['cache_hit_rate']:.0f}%")
+        if _finite(serve.get("queue_wait_ms_mean")) is not None:
+            parts.append(f"queue wait {serve['queue_wait_ms_mean']:.2f} ms mean")
+        mode = f" [{serve['mode']}]" if serve.get("mode") else ""
+        lines.append(f"  serving{mode}: " + " · ".join(parts))
     return "\n".join(lines)
 
 
@@ -526,9 +604,37 @@ def compare_runs(
                 regressions.append(
                     f"{label} increased {base_count} -> {cand_count} (model-health regression)"
                 )
+    # serving gates: QPS is higher-better (reuses check); tail latency is
+    # LOWER-better — a p99 that grew beyond threshold is a regression even
+    # when throughput held (the micro-batcher trading latency for fill is
+    # exactly the failure mode this catches)
+    cand_serve, base_serve = candidate.get("serve") or {}, baseline.get("serve") or {}
+    if cand_serve or base_serve:
+        check("serve_qps", _finite(cand_serve.get("qps")), _finite(base_serve.get("qps")))
+        cand_p99, base_p99 = _finite(cand_serve.get("p99_ms")), _finite(base_serve.get("p99_ms"))
+        if cand_p99 is None or base_p99 is None:
+            lines.append(
+                f"  serve_p99_ms: candidate={_fmt(cand_p99, '{:.3f}')} "
+                f"baseline={_fmt(base_p99, '{:.3f}')} (not comparable)"
+            )
+        else:
+            delta = (cand_p99 - base_p99) / base_p99 if base_p99 else 0.0
+            lines.append(f"  serve_p99_ms: {cand_p99:.3f} vs {base_p99:.3f} ({delta:+.1%})")
+            if base_p99 > 0 and cand_p99 > base_p99 * (1.0 + threshold):
+                regressions.append(
+                    f"serve_p99_ms regressed {delta:+.1%} (> {threshold:.0%} threshold)"
+                )
+        for name in ("batch_fill_ratio", "cache_hit_rate"):
+            cand_value, base_value = _finite(cand_serve.get(name)), _finite(base_serve.get(name))
+            if cand_value is not None and base_value is not None:
+                lines.append(f"  serve_{name}: {cand_value:.3f} vs {base_value:.3f}")
     cand_gp, base_gp = candidate.get("goodput"), baseline.get("goodput")
     if cand_gp and base_gp:
-        for name in (*GOODPUT_SPANS, "other"):
+        for name in (
+            *GOODPUT_SPANS,
+            *(n for n in SERVE_GOODPUT_SPANS if n not in GOODPUT_SPANS),
+            "other",
+        ):
             cand_frac = float((cand_gp.get("fractions") or {}).get(name, 0.0))
             base_frac = float((base_gp.get("fractions") or {}).get(name, 0.0))
             if abs(cand_frac - base_frac) >= 0.01:

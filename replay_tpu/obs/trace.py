@@ -40,8 +40,10 @@ from typing import Any, Dict, Iterable, Iterator, List, Mapping, Optional
 
 __all__ = [
     "GOODPUT_SPANS",
+    "SERVE_GOODPUT_SPANS",
     "Tracer",
     "goodput_breakdown",
+    "lifecycle_span",
     "traced_iterator",
 ]
 
@@ -64,6 +66,21 @@ GOODPUT_SPANS = (
     "validation",
     "checkpoint",
     "recovery",
+)
+
+# the serving pipeline's phases (replay_tpu.serve): a request waits in the
+# micro-batcher queue ("queue_wait", recorded cross-thread via
+# :func:`lifecycle_span`), its batch is assembled ("batch_build", shared with
+# the training batcher), scored on device ("score"), and — on the fused
+# candidate->rank path — retrieved ("retrieve") and re-ranked ("rerank").
+# ``goodput_breakdown(..., spans=SERVE_GOODPUT_SPANS)`` folds a serve worker's
+# wall clock into fractions summing to 1.0, same contract as training.
+SERVE_GOODPUT_SPANS = (
+    "queue_wait",
+    "batch_build",
+    "score",
+    "retrieve",
+    "rerank",
 )
 
 # the spans that make up the stepping pipeline: the denominator of the
@@ -213,6 +230,13 @@ class Tracer:
             )
 
     # -- aggregation -------------------------------------------------------- #
+    def now(self) -> float:
+        """Epoch-relative timestamp (seconds since this tracer was created) —
+        the time base of every recorded span's ``start``. Take one on the
+        producing thread and hand it to :func:`lifecycle_span` on the consuming
+        thread to time a phase whose begin/end straddle threads."""
+        return self._clock() - self._t0
+
     def wall_seconds(self) -> float:
         """Seconds since this tracer was created."""
         return self._clock() - self._t0
@@ -304,39 +328,65 @@ def traced_iterator(
         yield batch
 
 
+def lifecycle_span(
+    tracer: Tracer, name: str, started_at: float, **args: Any
+) -> float:
+    """Record a lifecycle phase that began on another thread; returns its
+    duration in seconds.
+
+    :func:`traced_iterator`'s cross-thread sibling: a request's ``queue_wait``
+    starts when the client thread enqueues it (capture ``tracer.now()`` there)
+    and ends when the serve worker dequeues it — no single ``with`` block can
+    cover both, so the span is recorded synthetically on the consuming thread
+    via :meth:`Tracer.add_span`.
+    """
+    duration = max(tracer.now() - float(started_at), 0.0)
+    tracer.add_span(name, float(started_at), duration, **args)
+    return duration
+
+
 def goodput_breakdown(
-    span_self_seconds: Mapping[str, float], wall_seconds: float
+    span_self_seconds: Mapping[str, float],
+    wall_seconds: float,
+    spans: Iterable[str] = GOODPUT_SPANS,
 ) -> Dict[str, Any]:
     """Fold an exclusive-time snapshot (diff) into the goodput record.
 
     Returns ``{"wall_seconds", "fractions", "input_starvation"}`` where
-    ``fractions`` maps every :data:`GOODPUT_SPANS` phase plus the derived
+    ``fractions`` maps every ``spans`` phase (default :data:`GOODPUT_SPANS`;
+    pass :data:`SERVE_GOODPUT_SPANS` for a serving worker) plus the derived
     ``other`` to its share of ``wall_seconds`` — summing to 1.0 by
     construction — and ``input_starvation`` is the fraction of the stepping
     pipeline (data_wait + batch_build + h2d + compile + train_step) spent on
     the input side (waiting on the iterator + same-thread batch assembly).
     """
+    spans = tuple(spans)
     wall = max(float(wall_seconds), 0.0)
     fractions: Dict[str, float] = {}
     tracked = 0.0
-    for name in GOODPUT_SPANS:
+    for name in spans:
         seconds = max(float(span_self_seconds.get(name, 0.0)), 0.0)
         tracked += seconds
         fractions[name] = seconds / wall if wall > 0 else 0.0
     if wall > 0 and tracked > wall:
         # spans from concurrent threads can overlap the window; renormalize so
         # the contract (fractions sum to 1.0) survives
-        for name in GOODPUT_SPANS:
+        for name in spans:
             fractions[name] *= wall / tracked
         tracked = wall
     fractions["other"] = (wall - tracked) / wall if wall > 0 else 1.0
-    pipeline = sum(
-        max(float(span_self_seconds.get(name, 0.0)), 0.0) for name in _STEP_PIPELINE
-    )
-    input_side = sum(
-        max(float(span_self_seconds.get(name, 0.0)), 0.0) for name in _INPUT_SPANS
-    )
-    starvation = input_side / pipeline if pipeline > 0 else 0.0
+    if "train_step" not in spans:
+        # a non-training breakdown (e.g. SERVE_GOODPUT_SPANS) has no stepping
+        # pipeline to starve — None keeps the metric honest and unrendered
+        starvation = None
+    else:
+        pipeline = sum(
+            max(float(span_self_seconds.get(name, 0.0)), 0.0) for name in _STEP_PIPELINE
+        )
+        input_side = sum(
+            max(float(span_self_seconds.get(name, 0.0)), 0.0) for name in _INPUT_SPANS
+        )
+        starvation = input_side / pipeline if pipeline > 0 else 0.0
     return {
         "wall_seconds": wall,
         "fractions": fractions,

@@ -59,9 +59,33 @@ class LogisticReranker:
         self.weights = np.asarray(w)
         return self
 
+    @staticmethod
+    def decision_function(features: np.ndarray, weights: np.ndarray) -> np.ndarray:
+        """Pre-sigmoid margins ``features @ w[:-1] + w[-1]`` — the ONE formula
+        shared by the host ``predict_proba`` and the on-device serve re-rank
+        (``replay_tpu.serve.pipeline`` applies the same ``weights`` with
+        ``jnp``), so serving scores stay faithful to the trained reranker."""
+        features = np.asarray(features, np.float64)
+        weights = np.asarray(weights, np.float64)
+        if features.shape[-1] != weights.shape[0] - 1:
+            msg = (
+                f"feature dim {features.shape[-1]} does not match reranker "
+                f"weights trained on {weights.shape[0] - 1} features"
+            )
+            raise ValueError(msg)
+        return features @ weights[:-1] + weights[-1]
+
+    @property
+    def serving_weights(self) -> np.ndarray:
+        """Trained ``[n_features + 1]`` weights (bias last) for the serve
+        pipeline's on-device re-rank; raises before :meth:`fit`."""
+        if self.weights is None:
+            msg = "LogisticReranker has no trained weights yet (call fit first)"
+            raise ValueError(msg)
+        return np.asarray(self.weights)
+
     def predict_proba(self, features: np.ndarray) -> np.ndarray:
-        x = np.column_stack([features, np.ones(len(features))])
-        return 1.0 / (1.0 + np.exp(-(x @ self.weights)))
+        return 1.0 / (1.0 + np.exp(-self.decision_function(features, self.serving_weights)))
 
 
 class TwoStages(BaseRecommender):

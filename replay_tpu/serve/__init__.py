@@ -1,0 +1,38 @@
+"""On-device scoring service: micro-batched, state-cached, candidate→rank fused.
+
+The production-serving analog of the reference's OpenVINO-compiled-model +
+ANN-index stack (SURVEY §2.8), built from this repo's own pieces:
+
+* :class:`MicroBatcher` — fills fixed ``[B, L]`` slots from concurrent
+  requests under a max-wait deadline (``batcher``).
+* :class:`UserStateCache` — per-user encoded-state LRU with one-step
+  incremental window advances (``cache``).
+* :class:`ScoringEngine` — pre-compiled ``CompiledInference`` bucket
+  executables per length bucket + cached-state scorers (``engine``).
+* :class:`CandidatePipeline` — exact sharded MIPS retrieval fused with the
+  two-stage re-rank and top-k, all on device (``pipeline``).
+* :class:`ScoringService` — the end-to-end service (``service``).
+
+``bench_serve.py`` (repo root) drives it with closed/open-loop load and emits
+the QPS/latency/fill/hit-rate record ``obs.report`` renders and gates on.
+See docs/serving.md.
+"""
+
+from .batcher import MicroBatcher
+from .cache import UserState, UserStateCache
+from .engine import ScoringEngine
+from .pipeline import CandidatePipeline
+from .request import ScoreRequest, ScoreResponse, make_window
+from .service import ScoringService
+
+__all__ = [
+    "CandidatePipeline",
+    "MicroBatcher",
+    "ScoreRequest",
+    "ScoreResponse",
+    "ScoringEngine",
+    "ScoringService",
+    "UserState",
+    "UserStateCache",
+    "make_window",
+]

@@ -1,0 +1,189 @@
+"""Request micro-batcher: fixed-slot batches under a max-wait deadline.
+
+The serving counterpart of ``SequenceBatcher``'s static-shape rule: XLA wants
+a handful of compiled shapes, live traffic arrives one request at a time, so
+concurrent requests are collected into per-lane queues (a lane = one compiled
+program family, e.g. ``encode:L=16`` or ``hit``) and dispatched as a batch
+when either the lane FILLS its largest compiled bucket or the OLDEST request's
+deadline (``max_wait`` after enqueue) expires — whichever comes first. Partial
+batches are padded up to the nearest bucket by the engine and masked by row
+validity, so a lone request costs one bucket-1 program, not a 512-wide slot.
+
+Single worker thread: every dispatch (and therefore every device call) runs on
+it sequentially — the accelerator is a serial resource anyway, and it keeps
+the jax side single-threaded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+
+class MicroBatcher:
+    """Collects submitted items into per-lane batches; a worker thread calls
+    ``dispatch(lane, items)`` when a lane fills or its oldest item times out.
+
+    :param dispatch: callback run ON THE WORKER THREAD with at most
+        ``capacity(lane)`` items. Exceptions are routed to ``on_error`` (the
+        worker survives).
+    :param capacity: max items per dispatched batch, per lane — the largest
+        compiled batch bucket. Either a mapping or a default int for lanes not
+        listed.
+    :param max_wait: seconds a request may wait for co-riders before a partial
+        batch is dispatched anyway (the latency/fill trade-off knob).
+    :param on_error: ``(lane, items, exception) -> None``; default re-raises
+        into stderr logging via the worker guard in ``dispatch`` wrappers.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[Hashable, List[Any]], None],
+        capacity: Any = 64,
+        max_wait: float = 0.002,
+        on_error: Optional[Callable[[Hashable, List[Any], BaseException], None]] = None,
+    ) -> None:
+        self._dispatch = dispatch
+        self._capacity = capacity if isinstance(capacity, dict) else {}
+        self._default_capacity = (
+            max(self._capacity.values()) if isinstance(capacity, dict) and self._capacity
+            else int(capacity) if not isinstance(capacity, dict) else 64
+        )
+        self.max_wait = float(max_wait)
+        self._on_error = on_error
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._lanes: Dict[Hashable, deque] = {}
+        self._running = False
+        self._worker: Optional[threading.Thread] = None
+        # accounting (under _lock)
+        self.submitted = 0
+        self.dispatched_batches = 0
+        self.dispatched_rows = 0
+        self.deadline_flushes = 0
+        self.full_flushes = 0
+
+    def capacity(self, lane: Hashable) -> int:
+        return int(self._capacity.get(lane, self._default_capacity))
+
+    # -- client side -------------------------------------------------------- #
+    def submit(self, lane: Hashable, item: Any) -> None:
+        """Enqueue ``item`` on ``lane`` (any thread). Raises once stopped."""
+        deadline = time.perf_counter() + self.max_wait
+        with self._wake:
+            if not self._running:
+                msg = "MicroBatcher is not running"
+                raise RuntimeError(msg)
+            self._lanes.setdefault(lane, deque()).append((deadline, item))
+            self.submitted += 1
+            self._wake.notify()
+
+    # -- worker ------------------------------------------------------------- #
+    def _pick(self, now: float) -> Optional[Tuple[Hashable, List[Any], bool]]:
+        """Under the lock: (lane, items, was_full) ready to dispatch, or None.
+
+        Expired deadlines win over full lanes: under sustained load one lane
+        can be full on every worker iteration, and preferring it would let
+        another lane's requests age unboundedly past ``max_wait`` — the
+        contract is fill OR deadline, *whichever comes first*, per lane.
+        """
+        oldest_lane = None
+        oldest_deadline = None
+        full_lane = None
+        for lane, queue in self._lanes.items():
+            if not queue:
+                continue
+            if full_lane is None and len(queue) >= self.capacity(lane):
+                full_lane = lane
+            if oldest_deadline is None or queue[0][0] < oldest_deadline:
+                oldest_deadline = queue[0][0]
+                oldest_lane = lane
+        if oldest_lane is not None and oldest_deadline <= now:
+            was_full = len(self._lanes[oldest_lane]) >= self.capacity(oldest_lane)
+            return oldest_lane, self._drain(oldest_lane), was_full
+        if full_lane is not None:
+            return full_lane, self._drain(full_lane), True
+        return None
+
+    def _drain(self, lane: Hashable) -> List[Any]:
+        queue = self._lanes[lane]
+        items = [queue.popleft()[1] for _ in range(min(len(queue), self.capacity(lane)))]
+        return items
+
+    def _next_deadline(self) -> Optional[float]:
+        deadlines = [q[0][0] for q in self._lanes.values() if q]
+        return min(deadlines) if deadlines else None
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                ready = self._pick(time.perf_counter())
+                if ready is None:
+                    if not self._running and not any(self._lanes.values()):
+                        return
+                    deadline = self._next_deadline()
+                    if self._running:
+                        timeout = (
+                            None if deadline is None
+                            else max(deadline - time.perf_counter(), 0.0)
+                        )
+                        self._wake.wait(timeout=timeout)
+                        continue
+                    # draining after stop(): flush whatever remains, no waiting
+                    for lane, queue in self._lanes.items():
+                        if queue:
+                            ready = lane, self._drain(lane), False
+                            break
+                    if ready is None:
+                        return
+                lane, items, was_full = ready
+                self.dispatched_batches += 1
+                self.dispatched_rows += len(items)
+                if was_full:
+                    self.full_flushes += 1
+                else:
+                    self.deadline_flushes += 1
+            try:
+                self._dispatch(lane, items)
+            except BaseException as exc:  # noqa: BLE001 — worker must survive
+                if self._on_error is not None:
+                    self._on_error(lane, items, exc)
+
+    # -- lifecycle ---------------------------------------------------------- #
+    def start(self) -> "MicroBatcher":
+        with self._wake:
+            if self._running:
+                return self
+            self._running = True
+        self._worker = threading.Thread(target=self._run, name="serve-microbatcher", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop accepting work, flush queued items through ``dispatch``, join."""
+        with self._wake:
+            if not self._running and self._worker is None:
+                return
+            self._running = False
+            self._wake.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=timeout)
+            self._worker = None
+
+    def __enter__(self) -> "MicroBatcher":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "submitted": self.submitted,
+                "dispatched_batches": self.dispatched_batches,
+                "dispatched_rows": self.dispatched_rows,
+                "deadline_flushes": self.deadline_flushes,
+                "full_flushes": self.full_flushes,
+            }

@@ -1,0 +1,198 @@
+"""Scoring engine: the pre-compiled executables behind the service.
+
+Two program families, both AOT-compiled at service construction so serving
+never traces:
+
+* **encode lanes** — one :class:`~replay_tpu.nn.compiled.CompiledInference`
+  per LENGTH bucket (each in ``dynamic_batch_size`` mode, so each length also
+  carries the batch-bucket ladder). A request is routed to the smallest length
+  bucket holding its window; because the positional table is tail-anchored
+  (``nn/agg.py``: shorter inputs take the table's tail) and padded keys are
+  masked to exact zeros in the softmax, a narrow-bucket encode is bitwise
+  identical to the same window right-aligned at full length — tested in
+  ``tests/serve/``.
+* **hidden scorers** — one executable per batch bucket scoring CACHED
+  last-position hidden states against the catalog (or the compiled slate):
+  the pure-cache-hit lane, which skips the transformer entirely.
+
+``outputs="hidden"`` (retrieval mode) drops the full-catalog logits from the
+encode programs — candidates come from the MIPS index instead, so the
+``[B, |catalog|]`` matmul never runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from replay_tpu.nn.compiled import CompiledInference
+
+
+def _smallest_covering(sorted_sizes: Sequence[int], n: int) -> int:
+    for size in sorted_sizes:
+        if size >= n:
+            return size
+    msg = f"{n} exceeds the largest compiled size {max(sorted_sizes)}"
+    raise ValueError(msg)
+
+
+class ScoringEngine:
+    """Routes ``[n, L]`` windows / ``[n, E]`` cached states to compiled buckets."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        max_sequence_length: Optional[int] = None,
+        length_buckets: Optional[Sequence[int]] = None,
+        batch_buckets: Sequence[int] = (1, 8, 64),
+        candidates: Optional[np.ndarray] = None,
+        feature_name: str = "item_id",
+        outputs: str = "both",
+    ) -> None:
+        if outputs not in ("both", "hidden"):
+            msg = "ScoringEngine outputs must be 'both' or 'hidden'"
+            raise ValueError(msg)
+        self.max_sequence_length = int(
+            max_sequence_length
+            if max_sequence_length is not None
+            else model.max_sequence_length
+        )
+        lengths = sorted(set(length_buckets or (self.max_sequence_length,)))
+        if lengths[-1] != self.max_sequence_length:
+            msg = (
+                f"length_buckets must top out at max_sequence_length "
+                f"{self.max_sequence_length}, got {lengths}"
+            )
+            raise ValueError(msg)
+        self.length_buckets: Tuple[int, ...] = tuple(lengths)
+        self.batch_buckets: Tuple[int, ...] = tuple(sorted(set(batch_buckets)))
+        self.outputs = outputs
+        self.candidates = (
+            np.asarray(candidates, np.int32) if candidates is not None else None
+        )
+        if self.candidates is not None and outputs == "hidden":
+            msg = "a fixed candidate slate needs scoring outputs; use outputs='both'"
+            raise ValueError(msg)
+        self.embedding_dim = int(model.embedding_dim)
+
+        candidates_count = len(self.candidates) if self.candidates is not None else None
+        self._encoders: Dict[int, CompiledInference] = {
+            length: CompiledInference.compile(
+                model,
+                params,
+                max_sequence_length=length,
+                mode="dynamic_batch_size",
+                dynamic_buckets=self.batch_buckets,
+                candidates_count=candidates_count,
+                feature_name=feature_name,
+                outputs=outputs,
+            )
+            for length in self.length_buckets
+        }
+
+        # hidden scorers (skipped in retrieval mode: cached states go straight
+        # to the MIPS index, no catalog-wide matmul exists to compile)
+        self._hidden_scorers: Dict[int, Any] = {}
+        if outputs == "both":
+            model_cls = type(model)
+
+            def score_hidden(params, hidden, cands):
+                return model.apply(
+                    {"params": params},
+                    hidden,
+                    candidates_to_score=cands,
+                    method=model_cls.get_logits,
+                )
+
+            for size in self.batch_buckets:
+                hidden_spec = jax.ShapeDtypeStruct(
+                    (size, self.embedding_dim), jnp.float32
+                )
+                cand_spec = (
+                    jax.ShapeDtypeStruct((candidates_count,), jnp.int32)
+                    if candidates_count
+                    else None
+                )
+                executable = (
+                    jax.jit(score_hidden)
+                    .lower(params, hidden_spec, cand_spec)
+                    .compile()
+                )
+                self._hidden_scorers[size] = (
+                    lambda hidden, cands, _ex=executable: _ex(params, hidden, cands)
+                )
+
+        # accounting
+        self.encode_calls = 0
+        self.encode_rows = 0
+        self.encode_slots = 0
+        self.hit_calls = 0
+        self.hit_rows = 0
+        self.hit_slots = 0
+
+    # -- routing ------------------------------------------------------------ #
+    def route_length(self, length: int) -> int:
+        """Smallest compiled length bucket holding a ``length``-event window."""
+        return _smallest_covering(self.length_buckets, max(int(length), 1))
+
+    def batch_bucket(self, rows: int) -> int:
+        return _smallest_covering(self.batch_buckets, rows)
+
+    # -- execution (serve-worker thread) ------------------------------------ #
+    def encode(self, length_bucket: int, item_ids: np.ndarray, padding_mask: np.ndarray):
+        """Run the length bucket's executable on ``[n, L_bucket]`` windows.
+
+        Returns ``(logits, hidden)`` in ``"both"`` mode (logits over the
+        catalog or the compiled slate) or ``(None, hidden)`` in retrieval
+        mode; both cut to the real row count, device-resident."""
+        compiled = self._encoders[length_bucket]
+        rows = item_ids.shape[0]
+        self.encode_calls += 1
+        self.encode_rows += rows
+        self.encode_slots += self.batch_bucket(rows)
+        out = compiled(item_ids, padding_mask, candidates=self.candidates)
+        if self.outputs == "both":
+            return out
+        return None, out
+
+    def score_hidden(self, hidden: np.ndarray):
+        """Score cached ``[n, E]`` hidden states (the pure-hit lane), padded
+        up to the nearest batch bucket; device-resident result cut to ``n``."""
+        if not self._hidden_scorers:
+            msg = "retrieval-mode engine has no hidden scorer (use the pipeline)"
+            raise ValueError(msg)
+        hidden = np.asarray(hidden, np.float32)
+        rows = hidden.shape[0]
+        bucket = self.batch_bucket(rows)
+        self.hit_calls += 1
+        self.hit_rows += rows
+        self.hit_slots += bucket
+        if rows < bucket:
+            hidden = np.concatenate(
+                [hidden, np.repeat(hidden[:1], bucket - rows, 0)]
+            )
+        logits = self._hidden_scorers[bucket](hidden, self.candidates)
+        return logits[:rows]
+
+    def record_ranked_batch(self, rows: int, bucket: int) -> None:
+        """Account a retrieval-mode pure-hit batch that bypassed the scorers
+        (cached states go straight to the MIPS pipeline) — without this the
+        fill ratio would only see the minority encode lane."""
+        self.hit_calls += 1
+        self.hit_rows += rows
+        self.hit_slots += bucket
+
+    def stats(self) -> Dict[str, float]:
+        slots = self.encode_slots + self.hit_slots
+        rows = self.encode_rows + self.hit_rows
+        return {
+            "encode_calls": self.encode_calls,
+            "encode_rows": self.encode_rows,
+            "hit_calls": self.hit_calls,
+            "hit_rows": self.hit_rows,
+            "batch_fill_ratio": rows / slots if slots else 0.0,
+        }

@@ -1,0 +1,114 @@
+"""Fused candidate→rank: exact MIPS retrieval + on-device re-rank + top-k.
+
+The production path of ROADMAP item 2: instead of materializing a
+``[B, |catalog|]`` score matrix per micro-batch, the encoder's last-hidden
+states query the (optionally mesh-sharded) exact MIPS index
+(``models/ann.py``) for the top-C candidates, a re-rank program applies the
+two-stage scenario's trained logistic weights (``scenarios/two_stages.py`` —
+the SAME ``LogisticReranker.decision_function`` math, run with ``jnp``), and
+the final top-k cut happens on device. All three stages stay device-resident
+between each other (``MIPSIndex.search_jax`` returns device arrays), so per
+micro-batch the host sees only the final ``[B, k]`` ids/scores.
+
+With the dot-product :class:`~replay_tpu.nn.head.EmbeddingTyingHead` (no
+bias), MIPS scores over the item-embedding table are bitwise-identical gathers
+of the full-catalog logits — retrieval loses nothing, it only skips scoring
+items that cannot reach the top-C (tests pin this).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from replay_tpu.models.ann import MIPSIndex
+
+
+class CandidatePipeline:
+    """MIPS top-C retrieval → logistic re-rank → top-k, fused per micro-batch.
+
+    :param index: the catalog's :class:`MIPSIndex` — for SASRec-style
+        weight-tying models, built over ``model.get_item_weights()`` so
+        retrieval scores ARE the model's logits.
+    :param num_candidates: C, the retrieval cut feeding the re-ranker.
+    :param top_k: k, the response cut (``k <= C``).
+    :param reranker_weights: optional ``[2]`` array (retrieval-score weight,
+        bias — ``LogisticReranker.serving_weights`` trained on one score
+        feature). ``None`` ranks by raw retrieval score.
+    """
+
+    def __init__(
+        self,
+        index: MIPSIndex,
+        num_candidates: int = 100,
+        top_k: int = 10,
+        reranker_weights: Optional[np.ndarray] = None,
+    ) -> None:
+        if num_candidates > index.num_items:
+            msg = (
+                f"num_candidates={num_candidates} exceeds the catalog "
+                f"({index.num_items} items)"
+            )
+            raise ValueError(msg)
+        if top_k > num_candidates:
+            msg = f"top_k={top_k} exceeds num_candidates={num_candidates}"
+            raise ValueError(msg)
+        self.index = index
+        self.num_candidates = int(num_candidates)
+        self.top_k = int(top_k)
+        self.reranker_weights = (
+            np.asarray(reranker_weights, np.float32)
+            if reranker_weights is not None
+            else None
+        )
+        if self.reranker_weights is not None and self.reranker_weights.shape != (2,):
+            msg = (
+                "serve re-rank uses ONE feature (the retrieval score): "
+                f"weights must be [2] (weight, bias), got {self.reranker_weights.shape}"
+            )
+            raise ValueError(msg)
+        self._rerank = self._build_rerank()
+
+    def _build_rerank(self):
+        weights = (
+            jnp.asarray(self.reranker_weights)
+            if self.reranker_weights is not None
+            else None
+        )
+
+        @partial(jax.jit, static_argnums=())
+        def rerank(values: jnp.ndarray, ids: jnp.ndarray):
+            # LogisticReranker.decision_function with jnp: f @ w[:-1] + w[-1]
+            # over the single retrieval-score feature; sigmoid is monotone but
+            # applied anyway so response scores equal host predict_proba
+            if weights is None:
+                ranking = values
+            else:
+                ranking = jax.nn.sigmoid(values * weights[0] + weights[1])
+            top_scores, positions = jax.lax.top_k(ranking, self.top_k)
+            return top_scores, jnp.take_along_axis(ids, positions, axis=1)
+
+        return rerank
+
+    def rank(self, hidden, tracer=None) -> Tuple[np.ndarray, np.ndarray]:
+        """``[B, E]`` query states → (scores ``[B, k]``, item ids ``[B, k]``).
+
+        The two device stages are traced as ``retrieve`` / ``rerank`` spans
+        when a tracer is supplied."""
+        import contextlib
+
+        span = tracer.span if tracer is not None else (lambda *_a, **_k: contextlib.nullcontext())
+        with span("retrieve", rows=int(np.shape(hidden)[0]), k=self.num_candidates):
+            values, ids = self.index.search_jax(hidden, self.num_candidates)
+        with span("rerank", rows=int(np.shape(hidden)[0]), k=self.top_k):
+            scores, items = self._rerank(values, ids)
+            scores = np.asarray(scores)
+            items = np.asarray(items)
+        return scores, items
+
+    def stats(self) -> Dict[str, int]:
+        return {"num_candidates": self.num_candidates, "top_k": self.top_k}

@@ -1,0 +1,101 @@
+"""Request/response types of the scoring service.
+
+The online analog of the reference's predict call: one request is ONE user's
+"score my next item" query. Requests carry either a full interaction history
+(cold start / exact-parity fallback) or just the incremental tail (``new_items``)
+for users whose encoded state the service already caches — or nothing beyond
+the user id, when a cached state should be scored as-is (the pure cache hit).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Hashable, Optional, Sequence, Tuple
+
+import numpy as np
+
+# how a response was produced, in decreasing order of cache leverage
+SERVED_FROM = ("hit", "advance", "cold")
+
+
+@dataclass
+class ScoreRequest:
+    """One user's scoring query.
+
+    :param user_id: cache key (any hashable).
+    :param history: full item-id history, oldest → newest. Required for users
+        the service has no cached state for; when given alongside a cached
+        state it WINS and refreshes the cache (the exact-parity fallback).
+    :param new_items: incremental interactions to append to the cached window
+        (the one-step update path for returning users).
+    :param k: top-k cut of the response. ``None`` returns full-catalog scores
+        (or the compiled slate's scores); retrieval-mode services default to
+        their pipeline's ``top_k``.
+    :param candidates: per-request candidate item ids, scored by exact gather
+        from the full-catalog scores (full mode only).
+    """
+
+    user_id: Hashable
+    history: Optional[Sequence[int]] = None
+    new_items: Sequence[int] = ()
+    k: Optional[int] = None
+    candidates: Optional[Sequence[int]] = None
+
+
+@dataclass
+class ScoreResponse:
+    """Scores for one request.
+
+    ``item_ids`` is populated for ranked responses (retrieval mode and top-k
+    cuts); for full-catalog scores it is ``None`` and ``scores[i]`` is item
+    ``i``'s score.
+    """
+
+    user_id: Hashable
+    scores: np.ndarray
+    item_ids: Optional[np.ndarray]
+    served_from: str  # one of SERVED_FROM
+    lane: str
+    queue_wait_s: float
+    # the compiled batch bucket this response's micro-batch ran at. Scores are
+    # bitwise independent of fill level / co-riders / row order WITHIN a
+    # bucket program, so (lane, batch_bucket) pins the exact program whose
+    # direct forward_inference output this response reproduces bit-for-bit.
+    batch_bucket: int = 0
+
+
+@dataclass
+class PendingRequest:
+    """Internal: a submitted request riding the micro-batcher queue.
+
+    The window/mask/length snapshot is resolved on the CLIENT thread at submit
+    time (cheap numpy bookkeeping) so the serve worker only stacks rows and
+    runs device programs; ``enqueued_at`` is tracer-epoch-relative
+    (``Tracer.now()``) for the cross-thread ``queue_wait`` span.
+    """
+
+    request: ScoreRequest
+    future: "Future[ScoreResponse]"
+    served_from: str
+    window: Optional[np.ndarray] = None  # [L_max] int32, right-aligned
+    mask: Optional[np.ndarray] = None  # [L_max] bool
+    length: int = 0
+    embedding: Optional[np.ndarray] = None  # [E] — pure-hit lane only
+    enqueued_at: float = 0.0
+    extra: Tuple[Any, ...] = field(default=())
+
+
+def make_window(
+    items: Sequence[int], max_sequence_length: int, pad_id: int = 0
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Right-align ``items`` into a ``[L]`` window (the canonical serving
+    layout, matching ``SequenceBatcher``'s left padding): returns
+    ``(window, mask, length)`` keeping only the most recent ``L`` events."""
+    length = min(len(items), max_sequence_length)
+    window = np.full(max_sequence_length, pad_id, np.int32)
+    mask = np.zeros(max_sequence_length, bool)
+    if length:
+        window[max_sequence_length - length :] = np.asarray(items, np.int32)[-length:]
+        mask[max_sequence_length - length :] = True
+    return window, mask, length

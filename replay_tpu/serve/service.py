@@ -1,0 +1,448 @@
+"""The on-device scoring service: micro-batched, state-cached, rank-fused.
+
+Orchestrates the serve subsystem end-to-end::
+
+    client threads ──submit()──▶ MicroBatcher lanes ──▶ serve worker
+                                                        ├─ encode lane: window
+                                                        │  batch → CompiledInference
+                                                        │  bucket executable
+                                                        ├─ hit lane: cached [E]
+                                                        │  states → hidden scorer
+                                                        └─ retrieval: MIPS top-C
+                                                           → re-rank → top-k
+
+Three serving modes, fixed at construction (one compiled program family each):
+
+* **full** (default): responses carry full-catalog scores, an exact host
+  top-k cut, or exact gathers for per-request candidate lists.
+* **slate** (``candidates=...``): every response scores one fixed candidate
+  slate compiled into the executables (the reference's ``candidates_to_score``
+  serving shape).
+* **retrieval** (``retrieval=CandidatePipeline(...)``): the fused
+  candidate→rank path — full-catalog logits never materialize.
+
+Parity contract (tested in ``tests/serve/``): response scores are BITWISE
+identical to a direct AOT ``forward_inference`` call on the same right-aligned
+window at the routed (length, batch) bucket — and within a bucket program they
+are bitwise independent of the fill level, the co-riding requests' content,
+and the row order, so micro-batching and caching never change a score. (The
+bucket qualifier is XLA reality: the same math compiled at two different batch
+shapes may differ in the last float ulp; every response carries its
+``batch_bucket`` so the exact program is always reconstructible.)
+
+Observability: requests record ``queue_wait`` spans (cross-thread, via
+``obs.trace.lifecycle_span``), batches record ``batch_build``/``score`` and
+the pipeline's ``retrieve``/``rerank`` spans; ``on_serve_start`` /
+``on_serve_batch`` / ``on_serve_end`` events flow through any
+:class:`~replay_tpu.obs.RunLogger`, and ``on_serve_end`` carries the serve
+goodput breakdown (``SERVE_GOODPUT_SPANS`` fractions, summing to 1.0).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from replay_tpu.obs import TrainerEvent, Tracer
+from replay_tpu.obs.trace import SERVE_GOODPUT_SPANS, goodput_breakdown, lifecycle_span
+
+from .batcher import MicroBatcher
+from .cache import UserState, UserStateCache
+from .engine import ScoringEngine
+from .pipeline import CandidatePipeline
+from .request import PendingRequest, ScoreRequest, ScoreResponse, make_window
+
+
+class ScoringService:
+    """Thread-safe online scoring over a trained sequential model."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        length_buckets: Optional[Sequence[int]] = None,
+        batch_buckets: Sequence[int] = (1, 8, 64),
+        max_wait_ms: float = 2.0,
+        cache_capacity: int = 10_000,
+        candidates: Optional[Sequence[int]] = None,
+        retrieval: Optional[CandidatePipeline] = None,
+        feature_name: str = "item_id",
+        pad_id: int = 0,
+        tracer: Optional[Tracer] = None,
+        logger=None,
+        trace_path: Optional[str] = None,
+    ) -> None:
+        if retrieval is not None and candidates is not None:
+            msg = "retrieval mode and a fixed candidate slate are mutually exclusive"
+            raise ValueError(msg)
+        self.mode = (
+            "retrieval" if retrieval is not None
+            else "slate" if candidates is not None
+            else "full"
+        )
+        self.retrieval = retrieval
+        self.pad_id = int(pad_id)
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.logger = logger
+        self.trace_path = trace_path
+        self.engine = ScoringEngine(
+            model,
+            params,
+            length_buckets=length_buckets,
+            batch_buckets=batch_buckets,
+            candidates=np.asarray(candidates, np.int32) if candidates is not None else None,
+            feature_name=feature_name,
+            outputs="hidden" if retrieval is not None else "both",
+        )
+        self.cache = UserStateCache(cache_capacity)
+        self.batcher = MicroBatcher(
+            dispatch=self._dispatch,
+            capacity=max(self.engine.batch_buckets),
+            max_wait=max_wait_ms / 1000.0,
+            on_error=self._on_dispatch_error,
+        )
+        self._count_lock = threading.Lock()
+        self._requests = 0
+        self._errors = 0
+        self._served_from: Dict[str, int] = {"hit": 0, "advance": 0, "cold": 0}
+        self._queue_wait_sum = 0.0
+        self._queue_wait_max = 0.0
+        self._goodput_t0: Dict[str, float] = {}
+        self._wall_t0 = 0.0
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------- #
+    def start(self) -> "ScoringService":
+        if self._started:
+            return self
+        self._started = True
+        self._goodput_t0 = self.tracer.snapshot()
+        self._wall_t0 = self.tracer.wall_seconds()
+        self.batcher.start()
+        self._emit(
+            "on_serve_start",
+            {
+                "mode": self.mode,
+                "length_buckets": list(self.engine.length_buckets),
+                "batch_buckets": list(self.engine.batch_buckets),
+                "max_wait_ms": self.batcher.max_wait * 1000.0,
+                "cache_capacity": self.cache.capacity,
+            },
+        )
+        return self
+
+    def close(self) -> None:
+        if not self._started:
+            return
+        self.batcher.stop()
+        self._started = False
+        payload = dict(self.stats())
+        snapshot = self.tracer.snapshot()
+        diff = {
+            name: snapshot.get(name, 0.0) - self._goodput_t0.get(name, 0.0)
+            for name in set(snapshot) | set(self._goodput_t0)
+        }
+        payload["goodput"] = goodput_breakdown(
+            diff,
+            self.tracer.wall_seconds() - self._wall_t0,
+            spans=SERVE_GOODPUT_SPANS,
+        )
+        self._emit("on_serve_end", payload)
+        if self.trace_path and self.tracer.enabled:
+            self.tracer.save(self.trace_path)
+
+    def __enter__(self) -> "ScoringService":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    # -- client API --------------------------------------------------------- #
+    def submit(
+        self,
+        user_id: Hashable,
+        history: Optional[Sequence[int]] = None,
+        new_items: Sequence[int] = (),
+        k: Optional[int] = None,
+        candidates: Optional[Sequence[int]] = None,
+    ) -> "Future[ScoreResponse]":
+        """Enqueue one scoring request; resolves to a :class:`ScoreResponse`."""
+        future: "Future[ScoreResponse]" = Future()
+        request = ScoreRequest(
+            user_id=user_id,
+            history=history,
+            new_items=tuple(new_items),
+            k=k,
+            candidates=candidates,
+        )
+        with self._count_lock:
+            self._requests += 1
+        try:
+            lane, pending = self._resolve(request, future)
+            self.batcher.submit(lane, pending)
+        except Exception as exc:  # noqa: BLE001 — surface through the future
+            with self._count_lock:
+                self._errors += 1
+            future.set_exception(exc)
+        return future
+
+    def score(self, user_id, timeout: Optional[float] = 60.0, **kwargs) -> ScoreResponse:
+        """Synchronous :meth:`submit`."""
+        return self.submit(user_id, **kwargs).result(timeout=timeout)
+
+    # -- request resolution (client thread) --------------------------------- #
+    def _resolve(
+        self, request: ScoreRequest, future: "Future[ScoreResponse]"
+    ) -> Tuple[Hashable, PendingRequest]:
+        if request.candidates is not None and self.mode != "full":
+            msg = (
+                f"per-request candidates need the full-scoring service "
+                f"(this one runs in {self.mode!r} mode)"
+            )
+            raise ValueError(msg)
+        if request.k is not None and self.retrieval is not None:
+            if request.k > self.retrieval.top_k:
+                msg = (
+                    f"k={request.k} exceeds the pipeline's compiled "
+                    f"top_k={self.retrieval.top_k}"
+                )
+                raise ValueError(msg)
+        max_len = self.engine.max_sequence_length
+
+        if request.history is not None:
+            # the exact-parity fallback: an explicit history always wins and
+            # re-anchors the cached state
+            items = list(request.history) + list(request.new_items)
+            if not items:
+                msg = "empty history"
+                raise ValueError(msg)
+            window, mask, length = make_window(items, max_len, self.pad_id)
+            previous = self.cache.peek(request.user_id)
+            state = UserState(
+                window=window,
+                mask=mask,
+                length=length,
+                embedding=None,
+                generation=previous.generation + 1 if previous else 0,
+            )
+            self.cache.store(request.user_id, state)
+            return self._encode_pending(request, future, state, "cold")
+
+        if request.new_items:
+            # atomic lookup+advance+store: concurrent appends for one user
+            # must both land (an unlocked read-modify-write would let the
+            # last store erase the other's interaction)
+            advanced = self.cache.advance_user(
+                request.user_id, request.new_items, self.pad_id
+            )
+            if advanced is None:
+                msg = (
+                    f"user {request.user_id!r} has no cached state; "
+                    "provide history= for the cold path"
+                )
+                raise KeyError(msg)
+            return self._encode_pending(request, future, advanced, "advance")
+        state = self.cache.lookup(request.user_id)
+        if state is None:
+            msg = (
+                f"user {request.user_id!r} has no cached state; "
+                "provide history= for the cold path"
+            )
+            raise KeyError(msg)
+        if state.embedding is not None:
+            pending = PendingRequest(
+                request=request,
+                future=future,
+                served_from="hit",
+                embedding=state.embedding,
+                length=state.length,
+                enqueued_at=self.tracer.now(),
+            )
+            return "hit", pending
+        # cached window whose embedding is still in flight (or was raced
+        # away): re-encode the cached window — still no history re-send
+        return self._encode_pending(request, future, state, "advance")
+
+    def _encode_pending(
+        self,
+        request: ScoreRequest,
+        future: "Future[ScoreResponse]",
+        state: UserState,
+        served_from: str,
+    ) -> Tuple[Hashable, PendingRequest]:
+        length_bucket = self.engine.route_length(state.length)
+        pending = PendingRequest(
+            request=request,
+            future=future,
+            served_from=served_from,
+            window=state.window,
+            mask=state.mask,
+            length=state.length,
+            enqueued_at=self.tracer.now(),
+            extra=(state,),
+        )
+        return ("encode", length_bucket), pending
+
+    # -- dispatch (serve-worker thread) ------------------------------------- #
+    def _on_dispatch_error(self, lane, items: List[PendingRequest], exc: BaseException) -> None:
+        with self._count_lock:
+            self._errors += len(items)
+        for item in items:
+            if not item.future.done():
+                item.future.set_exception(exc)
+
+    def _lane_name(self, lane) -> str:
+        return "hit" if lane == "hit" else f"encode:L={lane[1]}"
+
+    def _dispatch(self, lane, items: List[PendingRequest]) -> None:
+        waits = [
+            lifecycle_span(self.tracer, "queue_wait", item.enqueued_at, lane=self._lane_name(lane))
+            for item in items
+        ]
+        rows = len(items)
+        bucket = self.engine.batch_bucket(rows)
+        if lane == "hit":
+            with self.tracer.span("batch_build", rows=rows):
+                hidden = np.stack([item.embedding for item in items]).astype(np.float32)
+            if self.retrieval is not None:
+                self.engine.record_ranked_batch(rows, bucket)
+                scores, ids = self._rank(hidden, rows, bucket)
+                logits = None
+            else:
+                with self.tracer.span("score", rows=rows, lane="hit"):
+                    logits = np.asarray(self.engine.score_hidden(hidden))
+                scores = ids = None
+        else:
+            _, length_bucket = lane
+            with self.tracer.span("batch_build", rows=rows):
+                ids_batch = np.stack([item.window[-length_bucket:] for item in items])
+                mask_batch = np.stack([item.mask[-length_bucket:] for item in items])
+            with self.tracer.span("score", rows=rows, lane=self._lane_name(lane)):
+                logits_dev, hidden_dev = self.engine.encode(length_bucket, ids_batch, mask_batch)
+                hidden_np = np.asarray(hidden_dev)
+                logits = np.asarray(logits_dev) if logits_dev is not None else None
+            for item, embedding in zip(items, hidden_np):
+                state = item.extra[0]
+                self.cache.refresh_embedding(item.request.user_id, state, embedding)
+            if self.retrieval is not None:
+                scores, ids = self._rank(hidden_np, rows, bucket)
+            else:
+                scores = ids = None
+
+        for row, (item, wait) in enumerate(zip(items, waits)):
+            try:
+                response = self._build_response(
+                    item,
+                    lane_name=self._lane_name(lane),
+                    batch_bucket=bucket,
+                    queue_wait=wait,
+                    logits_row=logits[row] if logits is not None else None,
+                    ranked_scores=scores[row] if scores is not None else None,
+                    ranked_ids=ids[row] if ids is not None else None,
+                )
+            except Exception as exc:  # noqa: BLE001
+                with self._count_lock:
+                    self._errors += 1
+                item.future.set_exception(exc)
+                continue
+            with self._count_lock:
+                self._served_from[item.served_from] += 1
+                self._queue_wait_sum += wait
+                self._queue_wait_max = max(self._queue_wait_max, wait)
+            item.future.set_result(response)
+
+        self._emit(
+            "on_serve_batch",
+            {
+                "lane": self._lane_name(lane),
+                "rows": rows,
+                "bucket": bucket,
+                "fill": rows / bucket if bucket else 0.0,
+                "queue_wait_ms_max": max(waits) * 1000.0 if waits else 0.0,
+            },
+        )
+
+    def _rank(self, hidden: np.ndarray, rows: int, bucket: int):
+        """Run the fused retrieve→rerank path at the padded batch bucket —
+        the pipeline's jitted programs then only ever see the bucket ladder's
+        shapes (no per-fill retrace)."""
+        if rows < bucket:
+            hidden = np.concatenate([hidden, np.repeat(hidden[:1], bucket - rows, 0)])
+        scores, ids = self.retrieval.rank(hidden, tracer=self.tracer)
+        return scores[:rows], ids[:rows]
+
+    def _build_response(
+        self,
+        item: PendingRequest,
+        lane_name: str,
+        batch_bucket: int,
+        queue_wait: float,
+        logits_row: Optional[np.ndarray],
+        ranked_scores: Optional[np.ndarray],
+        ranked_ids: Optional[np.ndarray],
+    ) -> ScoreResponse:
+        request = item.request
+        if self.retrieval is not None:
+            k = request.k if request.k is not None else self.retrieval.top_k
+            scores, item_ids = ranked_scores[:k], ranked_ids[:k]
+        elif self.mode == "slate":
+            scores, item_ids = logits_row, np.asarray(self.engine.candidates)
+            if request.k is not None:
+                order = np.argsort(-scores, kind="stable")[: request.k]
+                scores, item_ids = scores[order], item_ids[order]
+        else:
+            if request.candidates is not None:
+                gathered = np.asarray(request.candidates, np.int64)
+                scores, item_ids = logits_row[gathered], gathered
+            elif request.k is not None:
+                order = np.argsort(-logits_row, kind="stable")[: request.k]
+                scores, item_ids = logits_row[order], order
+            else:
+                scores, item_ids = logits_row, None
+        return ScoreResponse(
+            user_id=request.user_id,
+            scores=np.asarray(scores),
+            item_ids=np.asarray(item_ids) if item_ids is not None else None,
+            served_from=item.served_from,
+            lane=lane_name,
+            queue_wait_s=queue_wait,
+            batch_bucket=batch_bucket,
+        )
+
+    # -- accounting --------------------------------------------------------- #
+    def _emit(self, event: str, payload: Dict[str, Any]) -> None:
+        if self.logger is not None:
+            self.logger.log_event(TrainerEvent(event=event, payload=payload))
+
+    def stats(self) -> Dict[str, Any]:
+        engine = self.engine.stats()
+        cache = self.cache.stats()
+        batcher = self.batcher.stats()
+        with self._count_lock:
+            served = dict(self._served_from)
+            requests = self._requests
+            errors = self._errors
+            wait_sum = self._queue_wait_sum
+            wait_max = self._queue_wait_max
+        answered = sum(served.values())
+        reused = served["hit"] + served["advance"]
+        return {
+            "mode": self.mode,
+            "requests": requests,
+            "answered": answered,
+            "errors": errors,
+            "served_from": served,
+            # state reuse: requests served from cached state (pure hits +
+            # one-step advances) over answered requests
+            "cache_hit_rate": reused / answered if answered else 0.0,
+            "pure_hit_rate": served["hit"] / answered if answered else 0.0,
+            "batch_fill_ratio": engine["batch_fill_ratio"],
+            "queue_wait_ms_mean": wait_sum / answered * 1000.0 if answered else 0.0,
+            "queue_wait_ms_max": wait_max * 1000.0,
+            "engine": engine,
+            "cache": cache,
+            "batcher": batcher,
+        }

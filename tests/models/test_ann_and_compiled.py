@@ -42,6 +42,42 @@ class TestMIPSIndex:
         with pytest.raises(ValueError, match="exceeds"):
             MIPSIndex(np.ones((4, 2), np.float32)).search(np.ones((1, 2), np.float32), k=9)
 
+    def test_shard_padding_rows_never_reach_topk(self):
+        """Regression: a catalog that does not divide the mesh axis pads some
+        shards with zero rows — those rows must never surface in top-k, even
+        when k exceeds a shard's UNPADDED row count (here shards 5-7 hold zero
+        real rows and every shard holds at most 2)."""
+        rng = np.random.default_rng(7)
+        num_items = 9  # 8-device mesh -> padded to 16, shard_size 2
+        # strictly negative vectors: any padded zero-row would WIN on score
+        # (dot products with a negative query come out positive), so a
+        # padding leak is guaranteed visible, not just possible
+        items = -np.abs(rng.normal(size=(num_items, 6))).astype(np.float32) - 0.1
+        queries = np.abs(rng.normal(size=(4, 6))).astype(np.float32) + 0.1
+        index = MIPSIndex(items, mesh=make_mesh())
+        for k in (1, 3, num_items):  # k=9 > every shard's 0-2 real rows
+            scores, idx = index.search(queries, k=k)
+            assert idx.max() < num_items, f"padded row leaked into top-{k}"
+            brute = queries @ items.T
+            want_idx = np.argsort(-brute, axis=1, kind="stable")[:, :k]
+            np.testing.assert_array_equal(np.sort(idx, 1), np.sort(want_idx, 1))
+            np.testing.assert_allclose(
+                np.sort(scores, 1),
+                np.sort(np.take_along_axis(brute, want_idx, 1), 1),
+                rtol=1e-5,
+            )
+
+    def test_search_jax_returns_device_arrays_equal_to_search(self):
+        rng = np.random.default_rng(2)
+        items = rng.normal(size=(12, 4)).astype(np.float32)
+        queries = rng.normal(size=(3, 4)).astype(np.float32)
+        index = MIPSIndex(items)
+        dev_scores, dev_idx = index.search_jax(jnp.asarray(queries), k=4)
+        assert isinstance(dev_scores, jax.Array) and isinstance(dev_idx, jax.Array)
+        host_scores, host_idx = index.search(queries, k=4)
+        np.testing.assert_array_equal(np.asarray(dev_idx), host_idx)
+        np.testing.assert_array_equal(np.asarray(dev_scores), host_scores)
+
 
 def test_als_ann_predict_matches_exact():
     rng = np.random.default_rng(0)
@@ -127,6 +163,108 @@ class TestCompiledInference:
         want = model.apply({"params": params}, {"item_id": ids}, mask,
                            method=SasRec.forward_inference)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6)
+
+class TestCompiledInferenceSerialization:
+    def test_serialize_roundtrip_identical_scores_every_bucket(self, sasrec_with_params):
+        """StableHLO bytes → fresh CompiledInference → identical scores (the
+        serving-process handoff: no model code, no params pytree needed)."""
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(
+            model, params, SEQ_LEN, mode="dynamic_batch_size", dynamic_buckets=(1, 4)
+        )
+        payload = compiled.serialize()
+        assert isinstance(payload, bytes)
+        served = CompiledInference.deserialize(payload)
+        assert served.buckets == compiled.buckets
+        assert served.mode == compiled.mode
+        assert served.max_sequence_length == SEQ_LEN
+        rng = np.random.default_rng(5)
+        for batch in (1, 2, 4):
+            ids = rng.integers(0, NUM_ITEMS, (batch, SEQ_LEN)).astype(np.int32)
+            mask = np.ones((batch, SEQ_LEN), bool)
+            np.testing.assert_array_equal(
+                np.asarray(served(ids, mask)), np.asarray(compiled(ids, mask))
+            )
+
+    def test_serialize_roundtrip_with_candidates_and_reserialize(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(
+            model, params, SEQ_LEN, batch_size=2, candidates_count=4
+        )
+        served = CompiledInference.deserialize(compiled.serialize())
+        ids = np.zeros((2, SEQ_LEN), np.int32)
+        mask = np.ones((2, SEQ_LEN), bool)
+        cands = np.asarray([1, 3, 5, 7], np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(served(ids, mask, candidates=cands)),
+            np.asarray(compiled(ids, mask, candidates=cands)),
+        )
+        # a deserialized instance can re-serialize (it keeps the raw blobs)
+        twice = CompiledInference.deserialize(served.serialize())
+        np.testing.assert_array_equal(
+            np.asarray(twice(ids, mask, candidates=cands)),
+            np.asarray(compiled(ids, mask, candidates=cands)),
+        )
+        # the padding/validation path survives the round trip too
+        with pytest.raises(ValueError, match="candidates shape"):
+            served(ids, mask, candidates=[1, 2])
+
+    def test_serialize_both_outputs_mode(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(
+            model, params, SEQ_LEN, batch_size=2, outputs="both"
+        )
+        served = CompiledInference.deserialize(compiled.serialize())
+        ids = np.zeros((2, SEQ_LEN), np.int32)
+        mask = np.ones((2, SEQ_LEN), bool)
+        logits_a, hidden_a = compiled(ids, mask)
+        logits_b, hidden_b = served(ids, mask)
+        np.testing.assert_array_equal(np.asarray(logits_a), np.asarray(logits_b))
+        np.testing.assert_array_equal(np.asarray(hidden_a), np.asarray(hidden_b))
+
+    def test_bad_payload_rejected(self):
+        with pytest.raises(ValueError, match="bad magic"):
+            CompiledInference.deserialize(b"not a payload")
+
+    def test_routing_only_instance_cannot_serialize(self):
+        chooser = CompiledInference(dict.fromkeys((1, 4)), SEQ_LEN, "dynamic_batch_size")
+        with pytest.raises(ValueError, match="no executables"):
+            chooser.serialize()
+
+
+class TestBucketsIntrospection:
+    def test_buckets_property_exposes_compiled_sizes(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(
+            model, params, SEQ_LEN, mode="dynamic_batch_size", dynamic_buckets=(8, 1, 4)
+        )
+        assert compiled.buckets == (1, 4, 8)  # ascending, whatever the input order
+        single = CompiledInference.compile(model, params, SEQ_LEN, batch_size=3)
+        assert single.buckets == (3,)
+
+    def test_outputs_mode_validation(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        with pytest.raises(ValueError, match="outputs"):
+            CompiledInference.compile(model, params, SEQ_LEN, outputs="everything")
+        with pytest.raises(ValueError, match="hidden"):
+            CompiledInference.compile(
+                model, params, SEQ_LEN, outputs="hidden", candidates_count=3
+            )
+
+    def test_hidden_outputs_mode_returns_last_state(self, sasrec_with_params):
+        model, params = sasrec_with_params
+        compiled = CompiledInference.compile(
+            model, params, SEQ_LEN, batch_size=2, outputs="hidden"
+        )
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, NUM_ITEMS, (2, SEQ_LEN)).astype(np.int32)
+        mask = np.ones((2, SEQ_LEN), bool)
+        hidden = np.asarray(compiled(ids, mask))
+        assert hidden.shape == (2, 8)
+        want = model.apply({"params": params}, {"item_id": ids}, mask,
+                           method=SasRec.__call__)[:, -1, :]
+        np.testing.assert_allclose(hidden, np.asarray(want), rtol=1e-5, atol=1e-6)
+
 
 class TestCompiledInferenceEdges:
     def test_candidate_scoring_and_validation(self, sasrec_with_params):

@@ -308,3 +308,100 @@ def test_h2d_overlap_surfaced_from_trace(tmp_path, capsys):
     assert main([run]) == 0
     out = capsys.readouterr().out
     assert "h2d:" in out and "overlapped" in out and "input starvation" in out
+
+
+# --------------------------------------------------------------------------- #
+# serving summaries (replay_tpu.serve / bench_serve.py)
+# --------------------------------------------------------------------------- #
+def _write_serve_run(path, qps=250.0, p99_ms=4.5, fill=0.8, hit_rate=0.9,
+                     with_bench_record=True):
+    os.makedirs(path, exist_ok=True)
+    serve_goodput = {
+        "wall_seconds": 2.0,
+        "fractions": {"queue_wait": 0.5, "batch_build": 0.05, "score": 0.2,
+                      "retrieve": 0.1, "rerank": 0.1, "other": 0.05},
+        "input_starvation": None,
+    }
+    events = [
+        {"event": "on_serve_start", "time": 1.0, "mode": "retrieval",
+         "length_buckets": [8], "batch_buckets": [1, 4], "max_wait_ms": 2.0,
+         "cache_capacity": 100},
+        {"event": "on_serve_batch", "time": 1.1, "lane": "encode:L=8", "rows": 3,
+         "bucket": 4, "fill": 0.75, "queue_wait_ms_max": 2.2},
+        {"event": "on_serve_batch", "time": 1.2, "lane": "hit", "rows": 4,
+         "bucket": 4, "fill": 1.0, "queue_wait_ms_max": 1.1},
+        {"event": "on_serve_end", "time": 3.0, "mode": "retrieval", "requests": 7,
+         "answered": 7, "errors": 0, "cache_hit_rate": hit_rate,
+         "pure_hit_rate": 0.5, "batch_fill_ratio": fill,
+         "queue_wait_ms_mean": 1.4, "queue_wait_ms_max": 2.2,
+         "served_from": {"hit": 4, "advance": 1, "cold": 2},
+         "goodput": serve_goodput},
+    ]
+    if with_bench_record:
+        events.append(
+            {"metric": "serve_qps", "value": qps, "unit": "req/s", "qps": qps,
+             "p50_ms": 1.2, "p95_ms": 3.1, "p99_ms": p99_ms,
+             "batch_fill_ratio": fill, "cache_hit_rate": hit_rate,
+             "closed_loop_qps": qps * 1.1, "mode": "retrieval", "backend": "cpu"}
+        )
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+    return path
+
+
+def test_serve_run_summarizes_and_renders(tmp_path, capsys):
+    run = _write_serve_run(str(tmp_path / "serve"))
+    summary = summarize_run(run)
+    assert summary["serve"]["qps"] == 250.0
+    assert summary["serve"]["p99_ms"] == 4.5
+    assert summary["serve"]["requests"] == 7
+    assert summary["serve"]["batches"] == 2
+    assert summary["serve"]["cache_hit_rate"] == 0.9
+    # the serve goodput is picked up by the generic goodput scan
+    assert summary["goodput"]["fractions"]["queue_wait"] == 0.5
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "serving [retrieval]:" in out
+    assert "250.0 qps" in out
+    assert "latency p50/p95/p99" in out
+    assert "batch fill 80%" in out
+    assert "cache hits 90%" in out
+    assert "queue_wait 50.0%" in out  # serve-span fractions render too
+    assert "input starvation" not in out  # meaningless for a serve run
+
+
+def test_serve_events_only_still_renders_section(tmp_path, capsys):
+    run = _write_serve_run(str(tmp_path / "serve"), with_bench_record=False)
+    summary = summarize_run(run)
+    assert summary["kind"] == "serve"
+    assert "qps" not in summary["serve"]  # no bench record in this run
+    assert summary["serve"]["requests"] == 7
+    assert main([run]) == 0
+    assert "serving" in capsys.readouterr().out
+
+
+def test_compare_flags_serve_qps_regression(tmp_path, capsys):
+    baseline = _write_serve_run(str(tmp_path / "base"), qps=250.0)
+    candidate = _write_serve_run(str(tmp_path / "cand"), qps=150.0)
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "serve_qps regressed" in capsys.readouterr().err
+
+
+def test_compare_flags_serve_p99_regression_latency_is_lower_better(tmp_path, capsys):
+    baseline = _write_serve_run(str(tmp_path / "base"), p99_ms=4.0)
+    candidate = _write_serve_run(str(tmp_path / "cand"), p99_ms=9.0)
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "serve_p99_ms regressed" in capsys.readouterr().err
+
+
+def test_compare_serve_improvement_passes(tmp_path):
+    baseline = _write_serve_run(str(tmp_path / "base"), qps=200.0, p99_ms=5.0)
+    candidate = _write_serve_run(str(tmp_path / "cand"), qps=260.0, p99_ms=3.0)
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+def test_compare_serve_within_threshold_passes(tmp_path):
+    baseline = _write_serve_run(str(tmp_path / "base"), qps=250.0, p99_ms=4.0)
+    candidate = _write_serve_run(str(tmp_path / "cand"), qps=240.0, p99_ms=4.3)
+    assert main([candidate, "--compare", baseline]) == 0

@@ -533,3 +533,72 @@ def test_traced_chunked_fit_goodput_sums_and_h2d_overlaps(tmp_path):
     # one compiled scan + one compiled per-step program (the tail)
     assert trainer.compile_tracker.traces["train_scan"] == 1
     assert trainer.compile_tracker.traces["train_step"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# serve spans: cross-thread lifecycle timing + the serve goodput breakdown
+# --------------------------------------------------------------------------- #
+def test_lifecycle_span_records_across_threads():
+    from replay_tpu.obs import lifecycle_span
+
+    tracer = Tracer()
+    started = {}
+
+    def producer():
+        started["at"] = tracer.now()
+
+    producer_thread = threading.Thread(target=producer)
+    producer_thread.start()
+    producer_thread.join()
+    time.sleep(0.02)
+    duration = lifecycle_span(tracer, "queue_wait", started["at"], lane="hit")
+    assert duration >= 0.015
+    (event,) = tracer.to_chrome_trace()["traceEvents"]
+    assert event["name"] == "queue_wait"
+    assert event["args"] == {"lane": "hit"}
+    assert event["dur"] == pytest.approx(duration * 1e6, rel=1e-3)
+    summary = tracer.summary()
+    assert summary["queue_wait"]["count"] == 1
+
+
+def test_lifecycle_span_on_disabled_tracer_is_a_noop():
+    from replay_tpu.obs import lifecycle_span
+
+    tracer = Tracer(enabled=False)
+    duration = lifecycle_span(tracer, "queue_wait", 0.0)
+    assert duration >= 0.0
+    assert tracer.to_chrome_trace()["traceEvents"] == []
+
+
+def test_serve_goodput_fractions_sum_to_one():
+    from replay_tpu.obs import SERVE_GOODPUT_SPANS
+
+    spans = {"queue_wait": 0.6, "batch_build": 0.05, "score": 0.2,
+             "retrieve": 0.04, "rerank": 0.03}
+    breakdown = goodput_breakdown(spans, 1.0, spans=SERVE_GOODPUT_SPANS)
+    fractions = breakdown["fractions"]
+    assert set(fractions) == set(SERVE_GOODPUT_SPANS) | {"other"}
+    assert sum(fractions.values()) == pytest.approx(1.0)
+    assert fractions["queue_wait"] == pytest.approx(0.6)
+    # no stepping pipeline in a serve breakdown -> starvation is None
+    assert breakdown["input_starvation"] is None
+
+
+def test_serve_goodput_renormalizes_overlapping_queue_waits():
+    """queue_wait is inherently concurrent (many requests wait at once): when
+    tracked span time exceeds the wall window the fractions renormalize so
+    the sum-to-1.0 contract survives."""
+    from replay_tpu.obs import SERVE_GOODPUT_SPANS
+
+    spans = {"queue_wait": 5.0, "score": 1.0}  # 6s of spans in a 2s window
+    breakdown = goodput_breakdown(spans, 2.0, spans=SERVE_GOODPUT_SPANS)
+    fractions = breakdown["fractions"]
+    assert sum(fractions.values()) == pytest.approx(1.0)
+    assert fractions["queue_wait"] == pytest.approx(5.0 / 6.0)
+    assert fractions["other"] == pytest.approx(0.0)
+
+
+def test_training_goodput_still_reports_starvation():
+    spans = {"data_wait": 0.2, "train_step": 0.6}
+    breakdown = goodput_breakdown(spans, 1.0)
+    assert breakdown["input_starvation"] == pytest.approx(0.25)

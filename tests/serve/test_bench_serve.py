@@ -1,0 +1,56 @@
+"""bench_serve.py emits one parseable JSON record with finite serving metrics."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.jax
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_bench_serve_one_json_line(tmp_path):
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.pathsep.join(
+            p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+            if p and ".axon_site" not in p
+        ),
+        "JAX_PLATFORMS": "cpu",
+        "REPLAY_TPU_SERVE_FALLBACK": "1",  # skip the backend probe subprocess
+        "REPLAY_TPU_SERVE_SEQ_LEN": "8",
+        "REPLAY_TPU_SERVE_NUM_ITEMS": "30",
+        "REPLAY_TPU_SERVE_EMBEDDING_DIM": "8",
+        "REPLAY_TPU_SERVE_NUM_BLOCKS": "1",
+        "REPLAY_TPU_SERVE_USERS": "12",
+        "REPLAY_TPU_SERVE_CLIENTS": "2",
+        "REPLAY_TPU_SERVE_CLOSED_REQUESTS": "8",
+        "REPLAY_TPU_SERVE_RATE": "200",
+        "REPLAY_TPU_SERVE_SECONDS": "1",
+        "REPLAY_TPU_SERVE_CANDIDATES": "10",
+        "REPLAY_TPU_SERVE_TOPK": "3",
+        "REPLAY_TPU_SERVE_BATCH_BUCKETS": "1,4",
+    }
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench_serve.py")],
+        capture_output=True,
+        timeout=300,
+        env=env,
+        cwd=str(tmp_path),  # run dir artifacts land under the repo, record on stdout
+        check=False,
+    )
+    assert out.returncode == 0, out.stderr.decode()
+    record = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    assert record["metric"] == "serve_qps_cpu_fallback"
+    assert record["unit"] == "req/s"
+    for key in ("qps", "p50_ms", "p95_ms", "p99_ms", "closed_loop_qps"):
+        assert isinstance(record[key], (int, float)) and record[key] > 0, key
+    assert record["p50_ms"] <= record["p95_ms"] <= record["p99_ms"]
+    assert 0.0 < record["batch_fill_ratio"] <= 1.0
+    assert 0.0 <= record["cache_hit_rate"] <= 1.0
+    assert record["request_errors"] == 0
+    assert record["mode"] == "retrieval"
+    assert record["shape_override"]["L"] == 8

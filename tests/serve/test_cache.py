@@ -1,0 +1,141 @@
+"""UserStateCache: LRU semantics, window advances, stale-write guard (host-only)."""
+
+import numpy as np
+
+from replay_tpu.serve import UserState, UserStateCache, make_window
+
+
+def _state(items, L=8):
+    window, mask, length = make_window(items, L)
+    return UserState(window=window, mask=mask, length=length)
+
+
+class TestMakeWindow:
+    def test_right_aligned_with_left_padding(self):
+        window, mask, length = make_window([5, 6, 7], 6)
+        assert length == 3
+        np.testing.assert_array_equal(window, [0, 0, 0, 5, 6, 7])
+        np.testing.assert_array_equal(mask, [False, False, False, True, True, True])
+
+    def test_long_history_keeps_most_recent(self):
+        window, mask, length = make_window(list(range(10)), 4)
+        assert length == 4
+        np.testing.assert_array_equal(window, [6, 7, 8, 9])
+        assert mask.all()
+
+    def test_custom_pad_id(self):
+        window, _, _ = make_window([1], 3, pad_id=-1)
+        np.testing.assert_array_equal(window, [-1, -1, 1])
+
+
+class TestAdvance:
+    def test_append_within_capacity(self):
+        cache = UserStateCache(4)
+        advanced = cache.advance(_state([1, 2, 3]), [9])
+        np.testing.assert_array_equal(advanced.window[-4:], [1, 2, 3, 9])
+        assert advanced.length == 4
+        assert advanced.embedding is None  # certifies the OLD window only
+        assert advanced.generation == 1
+        assert cache.advances == 1
+
+    def test_append_rolls_a_full_window(self):
+        state = _state(list(range(1, 9)))  # exactly L=8 events
+        advanced = UserStateCache(4).advance(state, [99])
+        np.testing.assert_array_equal(advanced.window, [2, 3, 4, 5, 6, 7, 8, 99])
+        assert advanced.length == 8
+
+    def test_multi_item_append(self):
+        advanced = UserStateCache(4).advance(_state([1]), [2, 3])
+        np.testing.assert_array_equal(advanced.window[-3:], [1, 2, 3])
+        assert advanced.length == 3
+
+    def test_advance_user_is_atomic_under_concurrent_appends(self):
+        """Two clients appending for the same user must BOTH land: the
+        lookup→advance→store sequence is one lock acquisition, so no
+        interaction is erased by a concurrent last-write-wins."""
+        import threading
+
+        cache = UserStateCache(8)
+        cache.store("u", _state([0], L=64))
+        items_a = list(range(100, 110))
+        items_b = list(range(200, 210))
+
+        def appender(items):
+            for item in items:
+                assert cache.advance_user("u", [item]) is not None
+
+        threads = [threading.Thread(target=appender, args=(i,)) for i in (items_a, items_b)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        final = cache.peek("u")
+        assert final.length == 21  # the seed + all 20 appends survived
+        window_items = set(final.window[final.mask].tolist())
+        assert set(items_a) <= window_items and set(items_b) <= window_items
+        assert final.generation == 20
+
+    def test_advance_user_unknown_user_returns_none_and_counts_miss(self):
+        cache = UserStateCache(4)
+        assert cache.advance_user("ghost", [1]) is None
+        assert cache.misses == 1 and cache.advances == 0
+
+
+class TestLRU:
+    def test_eviction_order_is_least_recently_used(self):
+        cache = UserStateCache(2)
+        cache.store("a", _state([1]))
+        cache.store("b", _state([2]))
+        assert cache.lookup("a") is not None  # refreshes a's recency
+        cache.store("c", _state([3]))  # evicts b, not a
+        assert cache.peek("b") is None
+        assert cache.peek("a") is not None and cache.peek("c") is not None
+        assert cache.evictions == 1
+
+    def test_hit_and_miss_counters(self):
+        cache = UserStateCache(4)
+        assert cache.lookup("ghost") is None
+        cache.store("u", _state([1]))
+        assert cache.lookup("u") is not None
+        stats = cache.stats()
+        assert stats["hits"] == 1 and stats["misses"] == 1
+        assert stats["hit_rate"] == 0.5
+
+    def test_peek_has_no_side_effects(self):
+        cache = UserStateCache(4)
+        cache.store("u", _state([1]))
+        cache.peek("u")
+        cache.peek("ghost")
+        assert cache.hits == 0 and cache.misses == 0
+
+    def test_store_beyond_capacity_evicts_oldest(self):
+        cache = UserStateCache(3)
+        for i in range(5):
+            cache.store(i, _state([i]))
+        assert len(cache) == 3
+        assert cache.peek(0) is None and cache.peek(1) is None
+        assert cache.peek(4) is not None
+
+
+class TestRefreshEmbedding:
+    def test_refresh_attaches_embedding(self):
+        cache = UserStateCache(4)
+        state = _state([1, 2])
+        cache.store("u", state)
+        cache.refresh_embedding("u", state, np.ones(16, np.float32))
+        assert cache.peek("u").embedding is not None
+
+    def test_stale_refresh_does_not_clobber_newer_generation(self):
+        cache = UserStateCache(4)
+        old = _state([1, 2])
+        cache.store("u", old)
+        newer = cache.advance(old, [3])
+        cache.store("u", newer)
+        # the encode of the OLD window finishes late: must not overwrite
+        cache.refresh_embedding("u", old, np.ones(16, np.float32))
+        current = cache.peek("u")
+        assert current.generation == newer.generation
+        assert current.embedding is None
+        # the newer window's own refresh lands
+        cache.refresh_embedding("u", newer, np.full(16, 2.0, np.float32))
+        assert cache.peek("u").embedding is not None

@@ -1,0 +1,178 @@
+"""MicroBatcher: lane routing, fill-vs-deadline dispatch, shutdown (host-only)."""
+
+import threading
+import time
+
+import pytest
+
+from replay_tpu.serve import MicroBatcher
+
+
+class Collector:
+    """Records every dispatch (lane, items) with a timestamp."""
+
+    def __init__(self, delay: float = 0.0):
+        self.batches = []
+        self.delay = delay
+        self.lock = threading.Lock()
+
+    def __call__(self, lane, items):
+        if self.delay:
+            time.sleep(self.delay)
+        with self.lock:
+            self.batches.append((lane, list(items), time.perf_counter()))
+
+    def rows(self):
+        with self.lock:
+            return [len(items) for _, items, _ in self.batches]
+
+
+def test_full_lane_dispatches_without_waiting_for_deadline():
+    collector = Collector()
+    with MicroBatcher(collector, capacity=4, max_wait=5.0) as batcher:
+        start = time.perf_counter()
+        for i in range(4):
+            batcher.submit("a", i)
+        deadline = time.perf_counter() + 2.0
+        while not collector.batches and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        elapsed = time.perf_counter() - start
+    assert collector.rows() == [4]
+    assert elapsed < 2.0  # nowhere near the 5s max_wait
+    assert collector.batches[0][1] == [0, 1, 2, 3]
+    stats = batcher.stats()
+    assert stats["full_flushes"] == 1 and stats["deadline_flushes"] == 0
+
+
+def test_partial_batch_flushes_at_deadline():
+    collector = Collector()
+    with MicroBatcher(collector, capacity=8, max_wait=0.05) as batcher:
+        batcher.submit("a", "only")
+        time.sleep(0.3)
+    assert collector.rows() == [1]
+    assert batcher.stats()["deadline_flushes"] == 1
+
+
+def test_lanes_do_not_mix():
+    collector = Collector()
+    with MicroBatcher(collector, capacity=4, max_wait=0.02) as batcher:
+        for i in range(3):
+            batcher.submit(("encode", 16), f"short{i}")
+        for i in range(2):
+            batcher.submit(("encode", 50), f"long{i}")
+        time.sleep(0.3)
+    lanes = {lane: items for lane, items, _ in collector.batches}
+    assert set(lanes) == {("encode", 16), ("encode", 50)}
+    assert lanes[("encode", 16)] == ["short0", "short1", "short2"]
+    assert lanes[("encode", 50)] == ["long0", "long1"]
+
+
+def test_oversubmission_splits_into_capacity_chunks():
+    collector = Collector()
+    with MicroBatcher(collector, capacity=4, max_wait=0.02) as batcher:
+        for i in range(10):
+            batcher.submit("a", i)
+        time.sleep(0.4)
+    rows = collector.rows()
+    assert sum(rows) == 10
+    assert max(rows) <= 4
+    # order preserved across chunks
+    flat = [item for _, items, _ in sorted(collector.batches, key=lambda b: b[2]) for item in items]
+    assert flat == list(range(10))
+
+
+def test_expired_deadline_beats_a_continuously_full_lane():
+    """A lane kept full by fresh arrivals must not starve another lane's
+    expired request: the deadline contract is per lane, whichever of
+    fill/deadline comes first. (Preferring any full lane would defer lane b
+    until lane a's traffic pauses — unbounded under sustained load.)"""
+    batcher_box = []
+    refills = [0]
+    order = []
+    lock = threading.Lock()
+
+    def dispatch(lane, items):
+        with lock:
+            order.append(lane)
+        time.sleep(0.02)
+        if lane == "a" and refills[0] < 10:
+            refills[0] += 1
+            # keep lane a full with FRESH deadlines, like live traffic would
+            batcher_box[0].submit("a", f"refill{refills[0]}a")
+            batcher_box[0].submit("a", f"refill{refills[0]}b")
+
+    batcher = MicroBatcher(dispatch, capacity=2, max_wait=0.03)
+    batcher_box.append(batcher)
+    with batcher:
+        batcher.submit("a", 1)
+        batcher.submit("a", 2)  # lane a full, and dispatches keep refilling it
+        batcher.submit("b", "must not starve")
+        deadline = time.perf_counter() + 3.0
+        while time.perf_counter() < deadline:
+            with lock:
+                if "b" in order:
+                    break
+            time.sleep(0.005)
+    with lock:
+        assert "b" in order, f"lane b never dispatched: {order}"
+        # b's 30ms deadline expires ~2 a-dispatches in; it must be served while
+        # lane a is still refilling, not after the 10-refill backlog drains
+        assert order.index("b") <= 5, f"lane b starved behind {order}"
+
+
+def test_stop_flushes_pending_items():
+    collector = Collector()
+    batcher = MicroBatcher(collector, capacity=64, max_wait=60.0).start()
+    for i in range(5):
+        batcher.submit("a", i)
+    batcher.stop()  # deadline is a minute away: stop must not wait for it
+    assert sum(collector.rows()) == 5
+
+
+def test_submit_after_stop_raises():
+    batcher = MicroBatcher(Collector(), capacity=4, max_wait=0.01).start()
+    batcher.stop()
+    with pytest.raises(RuntimeError, match="not running"):
+        batcher.submit("a", 1)
+
+
+def test_dispatch_error_routes_to_on_error_and_worker_survives():
+    errors = []
+    calls = []
+
+    def explode(lane, items):
+        calls.append(list(items))
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+
+    batcher = MicroBatcher(
+        explode,
+        capacity=2,
+        max_wait=0.01,
+        on_error=lambda lane, items, exc: errors.append((list(items), str(exc))),
+    ).start()
+    batcher.submit("a", 1)
+    batcher.submit("a", 2)
+    time.sleep(0.1)
+    batcher.submit("a", 3)  # the worker must still be alive
+    batcher.stop()
+    assert errors == [([1, 2], "boom")]
+    assert [1, 2] in calls and [3] in calls
+
+
+def test_concurrent_submitters_lose_nothing():
+    collector = Collector(delay=0.001)
+    with MicroBatcher(collector, capacity=8, max_wait=0.005) as batcher:
+        def client(base):
+            for i in range(50):
+                batcher.submit("lane", base + i)
+
+        threads = [threading.Thread(target=client, args=(1000 * t,)) for t in range(4)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        time.sleep(0.5)
+    dispatched = [item for _, items, _ in collector.batches for item in items]
+    assert sorted(dispatched) == sorted(1000 * t + i for t in range(4) for i in range(50))
+    assert max(collector.rows()) <= 8
