@@ -25,12 +25,24 @@ produces the full measurement batch the round-4 verdict asked for:
   attention at L=4096, fwd+bwd: the single-chip long-context A/B.
 - ``sasrec_l1024`` / ``sasrec_l1024_tiled`` — the full MODEL at L=1024
   (fused-CE head): default attention vs use_flash='tiled' end-to-end.
+- ``scale_{27k,100k,1m}_{ce,fused,tp,sce,gbce}`` — the catalog-scaling family
+  (docs/performance.md "Breaking the memory wall"): step time vs catalog size
+  at 27,278 / 100,000 / 1,000,000 items for plain CE (the memory wall — the
+  1M row is EXPECTED to OOM and record the error), the fused-logsumexp head,
+  the TP vocab-sharded fused head, SCE and gBCE. Each fused/TP row adds the
+  head's analytic FLOPs (obs.mfu.fused_ce_flops — pallas calls are opaque to
+  the XLA cost model) so the per-variant MFU stays an honest cross-variant
+  number. The memory-wall claim is "near-flat step time 27k → 1M" for the
+  fused/TP/SCE/gBCE heads.
 
 Usage (default env, i.e. the TPU tunnel):
     python bench_suite.py [--rows row1,row2] [--quick] [--out BENCH_SUITE.json]
 
 ``--quick`` shrinks every row to toy shapes on CPU — a script-correctness
-smoke, not a measurement.
+smoke, not a measurement. ``REPLAY_TPU_BENCH_ASSUME_KIND=v5e`` additionally
+computes the MFU arithmetic against that chip's peak on CPU quick runs (CI
+exercises the accounting path; the record carries ``mfu_peak_assumed`` so it
+can never be mistaken for a measurement).
 """
 
 import argparse
@@ -88,15 +100,19 @@ def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=Non
         stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *chunk)
         placed = trainer._put_stacked(stacked)
         jax.block_until_ready(placed)
+        # the raw scan program returns (state, {loss/good/grad_norm: [K]});
+        # time it directly but read the losses out of the metrics pytree
         scan_fn = trainer._train_scan
         t0 = time.perf_counter()
-        state, losses = scan_fn(state, placed)
+        state, chunk_metrics = scan_fn(state, placed)
+        losses = chunk_metrics["loss"]
         jax.block_until_ready(losses)
         chunk_time = time.perf_counter() - t0
         n_chunks = max(2, min(12, int(15.0 / max(chunk_time, 1e-6))))
         t0 = time.perf_counter()
         for _ in range(n_chunks):
-            state, losses = scan_fn(state, placed)
+            state, chunk_metrics = scan_fn(state, placed)
+        losses = chunk_metrics["loss"]
         jax.block_until_ready(losses)
         elapsed = time.perf_counter() - t0
         steps = n_chunks * scan_k
@@ -126,6 +142,15 @@ def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=Non
             utilization = _mfu(tflops, record["device_kind"], device_count=jax.device_count())
             if utilization is not None and record["backend"] != "cpu":
                 record["mfu"] = round(utilization, 4)
+            elif record["backend"] == "cpu" and os.environ.get("REPLAY_TPU_BENCH_ASSUME_KIND"):
+                # CI quick mode: exercise the MFU accounting arithmetic against
+                # an ASSUMED chip peak — mfu_peak_assumed marks the record so a
+                # CPU smoke can never read as a measurement
+                assumed = os.environ["REPLAY_TPU_BENCH_ASSUME_KIND"]
+                utilization = _mfu(tflops, assumed, device_count=jax.device_count())
+                if utilization is not None:
+                    record["mfu"] = round(utilization, 10)
+                    record["mfu_peak_assumed"] = assumed
         return record
     except Exception as exc:  # OOM / compile failure is a result, not a crash
         return {"row": label, "error": f"{type(exc).__name__}: {str(exc)[:400]}",
@@ -145,40 +170,91 @@ def item_schema(num_items, dim):
     )
 
 
-def sasrec_batch(num_items, batch, seq_len, seed=0):
+def sasrec_batch(num_items, batch, seq_len, seed=0, negatives=0):
     rng = np.random.default_rng(seed)
     items = rng.integers(0, num_items, size=(batch, seq_len + 1)).astype(np.int32)
     mask = np.ones((batch, seq_len), dtype=bool)
-    return {
+    record = {
         "feature_tensors": {"item_id": items[:, :-1]},
         "padding_mask": mask,
         "positive_labels": items[:, 1:, None],
         "target_padding_mask": mask[:, :, None],
     }
+    if negatives:  # a shared sampled-negative pool (the BCESampled/GBCE shape)
+        record["negative_labels"] = rng.integers(0, num_items, size=(negatives,)).astype(np.int32)
+    return record
 
 
 # --------------------------------------------------------------------------- #
 # rows
 # --------------------------------------------------------------------------- #
-def run_sasrec(num_items, dim, batch, seq_len, blocks, heads, fused, label, dtype):
-    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
-    from replay_tpu.nn.loss import CE, CEFused
-    from replay_tpu.nn.sequential.sasrec import SasRec
+def _sasrec_loss(loss_kind, num_items, quick):
+    """(loss, model_parallel, negatives, loss_label) for one scaling variant."""
+    from replay_tpu.nn.loss import CE, CEFused, CEFusedTP, GBCE, SCE, SCEParams
 
+    if loss_kind == "ce":
+        return CE(), 1, 0, "CE"
+    if loss_kind == "fused":
+        return CEFused(), 1, 0, "CEFused"
+    if loss_kind == "tp":
+        import jax
+
+        # shard the catalog over as much of the slice as divides it; a single
+        # chip degenerates to n_tp=1 (recorded in the row meta)
+        n = jax.device_count()
+        mp = max(d for d in (8, 4, 2, 1) if n % d == 0 and d <= n)
+        return CEFusedTP(), mp, 0, f"CEFusedTP(n_tp={mp})"
+    if loss_kind == "sce":
+        size = 8 if quick else 256
+        n_buckets = 8 if quick else 128
+        return (
+            SCE(SCEParams(n_buckets=n_buckets, bucket_size_x=size, bucket_size_y=size)),
+            1, 0, f"SCE(nb={n_buckets},bx={size},by={size})",
+        )
+    if loss_kind == "gbce":
+        negatives = 16 if quick else 256
+        return GBCE(catalog_size=num_items, t=0.75), 1, negatives, f"GBCE(t=0.75,k={negatives})"
+    msg = f"unknown loss_kind {loss_kind!r}"
+    raise ValueError(msg)
+
+
+def run_sasrec(num_items, dim, batch, seq_len, blocks, heads, loss_kind, label, dtype,
+               quick=False):
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.obs.mfu import fused_ce_flops
+
+    loss, model_parallel, negatives, loss_label = _sasrec_loss(loss_kind, num_items, quick)
     model = SasRec(
         schema=item_schema(num_items, dim), embedding_dim=dim, num_blocks=blocks,
         num_heads=heads, max_sequence_length=seq_len, dropout_rate=0.0, dtype=dtype,
     )
     trainer = Trainer(
-        model=model, loss=CEFused() if fused else CE(),
-        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3), mesh=make_mesh(),
+        model=model, loss=loss,
+        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
+        mesh=make_mesh(model_parallel=model_parallel),
+        shard_vocab=model_parallel > 1,
     )
-    extra = 6.0 * batch * seq_len * dim * num_items if fused else 0.0
+    # the pallas head is opaque to the XLA cost model: add its analytic FLOPs
+    # back so the fused/TP MFU stays honest next to the plain-CE rows
+    extra = (
+        fused_ce_flops(batch * seq_len, dim, num_items)
+        if loss_kind in ("fused", "tp")
+        else 0.0
+    )
+    meta = {"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
+            "loss": loss_label}
+    if model_parallel > 1:
+        meta["model_parallel"] = model_parallel
+    if loss_kind == "sce":
+        meta["note"] = ("approximate loss (hard-negative buckets): scalability "
+                        "row, not numerically comparable to CE rows")
+    if loss_kind == "gbce":
+        meta["note"] = ("sampled calibrated loss (gBCE): scalability row, not "
+                        "numerically comparable to CE rows")
     return measure(
-        trainer, sasrec_batch(num_items, batch, seq_len), label,
-        extra_flops_per_step=extra,
-        meta={"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
-              "loss": "CEFused" if fused else "CE"},
+        trainer, sasrec_batch(num_items, batch, seq_len, negatives=negatives), label,
+        extra_flops_per_step=extra, meta=meta,
     )
 
 
@@ -441,12 +517,12 @@ def main():
     q = args.quick
     B, L = (8, 8) if q else (512, 50)
     rows = {
-        "sasrec_ref": lambda: run_sasrec(3706 if not q else 50, 64, B, L, 2, 1, False, "sasrec_ref", dtype),
-        "sasrec_ref_fused": lambda: run_sasrec(3706 if not q else 50, 64, B, L, 2, 1, True, "sasrec_ref_fused", dtype),
-        "sasrec_27k": lambda: run_sasrec(27278 if not q else 96, 128 if not q else 16, B, L, 2, 2, False, "sasrec_27k", dtype),
-        "sasrec_27k_fused": lambda: run_sasrec(27278 if not q else 96, 128 if not q else 16, B, L, 2, 2, True, "sasrec_27k_fused", dtype),
-        "sasrec_100k": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, False, "sasrec_100k", dtype),
-        "sasrec_100k_fused": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, True, "sasrec_100k_fused", dtype),
+        "sasrec_ref": lambda: run_sasrec(3706 if not q else 50, 64, B, L, 2, 1, "ce", "sasrec_ref", dtype, q),
+        "sasrec_ref_fused": lambda: run_sasrec(3706 if not q else 50, 64, B, L, 2, 1, "fused", "sasrec_ref_fused", dtype, q),
+        "sasrec_27k": lambda: run_sasrec(27278 if not q else 96, 128 if not q else 16, B, L, 2, 2, "ce", "sasrec_27k", dtype, q),
+        "sasrec_27k_fused": lambda: run_sasrec(27278 if not q else 96, 128 if not q else 16, B, L, 2, 2, "fused", "sasrec_27k_fused", dtype, q),
+        "sasrec_100k": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, "ce", "sasrec_100k", dtype, q),
+        "sasrec_100k_fused": lambda: run_sasrec(100000 if not q else 128, 128 if not q else 16, B, L, 2, 2, "fused", "sasrec_100k_fused", dtype, q),
         "sasrec_100k_sce": lambda: run_sasrec_sce(100000 if not q else 128, 128 if not q else 16, B, L, "sasrec_100k_sce", dtype, q),
         "bert4rec": lambda: run_bert4rec(27278 if not q else 96, 300 if not q else 16, B, 100 if not q else L, 4 if not q else 2, dtype),
         "twotower": lambda: run_twotower(27278 if not q else 96, 64 if not q else 16, B, L, dtype),
@@ -455,6 +531,22 @@ def main():
         "sasrec_l1024": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, False, "sasrec_l1024", dtype, q),
         "sasrec_l1024_tiled": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, True, "sasrec_l1024_tiled", dtype, q),
     }
+    # the catalog-scaling family ("Breaking the memory wall"): one row per
+    # (catalog size, head) — near-flat step time 27k → 1M is the claim for
+    # every head except plain CE, whose 1M row records the OOM that motivates
+    # the rest. d=128 B=512 L=50 held constant so only the catalog moves.
+    scale_sizes = {"27k": 96, "100k": 128, "1m": 192} if q else {
+        "27k": 27278, "100k": 100000, "1m": 1000000,
+    }
+    scale_dim = 16 if q else 128
+    for size_tag, size_items in scale_sizes.items():
+        for kind in ("ce", "fused", "tp", "sce", "gbce"):
+            name = f"scale_{size_tag}_{kind}"
+            rows[name] = (
+                lambda n=size_items, k=kind, lbl=name: run_sasrec(
+                    n, scale_dim, B, L, 2, 2, k, lbl, dtype, q
+                )
+            )
     selected = list(rows) if args.rows == "all" else args.rows.split(",")
     unknown = [name for name in selected if name not in rows]
     if unknown:

@@ -1,6 +1,6 @@
 from .base import LossBase, broadcast_negatives, mask_negative_logits, masked_mean
-from .bce import BCE, BCESampled
-from .ce import CE, CEFused, CESampled, CESampledWeighted, CEWeighted
+from .bce import BCE, BCESampled, GBCE
+from .ce import CE, CEFused, CEFusedTP, CESampled, CESampledWeighted, CEWeighted
 from .login_ce import LogInCE, LogInCESampled
 from .logout_ce import LogOutCE, LogOutCEWeighted
 from .sce import SCE, ScalableCrossEntropyLoss, SCEParams
@@ -16,7 +16,10 @@ __all__ = [
     "BCE",
     "BCESampled",
     "CE",
+    "CEFused",
+    "CEFusedTP",
     "CESampled",
+    "GBCE",
     "CESampledWeighted",
     "CEWeighted",
     "LogInCE",

@@ -60,6 +60,10 @@ class CEFused(CE):
 
     needs_item_embeddings = True
     requires_tying_head = True
+    # the full [B, L, I] logits never exist on this path: health's logits-stats
+    # collector must stream its last-position stats over catalog chunks (or
+    # flag itself skipped) instead of calling get_logits (obs.health)
+    avoid_full_logits = True
 
     def __init__(
         self, tile: int = 256, item_tile: Optional[int] = None, interpret: bool = None
@@ -70,6 +74,56 @@ class CEFused(CE):
         self.interpret = interpret
         self.item_embeddings_callback = None
 
+    def _item_table(self) -> jnp.ndarray:
+        if self.item_embeddings_callback is None:
+            msg = (
+                f"{type(self).__name__} reconstructs logits from the raw item "
+                "table, but no item_embeddings_callback is bound. Train through "
+                "replay_tpu.nn.Trainer, which binds the model's "
+                "get_item_weights() automatically — a model that defines no "
+                "get_item_weights cannot drive this loss at all — or, for "
+                "direct use, set loss.item_embeddings_callback to a zero-arg "
+                "callable returning the [num_items, embed] table."
+            )
+            raise AttributeError(msg)
+        return self.item_embeddings_callback()
+
+    def _check_dtypes(self, hidden: jnp.ndarray, table: jnp.ndarray) -> None:
+        """Reject dtype mismatches the kernel would silently paper over.
+
+        Sanctioned: identical dtypes, and the flax compute-dtype split where
+        one side is the float32 PARAM table (or f32 hidden) and the other a
+        narrower float — the kernel accumulates in f32, exactly what
+        ``get_logits``'s einsum promotion does. Anything else (an integer /
+        quantized table, two different narrow floats) is a bug at the call
+        site, named here instead of surfacing as a wrong-loss training run.
+        """
+        h_dt, t_dt = jnp.dtype(hidden.dtype), jnp.dtype(table.dtype)
+        floats = jnp.issubdtype(h_dt, jnp.floating) and jnp.issubdtype(t_dt, jnp.floating)
+        sanctioned = h_dt == t_dt or (
+            floats and jnp.dtype(jnp.float32) in (h_dt, t_dt)
+        )
+        if not sanctioned:
+            msg = (
+                f"{type(self).__name__}: hidden states are {h_dt} but the item "
+                f"table is {t_dt}. Only matching dtypes (or a float32 side "
+                "paired with a narrower float — the standard flax compute-vs-"
+                "param split, accumulated in f32 inside the kernel) are "
+                "supported; cast the model or the table explicitly."
+            )
+            raise ValueError(msg)
+
+    def _resolve_interpret(self) -> bool:
+        return (
+            jax.default_backend() != "tpu" if self.interpret is None else self.interpret
+        )
+
+    def _lse(self, hidden2d: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+        """``[N]`` catalog logsumexp — the seam :class:`CEFusedTP` overrides."""
+        from replay_tpu.ops.fused_ce import fused_lse
+
+        return fused_lse(hidden2d, table, self.tile, self.item_tile, self._resolve_interpret())
+
     def __call__(
         self,
         model_embeddings,
@@ -79,24 +133,15 @@ class CEFused(CE):
         padding_mask,
         target_padding_mask,
     ) -> jnp.ndarray:
-        from replay_tpu.ops.fused_ce import fused_lse
-
         if positive_labels.shape[-1] != 1:
             msg = "Multi-positive labels are not supported by the CE loss"
             raise NotImplementedError(msg)
-        if self.item_embeddings_callback is None:
-            msg = "CEFused requires the trainer to bind item_embeddings_callback."
-            raise AttributeError(msg)
-        table = self.item_embeddings_callback()  # [I, E]
+        table = self._item_table()  # [I, E]
+        self._check_dtypes(model_embeddings, table)
         num_items = table.shape[0]
-        interpret = (
-            jax.default_backend() != "tpu" if self.interpret is None else self.interpret
-        )
         hidden = model_embeddings.reshape(-1, model_embeddings.shape[-1])
         labels = jnp.clip(positive_labels[..., 0], 0, num_items - 1)
-        lse = fused_lse(hidden, table, self.tile, self.item_tile, interpret).reshape(
-            labels.shape
-        )
+        lse = self._lse(hidden, table).reshape(labels.shape)
         label_logit = jnp.sum(
             model_embeddings.astype(jnp.float32) * table[labels].astype(jnp.float32),
             axis=-1,
@@ -105,6 +150,60 @@ class CEFused(CE):
         weights = self._label_weights(labels, nll.dtype)
         mask = target_padding_mask[..., 0].astype(nll.dtype) * weights
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class CEFusedTP(CEFused):
+    """:class:`CEFused` with the item table sharded over the mesh's TP axis.
+
+    The catalog lives ``[I/n_tp, E]`` per device (the layout
+    ``Trainer(shard_vocab=True)`` already places the embedding params in);
+    each shard runs the tile-wise online logsumexp locally and the shards
+    combine with a two-pass psum-style reduction inside ``shard_map``
+    (:func:`replay_tpu.parallel.sharded_fused_lse`). Backward: ``dh`` is
+    psummed across catalog shards, ``dW`` stays shard-local — the table is
+    never gathered to one device, which is what lets the catalog scale past
+    single-device HBM (ROADMAP item 1's million-item north star).
+
+    The trainer binds :attr:`mesh` automatically (``needs_mesh``); direct
+    callers assign it before the first call. ``axis_name``/``data_axis``
+    default to the trainer mesh's ``("data", "model")`` axes.
+    """
+
+    needs_mesh = True
+
+    def __init__(
+        self,
+        tile: int = 256,
+        item_tile: Optional[int] = None,
+        interpret: bool = None,
+        axis_name: str = "model",
+        data_axis: Optional[str] = "data",
+    ) -> None:
+        super().__init__(tile, item_tile, interpret)
+        self.axis_name = axis_name
+        self.data_axis = data_axis
+        self.mesh = None
+
+    def _lse(self, hidden2d: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+        from replay_tpu.parallel.sharded_ce import sharded_fused_lse
+
+        if self.mesh is None:
+            msg = (
+                "CEFusedTP needs the device mesh to shard the catalog over: "
+                "train through replay_tpu.nn.Trainer (which binds loss.mesh) "
+                "or assign loss.mesh before the first call."
+            )
+            raise AttributeError(msg)
+        return sharded_fused_lse(
+            hidden2d,
+            table,
+            self.mesh,
+            axis_name=self.axis_name,
+            data_axis=self.data_axis,
+            tile=self.tile,
+            item_tile=self.item_tile,
+            interpret=self._resolve_interpret(),
+        )
 
 
 class CEWeighted(CE):

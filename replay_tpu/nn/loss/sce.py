@@ -96,6 +96,9 @@ class SCE:
 
     needs_item_embeddings = True
     needs_rng = True
+    # SCE scores buckets, never the [B, L, I] logits — health's logits-stats
+    # collector streams its last-position stats instead (obs.health)
+    avoid_full_logits = True
 
     def __init__(self, sce_params: SCEParams) -> None:
         self.inner = ScalableCrossEntropyLoss(sce_params)

@@ -623,6 +623,9 @@ class Trainer:
                 "such declaration."
             )
             raise ValueError(msg)
+        if getattr(loss, "needs_mesh", False):
+            # vocab-sharded losses (CEFusedTP) shard_map over the trainer mesh
+            loss.mesh = self.mesh
         label_f, tmask_f, neg_f = self.label_field, self.target_mask_field, self.negative_field
         pad_f = self.padding_mask_field
 
@@ -710,27 +713,62 @@ class Trainer:
             metrics = {"loss": loss_value, "good": good, "grad_norm": grad_norm}
             if health is not None:
                 logits = None
+                streamed_stats = None
                 if health.logits_stats and hasattr(type(model), "get_logits"):
                     # last-position scoring-head stats (the catalog logits the
                     # inference path serves) — cheap next to the loss's scoring
                     last_hidden = hidden[:, -1, :] if hidden.ndim == 3 else hidden
-                    logits_extra = {
-                        name: batch[name]
-                        for name in self._logits_extra_params
-                        if name in batch
-                    }
-                    with jax.named_scope("health_logits"):
-                        logits = model.apply(
-                            {"params": state.params},
-                            last_hidden,
-                            None,
-                            method=type(model).get_logits,
-                            **logits_extra,
-                        )
+                    if getattr(loss, "avoid_full_logits", False):
+                        # memory-wall losses (CEFused/CEFusedTP/SCE/GBCE) never
+                        # materialize [B, I] logits — neither may health. For
+                        # bias-free tying heads the same stats stream over
+                        # catalog chunks (obs.health.streamed_logits_stats);
+                        # anything else is flagged skipped IN the record (a
+                        # numeric sentinel: every sink stays scalar-typed) —
+                        # never silently absent.
+                        if getattr(model, "logits_via_item_weights", False) and hasattr(
+                            type(model), "get_item_weights"
+                        ):
+                            from replay_tpu.obs.health import streamed_logits_stats
+
+                            table = model.apply(
+                                {"params": state.params},
+                                method=type(model).get_item_weights,
+                            )
+                            with jax.named_scope("health_logits"):
+                                streamed_stats = streamed_logits_stats(
+                                    last_hidden, table
+                                )
+                        else:
+                            streamed_stats = {"skipped": jnp.float32(1.0)}
+                            logger.warning(
+                                "health.logits_stats: %s avoids full logits and "
+                                "%s has no bias-free tying head to stream stats "
+                                "from — the health record carries "
+                                "logits={'skipped': 1.0} instead",
+                                type(loss).__name__,
+                                type(model).__name__,
+                            )
+                    else:
+                        logits_extra = {
+                            name: batch[name]
+                            for name in self._logits_extra_params
+                            if name in batch
+                        }
+                        with jax.named_scope("health_logits"):
+                            logits = model.apply(
+                                {"params": state.params},
+                                last_hidden,
+                                None,
+                                method=type(model).get_logits,
+                                **logits_extra,
+                            )
                 with jax.named_scope("health"):
                     health_tree = health_metrics(
                         health, state.params, grads, updates, intermediates, logits
                     )
+                if streamed_stats is not None:
+                    health_tree["logits"] = streamed_stats
                 health_tree["grad_norm_global"] = grad_norm
                 metrics["health"] = health_tree
 

@@ -48,6 +48,7 @@ __all__ = [
     "health_metrics",
     "param_group_key",
     "sow_stage_stats",
+    "streamed_logits_stats",
 ]
 
 _BLOCK_RE = re.compile(r"(block_\d+)")
@@ -346,6 +347,54 @@ def health_metrics(
             "std": jnp.std(logits32),
         }
     return health
+
+
+def streamed_logits_stats(
+    hidden: Any, table: Any, chunk: int = 4096
+) -> Dict[str, Any]:
+    """Last-position logits stats WITHOUT materializing ``[B, num_items]``.
+
+    The memory-wall losses (CEFused/CEFusedTP/SCE/GBCE — ``avoid_full_logits``)
+    never build the full logits tensor, and at a million-item catalog the
+    health collector must not either: ``[512, 1M]`` f32 is 2 GB for three
+    scalars. This sweeps the catalog in ``[B, chunk]`` blocks with a
+    ``lax.scan`` (the fused head's tiling discipline applied to diagnostics),
+    accumulating sum / sum-of-squares / absmax — the same ``mean``/``std``/
+    ``absmax`` the full-logits block reports, up to f32 reassociation across
+    chunks. Gradient-free (``stop_gradient``): diagnostics must not change
+    the step's backward.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    hidden = jax.lax.stop_gradient(hidden).astype(jnp.float32)  # [B, E]
+    table = jax.lax.stop_gradient(table).astype(jnp.float32)  # [I, E]
+    num_items, embed = table.shape
+    chunk = max(1, min(chunk, num_items))
+    pad = -num_items % chunk
+    if pad:
+        table = jnp.pad(table, ((0, pad), (0, 0)))
+    blocks = table.reshape(-1, chunk, embed)
+    offsets = jnp.arange(blocks.shape[0]) * chunk
+    valid_counts = jnp.clip(num_items - offsets, 0, chunk)
+
+    def fold(carry, block_and_count):
+        total, sumsq, absmax = carry
+        block, count = block_and_count
+        logits = hidden @ block.T  # [B, chunk]
+        mask = (jnp.arange(chunk) < count).astype(jnp.float32)
+        masked = logits * mask
+        total = total + jnp.sum(masked)
+        sumsq = sumsq + jnp.sum(masked * masked)
+        absmax = jnp.maximum(absmax, jnp.max(jnp.abs(masked)))
+        return (total, sumsq, absmax), None
+
+    init = (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+    (total, sumsq, absmax), _ = jax.lax.scan(fold, init, (blocks, valid_counts))
+    count = jnp.float32(hidden.shape[0] * num_items)
+    mean = total / count
+    variance = jnp.maximum(sumsq / count - mean * mean, 0.0)
+    return {"mean": mean, "absmax": absmax, "std": jnp.sqrt(variance)}
 
 
 # --------------------------------------------------------------------------- #

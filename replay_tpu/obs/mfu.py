@@ -64,6 +64,22 @@ def flops_per_step(jitted_fn: Any, *args, extra_flops: float = 0.0, **kwargs) ->
     return flops + float(extra_flops)
 
 
+def fused_ce_flops(rows: int, embed: int, num_items: int) -> float:
+    """Analytic FLOPs of one fused-CE head step (fwd + bwd) for ``rows``
+    hidden vectors against a ``num_items`` catalog.
+
+    The pallas kernels are opaque custom calls to the XLA cost model, so the
+    head's work must be added back via ``extra_flops`` or every fused-variant
+    MFU reads ~0 for exactly the rows where the head dominates: forward
+    ``2·N·E·I`` (the logits sweep), backward ``2 × 2·N·E·I`` (the dh and dW
+    kernels each rematerialize a logits block and do one matmul). The
+    TP-sharded head does the same TOTAL work spread over the mesh — pass the
+    global shapes and divide by nothing; ``mfu()`` already normalizes by
+    ``device_count``.
+    """
+    return 6.0 * float(rows) * float(embed) * float(num_items)
+
+
 def mfu(tflops_per_sec: float, device_kind: str, device_count: int = 1) -> Optional[float]:
     """Achieved ÷ peak TFLOP/s over ``device_count`` chips, or None when the
     chip kind has no peak entry (an MFU against an unknown peak is noise)."""

@@ -144,6 +144,7 @@ def summarize_events(
     epoch_ends = [e for e in events if e.get("event") == "on_epoch_end"]
     fit_ends = [e for e in events if e.get("event") == "on_fit_end"]
     bench = [e for e in events if "metric" in e and "value" in e]
+    bench_rows = [e for e in events if e.get("event") == "bench_row"]
     dryruns = [e for e in events if e.get("event") == "dryrun_multichip"]
     serve_ends = [e for e in events if e.get("event") == "on_serve_end"]
     serve_batches = [e for e in events if e.get("event") == "on_serve_batch"]
@@ -156,7 +157,7 @@ def summarize_events(
             if fit_ends or steps
             else (
                 "bench"
-                if bench
+                if bench or bench_rows
                 else (
                     "serve"
                     if serve_ends or serve_batches
@@ -290,6 +291,21 @@ def summarize_events(
     else:
         summary["mfu"] = _finite(fit_end.get("mfu"))
         summary["fit_samples_per_sec"] = None
+
+    # bench_suite.py rows (one bench_row event each): the full measurement
+    # batch — surfaced per row so the catalog-scaling family reads as a table
+    summary["bench_rows"] = [
+        {
+            key: record.get(key)
+            for key in (
+                "row", "samples_per_sec", "step_ms", "scan_k", "mfu",
+                "mfu_peak_assumed", "tflops_per_sec", "num_items", "d", "B",
+                "L", "loss", "model_parallel", "backend", "error",
+            )
+            if key in record
+        }
+        for record in bench_rows
+    ] or None
 
     if dryruns:
         record = dryruns[-1]
@@ -500,6 +516,29 @@ def render(summary: Mapping[str, Any]) -> str:
                     else ""
                 )
             )
+    bench_rows = summary.get("bench_rows")
+    if bench_rows:
+        lines.append(f"  bench suite: {len(bench_rows)} row(s)")
+        for row in bench_rows:
+            if row.get("error"):
+                lines.append(f"    {row.get('row')}: ERROR {row['error']}")
+                continue
+            parts = [
+                f"{_fmt(_finite(row.get('step_ms')), '{:.3f}')} ms/step",
+                f"{_fmt(_finite(row.get('samples_per_sec')))} samples/sec",
+            ]
+            utilization = _finite(row.get("mfu"))
+            if utilization is not None:
+                assumed = row.get("mfu_peak_assumed")
+                parts.append(
+                    f"MFU {utilization:.4g}"
+                    + (f" (assumed {assumed} peak)" if assumed else "")
+                )
+            if row.get("num_items") is not None:
+                parts.append(f"items {row['num_items']}")
+            if row.get("loss"):
+                parts.append(str(row["loss"]))
+            lines.append(f"    {row.get('row')}: " + " · ".join(parts))
     serve = summary.get("serve")
     if serve:
         parts = []

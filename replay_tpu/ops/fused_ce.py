@@ -21,27 +21,99 @@ each ``[row_tile, item_tile]`` logits block on the fly —
 (TPU pallas grids execute sequentially, which is what makes same-block
 accumulation across the inner axis well-defined.)
 
+Two provisions for callers beyond the single-device case:
+
+- ``num_valid`` may be a TRACED int32 scalar smaller than ``table.shape[0]``:
+  the vocab-sharded wrapper (replay_tpu.parallel.sharded_ce) gives each shard
+  a fixed-shape ``[I/n_tp, E]`` slice but a per-shard valid count derived from
+  ``lax.axis_index`` at run time. Padding columns are masked with a large
+  FINITE negative (``_MASK``) rather than −inf, so a shard whose slice is
+  entirely padding still produces a well-defined (≈ −1e30) lse instead of
+  NaN-ing the online max/sum; ``exp(_MASK − lse)`` underflows to exactly 0.0
+  for any realistic lse, so results are bit-identical to the −inf mask.
+- a VMEM-budget guard: the ``[row_tile, item_tile]`` working set is estimated
+  up front and ``item_tile`` auto-shrinks (lane-aligned halving) instead of
+  failing at Mosaic compile time (the round-3 16 MB bwd-kernel incident); one
+  warning is logged per shrunk configuration.
+
 On non-TPU backends the kernels run in interpreter mode (tests); call sites
 should prefer them only when ``jax.default_backend() == "tpu"``.
 """
 
 from __future__ import annotations
 
+import logging
 from functools import partial
+from typing import Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("replay_tpu")
 
 _LANE = 128  # TPU lane width: catalog axis is padded to a multiple of this
 _DEFAULT_ITEM_TILE = 4096  # catalog tiles: [row_tile, item_tile] logits blocks
+# finite catalog-padding mask: exp(_MASK - lse) == 0.0 exactly for any
+# realistic lse (f32 exp underflows below ~-104), so real rows are
+# bit-identical to a -inf mask, while a FULLY-masked shard (the TP wrapper's
+# empty tail shard) still yields a finite ~-1e30 lse instead of NaN
+_MASK = -1e30
+# per-core VMEM budget for one kernel invocation: 16 MiB of VMEM minus
+# headroom for Mosaic's own buffers — exceeding it fails at compile time.
+# Calibrated against the round-3 evidence: [256, 4096] at E=64 compiled and
+# ran (≈8 MB by the model below), the E=300 bwd kernel at the same tile
+# (≈24 MB) died at the 16 MB limit.
+_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+_shrink_warned: Set[Tuple[int, int, int, int]] = set()
 
 
 def _pad_to(value: int, multiple: int) -> int:
     return ((value + multiple - 1) // multiple) * multiple
 
 
-def _masked_logits(num_items_ref, h_ref, w_ref, item_tile: int):
-    """One [T, item_tile] logits block with catalog padding masked to -inf.
+def _working_set_bytes(tile: int, item_tile: int, embed: int) -> int:
+    """Estimated peak VMEM of one grid step of the WORST kernel (the
+    backwards): pipeline blocks (h/w/g/lse in, dh-or-dw out — double-buffered
+    by Mosaic) plus the f32 [tile, item_tile] logits intermediate (its
+    softmax-weighted successor reuses the buffer), all f32."""
+    blocks = 2 * (tile * embed + item_tile * embed) + 2 * tile
+    return 4 * (2 * blocks + tile * item_tile)
+
+
+def _resolve_item_tile(num_items: int, item_tile, tile: int, embed: int) -> int:
+    """Lane-align the catalog tile and shrink it to the VMEM budget.
+
+    The guard runs BEFORE the kernel is built: the round-3 incident was a
+    [256, 4096] bwd block at d=300 blowing the 16 MB Mosaic limit at compile
+    time — opaque to the caller. Halving keeps lane alignment; one warning per
+    shrunk configuration records the decision in the run log.
+    """
+    requested = _DEFAULT_ITEM_TILE if item_tile is None else item_tile
+    resolved = min(_pad_to(requested, _LANE), _pad_to(max(num_items, 1), _LANE))
+    shrunk = resolved
+    while shrunk > _LANE and _working_set_bytes(tile, shrunk, embed) > _VMEM_BUDGET_BYTES:
+        shrunk = _pad_to(shrunk // 2, _LANE)
+    if shrunk != resolved:
+        key = (tile, resolved, shrunk, embed)
+        if key not in _shrink_warned:
+            _shrink_warned.add(key)
+            logger.warning(
+                "fused_ce: item_tile %d would need ~%.1f MB of VMEM at "
+                "row_tile=%d, embed=%d (budget %.0f MB): shrunk to %d. Pass "
+                "item_tile= explicitly to silence.",
+                resolved,
+                _working_set_bytes(tile, resolved, embed) / 2**20,
+                tile,
+                embed,
+                _VMEM_BUDGET_BYTES / 2**20,
+                shrunk,
+            )
+    return shrunk
+
+
+def _masked_logits(num_valid_ref, h_ref, w_ref, item_tile: int):
+    """One [T, item_tile] logits block with catalog padding masked to _MASK.
 
     The mask is a [1, item_tile] row vector (a few KB) rather than a full-size
     iota compare, which would cost as much VMEM as the logits block itself.
@@ -54,10 +126,10 @@ def _masked_logits(num_items_ref, h_ref, w_ref, item_tile: int):
     col = pl.program_id(1) * item_tile + jax.lax.broadcasted_iota(
         jnp.int32, (1, item_tile), 1
     )
-    return logits + jnp.where(col < num_items_ref[0], 0.0, -jnp.inf).astype(jnp.float32)
+    return logits + jnp.where(col < num_valid_ref[0], 0.0, _MASK).astype(jnp.float32)
 
 
-def _lse_kernel(num_items_ref, h_ref, w_ref, lse_ref, m_ref, s_ref):
+def _lse_kernel(num_valid_ref, h_ref, w_ref, lse_ref, m_ref, s_ref):
     """Online logsumexp: running max/sum scratch across the inner item grid."""
     from jax.experimental import pallas as pl
 
@@ -68,9 +140,9 @@ def _lse_kernel(num_items_ref, h_ref, w_ref, lse_ref, m_ref, s_ref):
         m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
         s_ref[...] = jnp.zeros_like(s_ref)
 
-    logits = _masked_logits(num_items_ref, h_ref, w_ref, w_ref.shape[0])
-    tile_max = jnp.max(logits, axis=-1, keepdims=True)  # finite: every tile
-    new_max = jnp.maximum(m_ref[...], tile_max)  # has >=1 real column
+    logits = _masked_logits(num_valid_ref, h_ref, w_ref, w_ref.shape[0])
+    tile_max = jnp.max(logits, axis=-1, keepdims=True)  # finite even for a
+    new_max = jnp.maximum(m_ref[...], tile_max)  # fully-masked tile (_MASK)
     s_ref[...] = s_ref[...] * jnp.exp(m_ref[...] - new_max) + jnp.sum(
         jnp.exp(logits - new_max), axis=-1, keepdims=True
     )
@@ -81,11 +153,11 @@ def _lse_kernel(num_items_ref, h_ref, w_ref, lse_ref, m_ref, s_ref):
         lse_ref[...] = m_ref[...] + jnp.log(s_ref[...])
 
 
-def _dh_kernel(num_items_ref, h_ref, w_ref, g_ref, lse_ref, dh_ref):
+def _dh_kernel(num_valid_ref, h_ref, w_ref, g_ref, lse_ref, dh_ref):
     """dh[i] = sum_j (g * softmax_block_j) @ W_j — inner item axis accumulates."""
     from jax.experimental import pallas as pl
 
-    logits = _masked_logits(num_items_ref, h_ref, w_ref, w_ref.shape[0])
+    logits = _masked_logits(num_valid_ref, h_ref, w_ref, w_ref.shape[0])
     weighted = jnp.exp(logits - lse_ref[...]) * g_ref[...].astype(jnp.float32)
     # f32 accumulation across catalog tiles (dh_ref is f32; the caller casts to
     # hidden.dtype once after the kernel, mirroring the dW path)
@@ -102,12 +174,11 @@ def _dh_kernel(num_items_ref, h_ref, w_ref, g_ref, lse_ref, dh_ref):
         dh_ref[...] += contrib
 
 
-def _dw_kernel(num_items_ref, h_ref, w_ref, g_ref, lse_ref, dw_ref):
+def _dw_kernel(num_valid_ref, h_ref, w_ref, g_ref, lse_ref, dw_ref):
     """dW[j] = sum_i (g * softmax_block)ᵀ @ h_i — inner row axis accumulates.
 
     Grid is (items, rows): program_id(0) is the item tile, program_id(1) the
-    row tile, so ``_masked_logits``'s column offset uses program_id(0) here —
-    handled by swapping the id axes via the transposed wrapper below.
+    row tile, so the column offset uses program_id(0) here.
     """
     from jax.experimental import pallas as pl
 
@@ -118,7 +189,7 @@ def _dw_kernel(num_items_ref, h_ref, w_ref, g_ref, lse_ref, dw_ref):
     col = pl.program_id(0) * item_tile + jax.lax.broadcasted_iota(
         jnp.int32, (1, item_tile), 1
     )
-    logits = logits + jnp.where(col < num_items_ref[0], 0.0, -jnp.inf).astype(jnp.float32)
+    logits = logits + jnp.where(col < num_valid_ref[0], 0.0, _MASK).astype(jnp.float32)
     weighted = jnp.exp(logits - lse_ref[...]) * g_ref[...].astype(jnp.float32)
     contrib = jnp.dot(weighted.T, h, preferred_element_type=jnp.float32)
 
@@ -133,46 +204,53 @@ def _dw_kernel(num_items_ref, h_ref, w_ref, g_ref, lse_ref, dw_ref):
 
 def _prepare(hidden: jnp.ndarray, table: jnp.ndarray, tile: int, item_tile: int):
     n, embed = hidden.shape
-    num_items = table.shape[0]
+    num_rows = table.shape[0]
     n_pad = _pad_to(max(n, 1), tile)
-    items_pad = _pad_to(max(num_items, 1), item_tile)
+    items_pad = _pad_to(max(num_rows, 1), item_tile)
     hidden = jnp.pad(hidden, ((0, n_pad - n), (0, 0)))
-    table = jnp.pad(table, ((0, items_pad - num_items), (0, 0)))
-    return hidden, table, n, n_pad, items_pad, embed, num_items
+    table = jnp.pad(table, ((0, items_pad - num_rows), (0, 0)))
+    return hidden, table, n, n_pad, items_pad, embed, num_rows
 
 
-def _resolve_item_tile(num_items: int, item_tile) -> int:
-    if item_tile is None:
-        item_tile = _DEFAULT_ITEM_TILE
-    return min(_pad_to(item_tile, _LANE), _pad_to(max(num_items, 1), _LANE))
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def fused_lse(
     hidden: jnp.ndarray,
     table: jnp.ndarray,
     tile: int = 256,
-    item_tile: int = None,
+    item_tile: Optional[int] = None,
     interpret: bool = False,
+    num_valid=None,
 ):
     """``logsumexp(hidden @ table.T, axis=-1)`` without materializing the logits.
 
     :param hidden: ``[N, E]`` row vectors (any float dtype; f32 accumulation).
     :param table: ``[num_items, E]`` item embeddings.
     :param tile: rows per program.
-    :param item_tile: catalog columns per program (defaults to 4096; the
-        catalog is swept with an online max/sum so any size compiles).
+    :param item_tile: catalog columns per program (defaults to 4096, shrunk
+        lane-aligned to the VMEM budget; the catalog is swept with an online
+        max/sum so any size compiles).
+    :param num_valid: valid leading rows of ``table`` — everything past it is
+        masked out of the softmax. May be a TRACED int32 scalar (the
+        vocab-sharded wrapper's per-shard count); default: all rows.
     :return: ``[N]`` float32 log-sum-exp values.
     """
-    return _run_forward(hidden, table, tile, item_tile, interpret)
+    if num_valid is None:
+        num_valid = table.shape[0]
+    return _fused_lse(
+        hidden, table, jnp.asarray(num_valid, jnp.int32), tile, item_tile, interpret
+    )
 
 
-def _run_forward(hidden, table, tile, item_tile, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_lse(hidden, table, num_valid, tile, item_tile, interpret):
+    return _run_forward(hidden, table, num_valid, tile, item_tile, interpret)
+
+
+def _run_forward(hidden, table, num_valid, tile, item_tile, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    item_tile = _resolve_item_tile(table.shape[0], item_tile)
-    hidden_p, table_p, n, n_pad, items_pad, embed, num_items = _prepare(
+    item_tile = _resolve_item_tile(table.shape[0], item_tile, tile, hidden.shape[1])
+    hidden_p, table_p, n, n_pad, items_pad, embed, _ = _prepare(
         hidden, table, tile, item_tile
     )
     grid = (n_pad // tile, items_pad // item_tile)
@@ -193,28 +271,28 @@ def _run_forward(hidden, table, tile, item_tile, interpret):
         ),
         out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
         interpret=interpret,
-    )(jnp.asarray([num_items], jnp.int32), hidden_p, table_p)
+    )(jnp.reshape(num_valid, (1,)), hidden_p, table_p)
     return lse[:n, 0]
 
 
-def _fused_lse_fwd(hidden, table, tile, item_tile, interpret):
-    lse = _run_forward(hidden, table, tile, item_tile, interpret)
-    return lse, (hidden, table, lse)
+def _fused_lse_fwd(hidden, table, num_valid, tile, item_tile, interpret):
+    lse = _run_forward(hidden, table, num_valid, tile, item_tile, interpret)
+    return lse, (hidden, table, num_valid, lse)
 
 
 def _fused_lse_bwd(tile, item_tile, interpret, residuals, grad_lse):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    hidden, table, lse = residuals
-    item_tile = _resolve_item_tile(table.shape[0], item_tile)
-    hidden_p, table_p, n, n_pad, items_pad, embed, num_items = _prepare(
+    hidden, table, num_valid, lse = residuals
+    item_tile = _resolve_item_tile(table.shape[0], item_tile, tile, hidden.shape[1])
+    hidden_p, table_p, n, n_pad, items_pad, embed, num_rows = _prepare(
         hidden, table, tile, item_tile
     )
     rows, items = n_pad // tile, items_pad // item_tile
     g = jnp.pad(grad_lse.astype(jnp.float32), (0, n_pad - n)).reshape(n_pad, 1)
     lse_p = jnp.pad(lse, (0, n_pad - n)).reshape(n_pad, 1)
-    scalar = jnp.asarray([num_items], jnp.int32)
+    scalar = jnp.reshape(num_valid, (1,))
 
     dh = pl.pallas_call(
         _dh_kernel,
@@ -250,7 +328,12 @@ def _fused_lse_bwd(tile, item_tile, interpret, residuals, grad_lse):
         interpret=interpret,
     )(scalar, hidden_p, table_p, g, lse_p)
 
-    return dh[:n].astype(hidden.dtype), dw[:num_items].astype(table.dtype)
+    return (
+        dh[:n].astype(hidden.dtype),
+        dw[:num_rows].astype(table.dtype),
+        # num_valid is an int scalar: its cotangent is the symbolic float0 zero
+        np.zeros(np.shape(num_valid), jax.dtypes.float0),
+    )
 
 
-fused_lse.defvjp(_fused_lse_fwd, _fused_lse_bwd)
+_fused_lse.defvjp(_fused_lse_fwd, _fused_lse_bwd)

@@ -1,9 +1,11 @@
 from .distributed import initialize_distributed, replicas_info
 from .ring import full_attention_reference, ring_attention
+from .sharded_ce import sharded_fused_lse
 
 __all__ = [
     "full_attention_reference",
     "initialize_distributed",
     "replicas_info",
     "ring_attention",
+    "sharded_fused_lse",
 ]

@@ -1,0 +1,157 @@
+"""GBCE — the gBCE calibrated sampled loss ("Turning Dross Into Gold").
+
+Protocol conformance, calibration parity vs BCESampled at the β extremes, the
+β formula itself, and the million-item claim: a Trainer fit at a synthetic
+1M-item catalog touching ONLY the embedding table (never [B, L, I] logits),
+with finite loss and health metrics streamed — the drop-in sampled peer of
+the fused-CE heads (docs/performance.md "Breaking the memory wall").
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from replay_tpu.nn.loss import BCESampled, GBCE
+
+B, L, E, I = 2, 4, 8, 12
+RNG = np.random.default_rng(0)
+EMB = jnp.asarray(RNG.normal(size=(B, L, E)), dtype=jnp.float32)
+ITEMS = jnp.asarray(RNG.normal(size=(I, E)), dtype=jnp.float32)
+POS = jnp.asarray(RNG.integers(0, I, size=(B, L, 1)))
+NEG = jnp.asarray(RNG.integers(0, I, size=(5,)))
+PAD = jnp.asarray([[True] * L, [False, False, True, True]])
+TGT = PAD[..., None]
+
+pytestmark = pytest.mark.jax
+
+
+def make(loss):
+    def callback(embeddings, ids=None):
+        if ids is None:
+            return embeddings @ ITEMS.T
+        if ids.ndim == 1:
+            return embeddings @ ITEMS[ids].T
+        return jnp.einsum("...e,...ke->...k", embeddings, ITEMS[ids])
+
+    loss.logits_callback = callback
+    return loss
+
+
+def call(loss, pos=POS, neg=NEG, tgt=TGT):
+    return loss(EMB, {}, pos, neg, PAD, tgt)
+
+
+def test_beta_formula():
+    """β = α(t(1−1/α)+1/α): t=0 → 1 (plain BCE), t=1 → α (full calibration)."""
+    loss = GBCE(catalog_size=101, t=0.0)
+    assert loss.resolved_beta(25) == pytest.approx(1.0)
+    loss = GBCE(catalog_size=101, t=1.0)
+    assert loss.resolved_beta(25) == pytest.approx(25 / 100)
+    loss = GBCE(catalog_size=101, t=0.5)
+    alpha = 25 / 100
+    assert loss.resolved_beta(25) == pytest.approx(alpha * (0.5 * (1 - 1 / alpha) + 1 / alpha))
+
+
+def test_t_zero_is_bitwise_bce_sampled():
+    """β=1: GBCE must be BCESampled exactly — the scale is the IEEE identity."""
+    plain = float(call(make(BCESampled())))
+    calibrated = float(call(make(GBCE(catalog_size=I, t=0.0))))
+    assert plain == calibrated  # bitwise, not approx
+
+
+def test_full_calibration_shrinks_positive_term():
+    """β=α<1 scales only the −log σ(s⁺) term down: the loss must drop."""
+    plain = float(call(make(BCESampled())))
+    calibrated = float(call(make(GBCE(catalog_size=I, t=1.0))))
+    assert calibrated < plain
+
+
+def test_beta_override_and_negative_shapes():
+    loss = make(GBCE(beta=0.5))
+    v1 = call(loss, neg=NEG)
+    v2 = call(loss, neg=jnp.broadcast_to(NEG, (B, 5)))
+    v3 = call(loss, neg=jnp.broadcast_to(NEG, (B, L, 5)))
+    assert float(v1) == pytest.approx(float(v2), rel=1e-5)
+    assert float(v1) == pytest.approx(float(v3), rel=1e-5)
+
+
+def test_ignore_index_negatives_excluded():
+    loss = make(GBCE(catalog_size=I, t=0.5))
+    # padded negatives change the STATIC negative count (and thus β): compare
+    # against an explicit-β loss to isolate the masking behavior
+    fixed = make(GBCE(beta=0.7))
+    padded = call(fixed, neg=jnp.concatenate([NEG, jnp.array([-100, -100])]))
+    plain = call(fixed, neg=NEG)
+    assert float(padded) == pytest.approx(float(plain), rel=1e-5)
+    assert np.isfinite(float(call(loss)))
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="exactly one"):
+        GBCE()
+    with pytest.raises(ValueError, match="exactly one"):
+        GBCE(catalog_size=10, beta=0.5)
+    with pytest.raises(ValueError, match="t must be"):
+        GBCE(catalog_size=10, t=1.5)
+    with pytest.raises(ValueError, match="catalog_size"):
+        GBCE(catalog_size=1)
+
+
+@pytest.mark.smoke
+def test_million_item_trainer_fit_embedding_table_only():
+    """The million-item claim, executed: a SasRec with a 1,000,000-item
+    catalog fits through the production loop with GBCE — the only [I, ...]
+    tensor anywhere is the embedding table (32 MB at E=8; full logits would
+    be 2 GB per batch) — with finite loss and health metrics whose logits
+    stats STREAMED over the catalog (obs.health.streamed_logits_stats)."""
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.obs import HealthConfig
+
+    num_items, length, batch_size = 1_000_000, 6, 8
+    schema = TensorSchema(
+        TensorFeatureInfo(
+            "item_id", FeatureType.CATEGORICAL, is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+            embedding_dim=8,
+        )
+    )
+    rng = np.random.default_rng(0)
+
+    def make_batch(seed):
+        r = np.random.default_rng(seed)
+        items = r.integers(0, num_items, size=(batch_size, length + 1)).astype(np.int32)
+        return {
+            "feature_tensors": {"item_id": items[:, :-1]},
+            "padding_mask": np.ones((batch_size, length), bool),
+            "positive_labels": items[:, 1:, None],
+            "target_padding_mask": np.ones((batch_size, length, 1), bool),
+            "negative_labels": r.integers(0, num_items, size=(64,)).astype(np.int32),
+        }
+
+    model = SasRec(
+        schema=schema, embedding_dim=8, num_blocks=1, num_heads=1,
+        max_sequence_length=length, dropout_rate=0.0,
+    )
+    trainer = Trainer(
+        model=model,
+        loss=GBCE(catalog_size=num_items, t=0.75),
+        optimizer=OptimizerFactory(learning_rate=1e-2),
+        mesh=make_mesh(),
+        health=HealthConfig(cadence=1, attention_entropy=False, activation_stats=False),
+    )
+    trainer.fit([make_batch(i) for i in range(2)], epochs=1, log_every=0)
+    assert np.isfinite(trainer.history[-1]["train_loss"])
+    health = trainer.last_health
+    assert health is not None
+    stats = health["logits"]
+    assert set(stats) == {"mean", "absmax", "std"}
+    assert all(np.isfinite(v) for v in stats.values())
+    assert np.isfinite(health["grad_norm_global"])
+    # sampled loss at a million items: the batch touches a vanishing fraction
+    # of embedding rows — the coverage signal must reflect that
+    assert 0.0 < health["embedding_coverage"] < 1e-3

@@ -127,6 +127,57 @@ def test_cefused_trains_identically_to_ce():
     assert fused[-1] < fused[0]  # and it actually learns
 
 
+def test_vmem_guard_shrinks_item_tile(caplog):
+    """The [row_tile, item_tile] working set is budgeted UP FRONT: a config
+    that would blow the Mosaic VMEM limit at compile time (the round-3 16 MB
+    bwd-kernel incident: tile=256 x item_tile=4096 at d=300) auto-shrinks the
+    item tile lane-aligned, with one warning recording the decision."""
+    import logging
+
+    from replay_tpu.ops.fused_ce import (
+        _LANE,
+        _VMEM_BUDGET_BYTES,
+        _resolve_item_tile,
+        _shrink_warned,
+        _working_set_bytes,
+    )
+
+    _shrink_warned.clear()
+    with caplog.at_level(logging.WARNING, logger="replay_tpu"):
+        shrunk = _resolve_item_tile(1_000_000, None, 256, 300)
+    assert shrunk < 4096
+    assert shrunk % _LANE == 0
+    assert _working_set_bytes(256, shrunk, 300) <= _VMEM_BUDGET_BYTES
+    warnings = [r for r in caplog.records if "item_tile" in r.getMessage()]
+    assert len(warnings) == 1
+    # the same configuration warns ONCE, not once per trace
+    with caplog.at_level(logging.WARNING, logger="replay_tpu"):
+        assert _resolve_item_tile(1_000_000, None, 256, 300) == shrunk
+    assert len([r for r in caplog.records if "item_tile" in r.getMessage()]) == 1
+
+
+def test_vmem_guard_keeps_small_configs_unchanged():
+    """The bench/test shapes that fit must resolve exactly as before."""
+    from replay_tpu.ops.fused_ce import _resolve_item_tile
+
+    assert _resolve_item_tile(1000, None, 128, 64) == 1024  # lane-padded catalog
+    assert _resolve_item_tile(27278, None, 256, 64) == 4096  # the default tile
+    assert _resolve_item_tile(1000, 256, 128, 64) == 256  # explicit, in budget
+
+
+def test_vmem_guard_shrinks_explicit_item_tile(caplog):
+    """An explicit item_tile beyond budget shrinks too — the guard exists to
+    prevent the compile-time failure, not to trust the caller."""
+    import logging
+
+    from replay_tpu.ops.fused_ce import _resolve_item_tile, _shrink_warned
+
+    _shrink_warned.clear()
+    with caplog.at_level(logging.WARNING, logger="replay_tpu"):
+        shrunk = _resolve_item_tile(1_000_000, 16384, 512, 512)
+    assert shrunk < 16384
+
+
 def test_cefused_refuses_non_tying_head_model():
     """A model without the bias-free-head declaration cannot bind CEFused —
     it would silently train with a different loss than CE (advisor r3)."""
