@@ -50,9 +50,11 @@ import numpy as np
 
 # import-light on purpose (no jax): safe before the backend health probe;
 # the peak-TFLOPs table and cost-model FLOPs live in obs.mfu now, shared
-# with bench_suite.py and Trainer.fit's telemetry
+# with bench_suite.py and Trainer.fit's telemetry; obs.roofline adds the
+# peak-bandwidth table and the memory/compute-bound classification
 from replay_tpu.obs import JsonlLogger, MemoryMonitor
-from replay_tpu.obs.mfu import flops_per_step, mfu as _mfu
+from replay_tpu.obs.mfu import mfu as _mfu, program_costs
+from replay_tpu.obs.roofline import analyze_costs, bench_fields
 
 _DEFAULTS = {"BATCH": 512, "SEQ_LEN": 50, "NUM_ITEMS": 3706, "EMBEDDING_DIM": 64, "NUM_BLOCKS": 2}
 
@@ -248,14 +250,21 @@ def main() -> None:
 
     # per-step FLOPs from XLA's own cost model of the compiled train step;
     # the pallas custom call is opaque to the cost model, so the fused head
-    # adds back the analytic FLOPs it replaced (fwd 2NEI + bwd 2*2NEI)
-    step_flops = flops_per_step(
-        trainer._train_step,
-        state,
-        trainer._put_batch(batch),
-        extra_flops=(
-            6.0 * BATCH * SEQ_LEN * EMBEDDING_DIM * NUM_ITEMS if use_fused_ce else 0.0
-        ),
+    # adds back the analytic FLOPs it replaced (fwd 2NEI + bwd 2*2NEI).
+    # The same compile feeds the static roofline (obs.roofline): memory- vs
+    # compute-bound with the predicted ceiling, HBM footprint, collective
+    # bytes — "achieved X% of the roofline ceiling" is the honest MFU for
+    # bandwidth-bound heads.
+    extra_flops = 6.0 * BATCH * SEQ_LEN * EMBEDDING_DIM * NUM_ITEMS if use_fused_ce else 0.0
+    step_costs = program_costs(trainer._train_step, state, trainer._put_batch(batch))
+    step_flops = None
+    if step_costs and step_costs.get("flops"):
+        step_flops = float(step_costs["flops"]) + extra_flops
+    static_record = analyze_costs(
+        step_costs,
+        device_kind=jax.devices()[0].device_kind,
+        extra_flops=extra_flops,
+        mesh_shape={axis: int(n) for axis, n in trainer.mesh.shape.items()},
     )
 
     # headline: K optimizer steps per XLA dispatch (Trainer.train_steps lax.scan
@@ -360,6 +369,7 @@ def main() -> None:
         }
     device_kind = jax.devices()[0].device_kind
     record["device_kind"] = device_kind
+    tflops = None
     if step_flops:
         tflops = step_flops * steps / elapsed / 1e12
         record["tflops_per_sec"] = round(tflops, 3)
@@ -368,6 +378,10 @@ def main() -> None:
         utilization = _mfu(tflops, device_kind, device_count=jax.device_count())
         if utilization is not None and not on_cpu:
             record["mfu"] = round(utilization, 4)
+    # static program analyses (one shaping shared with bench_suite rows):
+    # HBM footprint + collective traffic + the roofline classification, and
+    # achieved ÷ per-chip roofline ceiling when the rate was measured
+    record.update(bench_fields(static_record, tflops, jax.device_count()))
     if record["backend"] == "tpu" and not SHAPE_OVERRIDE:
         record["captured_unix"] = int(time.time())
         rev = _git_rev()

@@ -56,7 +56,8 @@ import numpy as np
 
 from bench import _git_rev
 from replay_tpu.obs import JsonlLogger, MemoryMonitor
-from replay_tpu.obs.mfu import flops_per_step, mfu as _mfu
+from replay_tpu.obs.mfu import mfu as _mfu, program_costs
+from replay_tpu.obs.roofline import analyze_costs, bench_fields
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -88,11 +89,18 @@ def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=Non
         jax.block_until_ready(loss_value)
         dispatch_step = time.perf_counter() - t0
 
-        step_flops = flops_per_step(
-            trainer._train_step,
-            state,
-            trainer._put_batch(batch),
+        # one lower+compile feeds the per-step FLOPs AND the static roofline
+        # (obs.roofline): bound-ness, predicted ceiling, HBM footprint and
+        # collective bytes ride every row next to the measured rates
+        step_costs = program_costs(trainer._train_step, state, trainer._put_batch(batch))
+        step_flops = None
+        if step_costs and step_costs.get("flops"):
+            step_flops = float(step_costs["flops"]) + float(extra_flops_per_step)
+        static_record = analyze_costs(
+            step_costs,
+            device_kind=jax.devices()[0].device_kind,
             extra_flops=extra_flops_per_step,
+            mesh_shape={axis: int(n) for axis, n in trainer.mesh.shape.items()},
         )
 
         chunk = [batch] * scan_k
@@ -136,9 +144,17 @@ def measure(trainer, batch, label, scan_k=16, extra_flops_per_step=0.0, meta=Non
             ),
             **(meta or {}),
         }
+        tflops = None
         if step_flops:
             tflops = step_flops * steps / elapsed / 1e12
             record["tflops_per_sec"] = round(tflops, 3)
+        # one shaping shared with bench.py (obs.roofline.bench_fields):
+        # bound-ness + ceiling + HBM/collective bytes, and achieved ÷ per-chip
+        # roofline ceiling — the honest utilization for memory-bound heads
+        # (CPU rows: arithmetic against the assumed peak, flagged via
+        # roofline_peak_assumed)
+        record.update(bench_fields(static_record, tflops, jax.device_count()))
+        if step_flops:
             utilization = _mfu(tflops, record["device_kind"], device_count=jax.device_count())
             if utilization is not None and record["backend"] != "cpu":
                 record["mfu"] = round(utilization, 4)

@@ -94,6 +94,9 @@ class CompiledInference:
         # XLA re-associate the math and break bitwise parity with the live
         # executables (the latent issue the round-trip test surfaced).
         self._export_params: Any = None
+        # live-compiled instances keep the raw jax.stages.Compiled per bucket
+        # for static analysis (roofline()); absent after deserialize
+        self._executables: Optional[Dict[int, Any]] = None
 
     @property
     def buckets(self) -> Tuple[int, ...]:
@@ -101,6 +104,25 @@ class CompiledInference:
         the serve micro-batcher sizes its lanes from (no private attribute
         access)."""
         return tuple(sorted(self._compiled))
+
+    def roofline(self) -> Dict[int, Any]:
+        """Static roofline record per bucket executable (obs.roofline):
+        memory- vs compute-bound with the predicted ceiling, HBM footprint,
+        collective bytes — so the serving ladder's bound-ness is inspectable
+        next to the training programs'. Empty for deserialized instances
+        (jax.export calls expose no cost/memory analysis) and on backends
+        without the analyses."""
+        if not self._executables:
+            return {}
+        from replay_tpu.obs.mfu import compiled_costs
+        from replay_tpu.obs.roofline import analyze_costs
+
+        records: Dict[int, Any] = {}
+        for size, executable in sorted(self._executables.items()):
+            record = analyze_costs(compiled_costs(executable))
+            if record is not None:
+                records[int(size)] = record
+        return records
 
     @classmethod
     def compile(
@@ -179,6 +201,7 @@ class CompiledInference:
             return ids_spec, mask_spec, cand_spec
 
         compiled = {}
+        executables = {}
         for size in sizes:
             ids_spec, mask_spec, cand_spec = specs(size)
             executable = (
@@ -191,6 +214,7 @@ class CompiledInference:
             compiled[size] = (
                 lambda ids, mask, cands, _ex=executable: _ex(params, ids, mask, cands)
             )
+            executables[size] = executable
         out = cls(
             compiled,
             max_sequence_length,
@@ -198,6 +222,10 @@ class CompiledInference:
             outputs=outputs,
             candidates_count=candidates_count,
         )
+        # raw jax.stages.Compiled per bucket: the static-analysis seam
+        # (roofline()/cost introspection); deserialized instances run through
+        # jax.export calls instead and carry none
+        out._executables = executables
 
         def serialize_bucket(size: int) -> bytes:
             from jax import export as jax_export

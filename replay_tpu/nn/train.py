@@ -523,6 +523,12 @@ class Trainer:
         self._put_batch = _batch_sharding(self.mesh, self.padding_mask_field)
         self._train_step = None
         self._train_scan = None
+        # {name: (jitted_fn, abstract arg templates)} — ShapeDtypeStruct
+        # snapshots (shape/dtype/sharding, no buffers) of every dispatched
+        # program's arguments, recorded once at first dispatch so the static
+        # analyses (obs.roofline / obs.profile) can re-lower the EXACT
+        # programs later without holding donated state alive
+        self._programs: Dict[str, Tuple[Any, Tuple[Any, ...]]] = {}
         self._eval_logits = None
         self._query_embeddings_fn = None
         self._catalog_fn = None
@@ -600,6 +606,92 @@ class Trainer:
         wrapper's introspection trick, replay/nn/lightning/module.py:59)."""
         pool = {**batch, **overrides}
         return {name: pool[name] for name in self._forward_params if name in pool}
+
+    # -- program introspection (obs.profile / obs.roofline) ----------------- #
+    def _record_template(self, name: str, jitted_fn, *args) -> None:
+        """Snapshot a dispatched program's argument shapes/dtypes/shardings
+        (once per name; no device buffers are retained)."""
+        if name in self._programs:
+            return
+
+        def absify(x):
+            # pin only MESH shardings: uncommitted single-device leaves (state
+            # scalars created off-mesh) must stay free for jit to place, as
+            # they are at real dispatch — pinning their SingleDeviceSharding
+            # would conflict with the mesh-sharded params
+            sharding = getattr(x, "sharding", None)
+            if getattr(sharding, "mesh", None) is None:
+                sharding = None
+            return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=sharding)
+
+        self._programs[name] = (jitted_fn, tuple(jax.tree.map(absify, a) for a in args))
+
+    def lowered_hlo(self, name: str) -> str:
+        """The optimized HLO text of a dispatched program (``"train_step"`` /
+        ``"train_scan"``), re-lowered from its recorded templates — the input
+        to the collective inventory and the no-table-gather guard."""
+        if name not in self._programs:
+            msg = f"no program {name!r} dispatched yet; known: {sorted(self._programs)}"
+            raise KeyError(msg)
+        jitted, templates = self._programs[name]
+        return jitted.lower(*templates).compile().as_text()
+
+    def analyze_programs(
+        self, extra_flops: Optional[Mapping[str, float]] = None
+    ) -> Dict[str, Any]:
+        """Static roofline/memory/collective record per dispatched program
+        (:func:`replay_tpu.obs.roofline.analyze_program`): memory- vs
+        compute-bound with the predicted ceiling, the static HBM footprint
+        and the collective byte inventory. ``extra_flops`` maps program name
+        → analytic FLOPs the cost model cannot see (pallas heads)."""
+        from replay_tpu.obs.roofline import analyze_program
+
+        mesh_shape = {axis: int(n) for axis, n in self.mesh.shape.items()}
+        out: Dict[str, Any] = {}
+        for name, (jitted, templates) in self._programs.items():
+            record = analyze_program(
+                jitted,
+                *templates,
+                mesh_shape=mesh_shape,
+                extra_flops=(extra_flops or {}).get(name, 0.0),
+            )
+            if record is not None:
+                out[name] = record
+        return out
+
+    def _profile_payload(self, profile_dir: str) -> Dict[str, Any]:
+        """Post-capture analysis for a profiled fit: the per-named-scope
+        device-time attribution (obs.profile) joined against THIS trainer's
+        compiled programs, plus their roofline records — one re-compile per
+        program, shared by both analyses. Best-effort: a missing capture or
+        an analysing-free backend degrades to a partial payload with a logged
+        warning, never a failed fit."""
+        from replay_tpu.obs.mfu import program_costs
+        from replay_tpu.obs.profile import attribute_capture
+        from replay_tpu.obs.roofline import analyze_costs
+
+        payload: Dict[str, Any] = {}
+        mesh_shape = {axis: int(n) for axis, n in self.mesh.shape.items()}
+        texts: Dict[str, str] = {}
+        rooflines: Dict[str, Any] = {}
+        for name, (jitted, templates) in self._programs.items():
+            costs = program_costs(jitted, *templates)
+            if costs is None:
+                continue
+            if costs.get("hlo_text"):
+                texts[name] = costs["hlo_text"]
+            record = analyze_costs(costs, mesh_shape=mesh_shape)
+            if record is not None:
+                rooflines[name] = record
+        try:
+            payload["device_time"] = attribute_capture(profile_dir, texts)
+        except (OSError, ValueError) as exc:
+            logger.warning(
+                "device-time attribution failed for %s: %s", profile_dir, exc
+            )
+        if rooflines:
+            payload["roofline"] = rooflines
+        return payload
 
     # -- train ------------------------------------------------------------- #
     def _build_train_step(self, health: Optional[HealthConfig] = None):
@@ -829,6 +921,7 @@ class Trainer:
             )
         with self._h2d_span():
             placed = self._put_batch(batch)
+        self._record_template("train_step", self._train_step, state, placed)
         with self.compile_tracker.observe("train_step"):
             new_state, metrics = self._train_step(state, placed)
         self.last_step_metrics = metrics
@@ -895,6 +988,7 @@ class Trainer:
         stacked = self._stack_chunk(batches)
         with self._h2d_span():
             placed = self._put_stacked(stacked)
+        self._record_template("train_scan", scan_fn, state, placed)
         with self.compile_tracker.observe("train_scan"):
             new_state, metrics = scan_fn(state, placed)
         # per-step [K] arrays (loss / sentinel good flags / grad norms)
@@ -1020,7 +1114,14 @@ class Trainer:
         ``profile_steps=(start, stop)`` captures a ``jax.profiler`` trace of
         the half-open step window [start, stop) — counted over steps actually
         executed by this fit call — into ``profile_dir`` (default: the first
-        JsonlLogger's ``run_dir/profile``, else ``./jax_profile``).
+        JsonlLogger's ``run_dir/profile``, else ``./jax_profile``). The
+        capture is then parsed (``obs.profile``): per-``jax.named_scope``
+        DEVICE-time attribution (embed/encoder/final_norm/forward/loss) rides
+        ``on_fit_end`` as a ``device_time`` payload, next to a per-program
+        ``roofline`` record (``obs.roofline``: memory- vs compute-bound with
+        the predicted ceiling, static HBM footprint, collective bytes) —
+        rendered by ``obs.report`` as the "device attribution" and "roofline"
+        sections (docs/performance.md "Attribution and roofline").
 
         ``checkpoint_every`` additionally saves MID-epoch every that many steps,
         recording the data-iterator position (epoch + step within the epoch) in
@@ -1464,12 +1565,18 @@ class Trainer:
             return float(lr_schedule(step)) * self._lr_scale
 
         def fit_end_payload() -> Dict[str, Any]:
+            nonlocal profile_active
             payload = {
                 "telemetry": telemetry.summary(),
                 "compile": self.compile_tracker.report(),
                 "peak_memory_bytes": memory.peak_bytes(),
                 "history_len": len(self.history),
             }
+            if memory.observed_samples:
+                # the chunk-boundary sampling window (scan path): THIS fit's
+                # high-water mark, vs the allocator's process-lifetime peak
+                payload["peak_memory_sampled_bytes"] = memory.observed_peak_bytes
+                payload["peak_memory_samples"] = memory.observed_samples
             if state is not None:  # sentinel-skipped updates over the run
                 payload["bad_steps"] = int(state.bad_steps)
             if tracing:
@@ -1477,6 +1584,16 @@ class Trainer:
                 # goodput + THIS fit's per-span totals ride the terminal event
                 payload["goodput"] = trace_window(fit_trace_base, fit_trace_t0)
                 payload["spans"] = fit_spans()
+            if profile_capture_dir is not None:
+                if profile_active:
+                    # a window still open (fit ended/preempted inside it):
+                    # finalize the capture so the attribution reads real data
+                    profile_stack.close()
+                    profile_active = False
+                # per-scope DEVICE-time attribution + per-program roofline
+                # (obs.profile / obs.roofline) — the on-chip half of the
+                # goodput story, joined against this fit's compiled programs
+                payload.update(self._profile_payload(profile_capture_dir))
             return payload
 
         emit(
@@ -1511,6 +1628,7 @@ class Trainer:
 
         profile_stack = contextlib.ExitStack()
         profile_active = False
+        profile_capture_dir: Optional[str] = None  # set when a window opens
         measured_total = 0  # steps actually executed by THIS fit call
         last_emitted_at = 0
         step_base = None  # int(state.step) fetched once; then tracked on host
@@ -1785,8 +1903,9 @@ class Trainer:
                                             trace as _profiler_trace,
                                         )
 
+                                        profile_capture_dir = resolved_profile_dir()
                                         profile_stack.enter_context(
-                                            _profiler_trace(resolved_profile_dir())
+                                            _profiler_trace(profile_capture_dir)
                                         )
                                         profile_active = True
                                     state, loss_value = self.traced_train_step(
@@ -1809,8 +1928,9 @@ class Trainer:
                                             trace as _profiler_trace,
                                         )
 
+                                        profile_capture_dir = resolved_profile_dir()
                                         profile_stack.enter_context(
-                                            _profiler_trace(resolved_profile_dir())
+                                            _profiler_trace(profile_capture_dir)
                                         )
                                         profile_active = True
                                     scan_fn = self._ensure_train_scan()
@@ -1829,6 +1949,9 @@ class Trainer:
                                         if tracing
                                         else contextlib.nullcontext()
                                     )
+                                    self._record_template(
+                                        "train_scan", scan_fn, state, placed
+                                    )
                                     with span_cm as step_span:
                                         with self.compile_tracker.observe("train_scan"):
                                             state, chunk_metrics = scan_fn(state, placed)
@@ -1846,6 +1969,11 @@ class Trainer:
                                         if compile_delta > 0:
                                             trace.carve(step_span, "compile", compile_delta)
                                     self.last_step_metrics = chunk_metrics
+                                    # chunk-boundary HBM sample: the scan path
+                                    # otherwise only snapshots memory per
+                                    # epoch; a CPU backend (no allocator
+                                    # stats) makes this a no-op
+                                    memory.observe()
                                     if step_base is None:
                                         # state.step already sits at the chunk END
                                         step_base = int(state.step) - (measured_total + k)
@@ -1951,7 +2079,8 @@ class Trainer:
                         # aliased: `trace` is the fit-scope Tracer handle
                         from replay_tpu.utils.profiling import trace as _profiler_trace
 
-                        profile_stack.enter_context(_profiler_trace(resolved_profile_dir()))
+                        profile_capture_dir = resolved_profile_dir()
+                        profile_stack.enter_context(_profiler_trace(profile_capture_dir))
                         profile_active = True
                     # traced: loss-fenced span + compile carve; untraced: the
                     # plain async-dispatch step

@@ -11,8 +11,13 @@ throughput (:class:`StepTelemetry`) and achieved-vs-peak FLOPs (:mod:`.mfu`).
 wall-clock go BETWEEN steps — ``trace.json`` + per-epoch phase fractions),
 :mod:`.health` computes in-graph model-health diagnostics (per-group norms and
 update ratios, activation stats, attention entropy, the ``HealthWatcher``
-early warning), and :mod:`.report` is the run-report CLI over the artifacts
-(``python -m replay_tpu.obs.report <run_dir>``). Beyond-parity — SURVEY.md §5.
+early warning), :mod:`.profile` parses ``jax.profiler`` captures into
+per-``named_scope`` DEVICE-time attribution, :mod:`.roofline` classifies every
+compiled program memory- vs compute-bound against the chip's peak FLOPs/
+bandwidth tables (with HBM footprint + collective-bytes introspection via
+:mod:`replay_tpu.parallel.introspect`), and :mod:`.report` is the run-report
+CLI over the artifacts (``python -m replay_tpu.obs.report <run_dir>``).
+Beyond-parity — SURVEY.md §5.
 """
 
 from .collectors import CompileTracker, MemoryMonitor, StepTelemetry
@@ -25,7 +30,22 @@ from .events import (
     TensorBoardLogger,
     TrainerEvent,
 )
-from .mfu import PEAK_BF16_TFLOPS, cost_analysis, flops_per_step, mfu, peak_tflops
+from .mfu import (
+    PEAK_BF16_TFLOPS,
+    cost_analysis,
+    flops_per_step,
+    mfu,
+    peak_tflops,
+    program_costs,
+)
+from .profile import NAMED_SCOPES, attribute_capture, latest_capture, scope_of
+from .roofline import (
+    PEAK_HBM_GBPS,
+    analyze_program,
+    classify,
+    of_ceiling,
+    peak_bandwidth,
+)
 from .trace import (
     GOODPUT_SPANS,
     SERVE_GOODPUT_SPANS,
@@ -44,20 +64,30 @@ __all__ = [
     "JsonlLogger",
     "MemoryMonitor",
     "MultiLogger",
+    "NAMED_SCOPES",
     "PEAK_BF16_TFLOPS",
+    "PEAK_HBM_GBPS",
     "RunLogger",
     "SERVE_GOODPUT_SPANS",
     "StepTelemetry",
     "TensorBoardLogger",
     "Tracer",
     "TrainerEvent",
+    "analyze_program",
+    "attribute_capture",
+    "classify",
     "cost_analysis",
     "flatten_health",
     "flops_per_step",
     "goodput_breakdown",
     "health_metrics",
+    "latest_capture",
     "lifecycle_span",
     "mfu",
+    "of_ceiling",
+    "peak_bandwidth",
     "peak_tflops",
+    "program_costs",
+    "scope_of",
     "traced_iterator",
 ]

@@ -208,6 +208,10 @@ class MemoryMonitor:
 
     def __init__(self, devices=None) -> None:
         self._devices = devices
+        # chunk-boundary sampling (observe()): the windowed high-water mark
+        # over explicit samples, vs the allocator's process-lifetime peak
+        self.observed_peak_bytes: Optional[int] = None
+        self.observed_samples: int = 0
 
     def _resolve(self):
         if self._devices is None:
@@ -242,3 +246,20 @@ class MemoryMonitor:
 
     def bytes_in_use(self) -> Optional[int]:
         return self._max_over_devices("bytes_in_use")
+
+    def observe(self) -> Optional[int]:
+        """Sample the current cross-device peak into the observed window.
+
+        The scan-chunked fit calls this at every chunk boundary (the only
+        points the host touches the loop anyway), so ``observed_peak_bytes``
+        tracks the fit's own HBM high-water mark instead of inheriting an
+        earlier program's process-lifetime peak. CPU-safe: backends without
+        allocator stats return None and the sample is not counted.
+        """
+        peak = self.peak_bytes()
+        if peak is None:
+            return None
+        self.observed_samples += 1
+        if self.observed_peak_bytes is None or peak > self.observed_peak_bytes:
+            self.observed_peak_bytes = peak
+        return peak

@@ -48,6 +48,59 @@ def cost_analysis(jitted_fn: Any, *args, **kwargs) -> Optional[dict]:
     return analysis if isinstance(analysis, dict) else None
 
 
+def program_costs(jitted_fn: Any, *args, **kwargs) -> Optional[dict]:
+    """Everything the static analyses say about one compiled program, from a
+    single ``lower().compile()``: the cost model's ``flops`` / ``bytes
+    accessed`` / ``transcendentals``, ``memory_analysis()``'s argument/output/
+    temp/code byte sizes, and the optimized HLO text (the input to the
+    collective inventory, :mod:`replay_tpu.parallel.introspect`). Fields
+    degrade to absence where a backend offers no analysis; returns None only
+    when compilation itself is unavailable. ``obs.roofline.analyze_program``
+    builds the bound-ness classification on top of this record.
+    """
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+    except Exception:  # best-effort across backends
+        return None
+    return compiled_costs(compiled)
+
+
+def compiled_costs(compiled: Any) -> Optional[dict]:
+    """:func:`program_costs` for an ALREADY-compiled ``jax.stages.Compiled``
+    (AOT executables like CompiledInference buckets — no re-lowering)."""
+    record: dict = {}
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if isinstance(analysis, dict):
+            record["flops"] = float(analysis.get("flops", 0.0)) or None
+            record["bytes_accessed"] = float(analysis.get("bytes accessed", 0.0)) or None
+            if "transcendentals" in analysis:
+                record["transcendentals"] = float(analysis["transcendentals"])
+    except Exception:
+        pass
+    try:
+        memory = compiled.memory_analysis()
+        if memory is not None:
+            record["memory"] = {
+                "argument_bytes": int(getattr(memory, "argument_size_in_bytes", 0)),
+                "output_bytes": int(getattr(memory, "output_size_in_bytes", 0)),
+                "temp_bytes": int(getattr(memory, "temp_size_in_bytes", 0)),
+                "alias_bytes": int(getattr(memory, "alias_size_in_bytes", 0)),
+                "generated_code_bytes": int(
+                    getattr(memory, "generated_code_size_in_bytes", 0)
+                ),
+            }
+    except Exception:
+        pass
+    try:
+        record["hlo_text"] = compiled.as_text()
+    except Exception:
+        pass
+    return record or None
+
+
 def flops_per_step(jitted_fn: Any, *args, extra_flops: float = 0.0, **kwargs) -> Optional[float]:
     """Per-call FLOPs of a compiled step from the XLA cost model.
 

@@ -1,0 +1,237 @@
+"""Device-time attribution: parse a ``jax.profiler`` capture into per-scope time.
+
+The host-side span layer (:mod:`.trace`) answers *where wall-clock goes between
+steps*; this module answers the question "Demystifying BERT" (PAPERS.md) says
+an honest utilization number requires: *where does a step's time go on-chip?*
+``Trainer.fit(profile_steps=(a, b))`` has captured ``jax.profiler`` traces
+since PR 3, and the model/step bodies were labeled with ``jax.named_scope``
+(embed / encoder / final_norm / forward / loss / health) in the same PR — but
+the scopes were write-only: nothing ever read them back. This module closes
+that loop, stdlib-only (gzip + json + re, no jax, no tensorflow):
+
+1. A capture directory holds ``plugins/profile/<run>/<host>.trace.json.gz`` —
+   Chrome trace-event JSON whose XLA-op events carry ``args.hlo_op`` /
+   ``args.hlo_module`` (:func:`latest_capture`, :func:`load_capture`,
+   :func:`device_op_times`).
+2. The scope names live in the *compiled program's* HLO metadata
+   (``metadata={op_name="jit(train_step)/.../jvp(forward)/dot_general"}``):
+   :func:`parse_op_metadata` maps instruction name → op path,
+   :func:`scope_of` extracts the deepest named scope from a path (transform
+   wrappers like ``jvp(forward)`` / ``transpose(jvp(loss))`` are seen
+   through).
+3. :func:`attribute_capture` joins the two: per-scope device seconds +
+   fractions, per-module totals, and an explicit ``unattributed_seconds``
+   (ops outside any named scope — optimizer update, embeddings lookup glue)
+   so the breakdown never silently over-claims.
+
+``Trainer.fit`` runs the join automatically when a profile window was
+captured and attaches the record as a ``device_time`` payload on
+``on_fit_end``; ``obs.report`` renders it as the "device attribution"
+section. The same functions work on real-TPU captures (device planes carry
+the same ``hlo_op`` args) — the CPU-mesh CI path and the v5e path read one
+code.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "NAMED_SCOPES",
+    "attribute_capture",
+    "device_op_times",
+    "hlo_module_name",
+    "latest_capture",
+    "load_capture",
+    "parse_op_metadata",
+    "scope_of",
+]
+
+# the named scopes the trainer/model bodies emit (nn/train.py `forward`/`loss`/
+# `health*`; nn/sequential sasrec `embed`/`encoder`/`final_norm`), in display
+# order. Sub-scopes of `forward` come first so the deepest match wins ties in
+# rendering; matching itself is positional (rightmost segment in the op path).
+NAMED_SCOPES = (
+    "embed",
+    "encoder",
+    "final_norm",
+    "health_logits",
+    "health",
+    "forward",
+    "loss",
+)
+
+# `%dot.5 = f32[...] dot(...), metadata={op_name="jit(f)/jvp(forward)/dot" ...}`
+_METADATA_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s.*?metadata=\{[^}]*"
+    r"op_name=\"(?P<op_name>[^\"]+)\"",
+    re.MULTILINE,
+)
+
+# the dump header: `HloModule jit_train_step, is_scheduled=true, ...` — the
+# same name the profiler emits as the events' `hlo_module` arg
+_MODULE_RE = re.compile(r"^HloModule\s+([\w.\-]+)", re.MULTILINE)
+
+
+def hlo_module_name(hlo_text: str) -> Optional[str]:
+    """The module name of an ``as_text()`` dump, or None without a header."""
+    match = _MODULE_RE.search(hlo_text)
+    return match.group(1) if match else None
+
+
+def latest_capture(profile_dir: str) -> Optional[str]:
+    """Newest ``*.trace.json.gz`` under ``profile_dir`` (the layout
+    ``jax.profiler.start_trace`` writes: ``plugins/profile/<run>/<host>.
+    trace.json.gz``), or None when nothing was captured."""
+    pattern = os.path.join(profile_dir, "plugins", "profile", "*", "*.trace.json.gz")
+    captures = sorted(glob.glob(pattern), key=os.path.getmtime)
+    return captures[-1] if captures else None
+
+
+def load_capture(path: str) -> List[Dict[str, Any]]:
+    """The ``traceEvents`` list of a (gzipped) Chrome trace-event capture."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        payload = json.load(fh)
+    events = payload.get("traceEvents") if isinstance(payload, Mapping) else payload
+    if not isinstance(events, list):
+        msg = f"{path}: no traceEvents list"
+        raise ValueError(msg)
+    return [e for e in events if isinstance(e, Mapping)]
+
+
+def device_op_times(events: Iterable[Mapping[str, Any]]) -> Dict[Tuple[str, str], float]:
+    """Aggregate XLA-op execution events into ``{(module, op): seconds}``.
+
+    An XLA-op event is a complete event (``ph == "X"``) whose args carry
+    ``hlo_op`` — true on CPU host planes and TPU device planes alike; host
+    python/runtime spans carry no ``hlo_op`` and are excluded, so the totals
+    are device(-executor) time, not wall clock.
+    """
+    totals: Dict[Tuple[str, str], float] = {}
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        args = event.get("args")
+        if not isinstance(args, Mapping) or "hlo_op" not in args:
+            continue
+        duration = event.get("dur", 0)
+        if not isinstance(duration, (int, float)) or duration < 0:
+            continue
+        key = (str(args.get("hlo_module", "")), str(args["hlo_op"]))
+        totals[key] = totals.get(key, 0.0) + float(duration) / 1e6
+    return totals
+
+
+def parse_op_metadata(hlo_text: str) -> Dict[str, str]:
+    """``{instruction_name: op_name_path}`` from an HLO ``as_text()`` dump.
+
+    Fusions report the fusion root's ``op_name`` — the same name the profiler
+    emits as ``hlo_op`` for the fused kernel, so the join stays 1:1.
+    """
+    mapping: Dict[str, str] = {}
+    for match in _METADATA_RE.finditer(hlo_text):
+        mapping.setdefault(match.group("name"), match.group("op_name"))
+    return mapping
+
+
+def scope_of(op_path: str, scopes: Sequence[str] = NAMED_SCOPES) -> Optional[str]:
+    """The deepest named scope appearing in an op metadata path.
+
+    Scope labels survive jax transforms wrapped — ``jvp(forward)``,
+    ``transpose(jvp(loss))``, ``remat(encoder)`` — so a scope matches as a
+    whole path segment OR inside transform parentheses. The rightmost
+    (deepest) match wins: an op under ``.../forward/embed/...`` belongs to
+    ``embed``, not ``forward``.
+    """
+    best: Tuple[int, Optional[str]] = (-1, None)
+    for scope in scopes:
+        pattern = re.compile(r"(?:^|/|\()" + re.escape(scope) + r"(?:\)|/|$)")
+        last = None
+        for match in pattern.finditer(op_path):
+            last = match
+        if last is not None and last.start() > best[0]:
+            best = (last.start(), scope)
+    return best[1]
+
+
+def attribute_capture(
+    profile_dir: str,
+    hlo_texts: Optional[Mapping[str, str] | str] = None,
+    scopes: Sequence[str] = NAMED_SCOPES,
+) -> Dict[str, Any]:
+    """Join a profiler capture with compiled-program metadata → per-scope time.
+
+    :param profile_dir: the directory handed to ``jax.profiler.start_trace``
+        (``Trainer.fit``'s ``profile_dir``).
+    :param hlo_texts: compiled HLO ``as_text()`` dumps to resolve scopes
+        against — a single string or ``{label: text}`` (one per compiled
+        program that ran in the window). None attributes nothing (every op
+        lands in ``unattributed_seconds``) but still totals device time.
+    :returns: ``{"capture", "total_device_seconds", "modules": {module:
+        seconds}, "scopes": {scope: {"seconds", "fraction"}},
+        "attributed_seconds", "unattributed_seconds"}`` — fractions are of
+        total device time, and attributed + unattributed == total by
+        construction.
+    :raises FileNotFoundError: no capture under ``profile_dir``.
+    """
+    capture = latest_capture(profile_dir)
+    if capture is None:
+        msg = f"{profile_dir}: no jax.profiler capture (plugins/profile/*/*.trace.json.gz)"
+        raise FileNotFoundError(msg)
+    op_times = device_op_times(load_capture(capture))
+
+    texts: Dict[str, str]
+    if hlo_texts is None:
+        texts = {}
+    elif isinstance(hlo_texts, str):
+        texts = {"program": hlo_texts}
+    else:
+        texts = dict(hlo_texts)
+    # instruction names are MODULE-LOCAL counters (`fusion.3` exists in both
+    # the step and the scan program with different op paths), so the join is
+    # keyed per module — the flat map is only the fallback for events whose
+    # hlo_module has no parsed header (renamed/suffixed SPMD modules)
+    paths_by_module: Dict[str, Dict[str, str]] = {}
+    op_paths: Dict[str, str] = {}
+    for text in texts.values():
+        parsed = parse_op_metadata(text)
+        module_name = hlo_module_name(text)
+        if module_name is not None:
+            paths_by_module.setdefault(module_name, {}).update(parsed)
+        for name, op_path in parsed.items():
+            op_paths.setdefault(name, op_path)
+
+    total = 0.0
+    modules: Dict[str, float] = {}
+    scope_seconds: Dict[str, float] = {}
+    attributed = 0.0
+    for (module, op), seconds in op_times.items():
+        total += seconds
+        modules[module] = modules.get(module, 0.0) + seconds
+        op_path = paths_by_module[module].get(op) if module in paths_by_module else op_paths.get(op)
+        scope = scope_of(op_path, scopes) if op_path else None
+        if scope is not None:
+            scope_seconds[scope] = scope_seconds.get(scope, 0.0) + seconds
+            attributed += seconds
+    ordered = {
+        scope: {
+            "seconds": scope_seconds[scope],
+            "fraction": scope_seconds[scope] / total if total > 0 else 0.0,
+        }
+        for scope in (*scopes, *sorted(set(scope_seconds) - set(scopes)))
+        if scope in scope_seconds
+    }
+    return {
+        "capture": capture,
+        "total_device_seconds": total,
+        "modules": modules,
+        "scopes": ordered,
+        "attributed_seconds": attributed,
+        "unattributed_seconds": max(total - attributed, 0.0),
+    }

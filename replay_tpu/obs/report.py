@@ -8,15 +8,22 @@
 Turns the telemetry artifacts every trainer/bench/dry run leaves behind into
 the one-page answer "Demystifying BERT" (PAPERS.md) says a profile must
 become: throughput, MFU, the goodput breakdown (where wall-clock went between
-steps), retraces, bad/recovered steps, the model-health record
+steps), the DEVICE-time attribution (obs.profile: per-``named_scope`` on-chip
+time from a profiled fit) and per-program roofline records (obs.roofline:
+memory- vs compute-bound, predicted ceiling, HBM footprint, collective
+bytes), retraces, bad/recovered steps, the model-health record
 (obs.health: per-group norms/update ratios, activation stats, attention
 entropy, early warnings), and the serving summary (replay_tpu.serve /
 bench_serve.py: QPS, latency percentiles, batch fill, cache hit rate —
 gated on QPS drops and p99 growth). ``--compare`` diffs two runs —
 either run may be a run directory, a raw ``events.jsonl``, or a single-record
 bench JSON (``BENCH_*.json`` / ``BENCH_TPU_SIDECAR.json``) — and exits
-non-zero when the candidate regresses beyond ``--threshold`` (relative), so
-CI can gate on it.
+non-zero when the candidate regresses beyond ``--threshold`` (relative):
+throughput/MFU drops, new retraces, ``peak_memory_bytes`` growth beyond
+``--memory-threshold``, ``compile_seconds`` growth beyond
+``--compile-threshold``, and per-bench-row throughput (rows with an ``error``
+field — by-design OOM evidence — are skipped, not tripped on), so CI can
+gate on it.
 
 Import-light by design (stdlib only): the CLI must run in seconds with no
 jax/device involvement, and a malformed artifact must fail loudly (non-zero
@@ -180,6 +187,15 @@ def summarize_events(
 
     fit_end = fit_ends[-1] if fit_ends else {}
     telemetry = fit_end.get("telemetry") or {}
+    # on-chip observability (obs.profile / obs.roofline): the per-named-scope
+    # device-time attribution and per-program roofline records a profiled fit
+    # attaches to its terminal event
+    summary["device_time"] = (
+        dict(fit_end["device_time"]) if isinstance(fit_end.get("device_time"), Mapping) else None
+    )
+    summary["roofline"] = (
+        dict(fit_end["roofline"]) if isinstance(fit_end.get("roofline"), Mapping) else None
+    )
     summary["bad_steps"] = fit_end.get("bad_steps")
     if summary["bad_steps"] is None:
         # crashed/killed runs have no on_fit_end: the epoch-end rollup is the
@@ -301,17 +317,40 @@ def summarize_events(
                 "row", "samples_per_sec", "step_ms", "scan_k", "mfu",
                 "mfu_peak_assumed", "tflops_per_sec", "num_items", "d", "B",
                 "L", "loss", "model_parallel", "backend", "error",
+                # static program analyses (obs.roofline / parallel.introspect)
+                "roofline_bound", "roofline_ceiling_tflops",
+                "of_roofline_ceiling", "arithmetic_intensity",
+                "hbm_peak_bytes", "collective_bytes", "peak_memory_bytes",
             )
             if key in record
         }
         for record in bench_rows
     ] or None
 
+    # peak device memory: fit telemetry first, then the bench record, then the
+    # largest non-error suite row — the --compare lower-better gate's input
+    peak_memory = _finite(fit_end.get("peak_memory_bytes"))
+    if peak_memory is None and bench:
+        peak_memory = _finite(bench[-1].get("peak_memory_bytes"))
+    if peak_memory is None and bench_rows:
+        row_peaks = [
+            value
+            for row in bench_rows
+            if not row.get("error")
+            for value in [_finite(row.get("peak_memory_bytes"))]
+            if value is not None
+        ]
+        peak_memory = max(row_peaks) if row_peaks else None
+    summary["peak_memory_bytes"] = peak_memory
+
     if dryruns:
         record = dryruns[-1]
         summary["dryrun"] = {
             key: record.get(key)
-            for key in ("mesh", "losses", "psum", "sp_ring_err", "spans", "backend")
+            for key in (
+                "mesh", "losses", "psum", "sp_ring_err", "spans", "backend",
+                "collectives", "sharding",
+            )
             if key in record
         }
 
@@ -484,6 +523,54 @@ def render(summary: Mapping[str, Any]) -> str:
             f"{name} {entry['seconds']:.2f}s x{entry['count']}" for name, entry in top
         )
         lines.append(f"  trace.json: {sum(e['count'] for e in trace.values())} span(s): {shown}")
+    device_time = summary.get("device_time")
+    if device_time:
+        total = _finite(device_time.get("total_device_seconds")) or 0.0
+        scopes = device_time.get("scopes") or {}
+        parts = [
+            f"{scope} {100.0 * float((entry or {}).get('fraction', 0.0)):.1f}%"
+            for scope, entry in scopes.items()
+            if isinstance(entry, Mapping)
+        ]
+        unattributed = _finite(device_time.get("unattributed_seconds"))
+        if unattributed is not None and total > 0:
+            parts.append(f"unattributed {100.0 * unattributed / total:.1f}%")
+        lines.append(
+            f"  device attribution ({1000.0 * total:.1f} ms device time in the "
+            "profiled window): " + (" · ".join(parts) if parts else "no scopes resolved")
+        )
+    roofline = summary.get("roofline")
+    if roofline:
+        lines.append("  roofline:")
+        for program, record in sorted(roofline.items()):
+            if not isinstance(record, Mapping):
+                continue
+            classification = record.get("roofline") or {}
+            parts = []
+            if classification.get("bound"):
+                assumed = classification.get("peak_assumed")
+                parts.append(
+                    f"{classification['bound']}-bound"
+                    + (f" (assumed {assumed} peaks)" if assumed else "")
+                )
+                intensity = _finite(classification.get("arithmetic_intensity"))
+                critical = _finite(classification.get("critical_intensity"))
+                if intensity is not None and critical is not None:
+                    parts.append(
+                        f"intensity {intensity:.1f} flops/B (critical {critical:.1f})"
+                    )
+                ceiling = _finite(classification.get("ceiling_tflops"))
+                if ceiling is not None:
+                    parts.append(f"ceiling {ceiling:.3g} TFLOP/s")
+            else:
+                parts.append("unclassified (no chip peaks)")
+            peak = _finite(record.get("hbm_peak_bytes"))
+            if peak is not None:
+                parts.append(f"peak HBM {peak / 1e6:.1f} MB")
+            collective = _finite(record.get("collective_bytes"))
+            if collective is not None:
+                parts.append(f"collectives {collective / 1e6:.2f} MB")
+            lines.append(f"    {program}: " + " · ".join(parts))
     dryrun = summary.get("dryrun")
     if dryrun:
         lines.append(
@@ -496,6 +583,31 @@ def render(summary: Mapping[str, Any]) -> str:
                 for name, entry in sorted(dryrun["spans"].items())
             )
             lines.append(f"  dryrun spans: {shown}")
+        collectives = dryrun.get("collectives")
+        if isinstance(collectives, Mapping):
+            for program, entry in sorted(collectives.items()):
+                if not isinstance(entry, Mapping):
+                    continue
+                by_op = entry.get("by_op") or {}
+                shown = " · ".join(
+                    f"{op} x{stats.get('count')} ({(stats.get('bytes') or 0) / 1e3:.1f} kB)"
+                    for op, stats in sorted(by_op.items())
+                    if isinstance(stats, Mapping)
+                )
+                lines.append(
+                    f"  collectives[{program}]: {entry.get('count')} op(s), "
+                    f"{(entry.get('bytes') or 0) / 1e3:.1f} kB: {shown}"
+                )
+        sharding = dryrun.get("sharding")
+        if isinstance(sharding, Mapping):
+            flags = sharding.get("flags") or []
+            lines.append(
+                f"  sharding: {(sharding.get('sharded_bytes') or 0) / 1e3:.1f} kB "
+                f"sharded · {(sharding.get('replicated_bytes') or 0) / 1e3:.1f} kB "
+                f"replicated · {len(flags)} flag(s)"
+            )
+            for flag in flags:
+                lines.append(f"    FLAG: {flag}")
     bench = summary.get("bench")
     if bench:
         lines.append(
@@ -538,6 +650,18 @@ def render(summary: Mapping[str, Any]) -> str:
                 parts.append(f"items {row['num_items']}")
             if row.get("loss"):
                 parts.append(str(row["loss"]))
+            if row.get("roofline_bound"):
+                bound = f"{row['roofline_bound']}-bound"
+                of_ceiling = _finite(row.get("of_roofline_ceiling"))
+                if of_ceiling is not None:
+                    bound += f" ({100.0 * of_ceiling:.0f}% of ceiling)"
+                parts.append(bound)
+            hbm = _finite(row.get("hbm_peak_bytes"))
+            if hbm is not None:
+                parts.append(f"HBM {hbm / 1e6:.1f} MB")
+            collective = _finite(row.get("collective_bytes"))
+            if collective:
+                parts.append(f"coll {collective / 1e6:.2f} MB")
             lines.append(f"    {row.get('row')}: " + " · ".join(parts))
     serve = summary.get("serve")
     if serve:
@@ -574,12 +698,25 @@ def compare_runs(
     candidate: Mapping[str, Any],
     baseline: Mapping[str, Any],
     threshold: float = 0.1,
+    memory_threshold: Optional[float] = None,
+    compile_threshold: Optional[float] = None,
 ) -> Tuple[List[str], List[str]]:
     """(report lines, regression lines) for candidate vs baseline.
 
     A regression is a relative drop beyond ``threshold`` in throughput or MFU,
-    or new retraces — the three signals TurboGR-style goodput work optimizes.
+    new retraces, or a LOWER-better metric growing past its own threshold:
+    ``peak_memory_bytes`` beyond ``memory_threshold`` (default: ``threshold``)
+    and ``compile_seconds`` beyond ``compile_threshold`` (default:
+    ``max(threshold, 0.5)`` — compile wall-time is machine-noisy, so the gate
+    only catches step-function growth like a new compiled variant). Bench-suite
+    rows compare per row name; rows carrying an ``error`` field on either side
+    are skipped (the by-design 1M plain-CE OOM row must not trip the gate),
+    but a row that errors ONLY in the candidate is a regression.
     """
+    if memory_threshold is None:
+        memory_threshold = threshold
+    if compile_threshold is None:
+        compile_threshold = max(threshold, 0.5)
     lines: List[str] = [
         f"Compare — candidate {candidate.get('source')} vs baseline {baseline.get('source')}"
     ]
@@ -595,6 +732,22 @@ def compare_runs(
         )
         if base > 0 and cand < base * (1.0 - threshold):
             regressions.append(f"{name} regressed {-delta:.1%} (> {threshold:.0%} threshold)")
+
+    def check_lower_better(
+        name: str, cand: Optional[float], base: Optional[float], limit: float, unit: str = ""
+    ) -> None:
+        if cand is None or base is None:
+            lines.append(
+                f"  {name}: candidate={_fmt(cand, '{:.3f}')} "
+                f"baseline={_fmt(base, '{:.3f}')} (not comparable)"
+            )
+            return
+        delta = (cand - base) / base if base else 0.0
+        lines.append(f"  {name}: {cand:.3f}{unit} vs {base:.3f}{unit} ({delta:+.1%})")
+        if base > 0 and cand > base * (1.0 + limit):
+            regressions.append(
+                f"{name} regressed {delta:+.1%} (> {limit:.0%} threshold, lower is better)"
+            )
 
     check("samples_per_sec", candidate.get("samples_per_sec"), baseline.get("samples_per_sec"))
     check("steps_per_sec", candidate.get("steps_per_sec"), baseline.get("steps_per_sec"))
@@ -624,6 +777,52 @@ def compare_runs(
             regressions.append(
                 f"retraces increased {base_retraces} -> {cand_retraces} (shape leak?)"
             )
+    # lower-better resource gates: device-memory growth is a capacity
+    # regression even at held throughput; compile-time growth is the "one
+    # more compiled variant slipped in" signal
+    if candidate.get("peak_memory_bytes") is not None or baseline.get("peak_memory_bytes") is not None:
+        check_lower_better(
+            "peak_memory_bytes",
+            _finite(candidate.get("peak_memory_bytes")),
+            _finite(baseline.get("peak_memory_bytes")),
+            memory_threshold,
+        )
+    if candidate.get("compile_seconds") is not None or baseline.get("compile_seconds") is not None:
+        check_lower_better(
+            "compile_seconds",
+            _finite(candidate.get("compile_seconds")),
+            _finite(baseline.get("compile_seconds")),
+            compile_threshold,
+            unit="s",
+        )
+    # bench-suite rows: per-row throughput gates keyed by row name; error
+    # rows (the by-design OOM evidence) are reported but never gated — except
+    # a NEW error where the baseline measured, which IS the regression
+    cand_rows = {
+        row.get("row"): row for row in (candidate.get("bench_rows") or []) if row.get("row")
+    }
+    base_rows = {
+        row.get("row"): row for row in (baseline.get("bench_rows") or []) if row.get("row")
+    }
+    for name in sorted(set(cand_rows) & set(base_rows)):
+        cand_row, base_row = cand_rows[name], base_rows[name]
+        if base_row.get("error"):
+            lines.append(f"  bench_row[{name}]: skipped (baseline error row)")
+            continue
+        if cand_row.get("error"):
+            lines.append(
+                f"  bench_row[{name}]: candidate ERROR {cand_row['error']} "
+                "(baseline measured)"
+            )
+            regressions.append(
+                f"bench_row[{name}] errored in the candidate but measured in the baseline"
+            )
+            continue
+        check(
+            f"bench_row[{name}].samples_per_sec",
+            _finite(cand_row.get("samples_per_sec")),
+            _finite(base_row.get("samples_per_sec")),
+        )
     # anomaly-count gates: a run that skips more steps (or warns more) than
     # its baseline regressed in stability even when throughput held
     for name, label in (
@@ -706,6 +905,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="relative regression threshold for --compare (default 0.1 = 10%%)",
     )
     parser.add_argument(
+        "--memory-threshold",
+        type=float,
+        default=None,
+        help="relative growth threshold for peak_memory_bytes (lower-better "
+        "gate; default: --threshold)",
+    )
+    parser.add_argument(
+        "--compile-threshold",
+        type=float,
+        default=None,
+        help="relative growth threshold for compile_seconds (lower-better "
+        "gate; default: max(--threshold, 0.5) — compile time is machine-noisy)",
+    )
+    parser.add_argument(
         "--json", action="store_true", help="emit the summary as JSON instead of text"
     )
     args = parser.parse_args(argv)
@@ -727,7 +940,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except (OSError, ValueError) as exc:
             print(f"report: cannot parse {args.compare}: {exc}", file=sys.stderr)
             return 1
-        lines, regressions = compare_runs(summary, baseline, threshold=args.threshold)
+        lines, regressions = compare_runs(
+            summary,
+            baseline,
+            threshold=args.threshold,
+            memory_threshold=args.memory_threshold,
+            compile_threshold=args.compile_threshold,
+        )
         print()
         print("\n".join(lines))
         if regressions:

@@ -1,11 +1,21 @@
 from .distributed import initialize_distributed, replicas_info
+from .introspect import (
+    collective_bytes,
+    collective_inventory,
+    sharding_report,
+    summarize_collectives,
+)
 from .ring import full_attention_reference, ring_attention
 from .sharded_ce import sharded_fused_lse
 
 __all__ = [
+    "collective_bytes",
+    "collective_inventory",
     "full_attention_reference",
     "initialize_distributed",
     "replicas_info",
     "ring_attention",
+    "sharding_report",
     "sharded_fused_lse",
+    "summarize_collectives",
 ]

@@ -17,15 +17,40 @@ from typing import Optional
 
 
 @contextlib.contextmanager
-def trace(log_dir: str, *, create_perfetto_link: bool = False):
-    """Capture a device trace of the enclosed region."""
+def trace(log_dir: str, *, create_perfetto_link: bool = False,
+          python_tracer_level: int = 0):
+    """Capture a device trace of the enclosed region.
+
+    ``python_tracer_level=0`` (the default) keeps python-frame events OUT of
+    the capture: a busy host loop (the scan-chunked fit's feeder + accounting
+    threads) emits millions of them, flooding the profiler's event cap and
+    dropping the XLA op events that ``obs.profile``'s device-time attribution
+    needs. jax's public ``start_trace`` pins the level to 1, so when the
+    xla_client ProfileOptions API is available the session is driven directly
+    (same export layout); otherwise this degrades to the public API.
+    """
     import jax
 
-    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    session = None
+    if not create_perfetto_link:
+        try:
+            from jax._src.lib import xla_client
+
+            options = xla_client.profiler.ProfileOptions()
+            options.python_tracer_level = int(python_tracer_level)
+            jax.devices()  # TPU: libtpu must initialize BEFORE the tracer
+            session = xla_client.profiler.ProfilerSession(options)
+        except Exception:
+            session = None
+    if session is None:
+        jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if session is not None:
+            session.export(session.stop(), str(log_dir))
+        else:
+            jax.profiler.stop_trace()
 
 
 class StepTimer:

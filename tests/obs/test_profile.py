@@ -1,0 +1,297 @@
+"""Device-time attribution (obs.profile): parsing, the scope join, and the
+profiled-fit smoke that produces the CI artifact.
+
+Core tier covers the stdlib-only pieces on synthetic captures/HLO text (no
+jax): capture discovery, op-time aggregation, metadata parsing, scope
+extraction through transform wrappers, and the attribution invariants
+(attributed + unattributed == total). The jax smoke drives
+``Trainer.fit(profile_steps=...)`` end-to-end on the virtual 8-device mesh
+and asserts the capture parses, the named-scope attribution sums to ≤ the
+total step device time with finite fractions, and the ``device_time`` /
+``roofline`` payloads land on ``on_fit_end`` (the run_logs/profile_smoke
+artifact CI renders and uploads).
+"""
+
+import gzip
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from replay_tpu.obs.profile import (
+    NAMED_SCOPES,
+    attribute_capture,
+    device_op_times,
+    latest_capture,
+    load_capture,
+    parse_op_metadata,
+    scope_of,
+)
+
+_HLO_TEXT = """
+HloModule jit_train_step
+
+%fused_computation (param_0: f32[8,16]) -> f32[8,16] {
+  ROOT %tanh.0 = f32[8,16] tanh(f32[8,16] %param_0), metadata={op_name="jit(train_step)/jit(main)/jvp(forward)/jvp(encoder)/tanh" source_file="model.py" source_line=1}
+}
+
+ENTRY %main {
+  %dot.5 = f32[8,16]{1,0} dot(f32[8,32]{1,0} %Arg_0.1, f32[32,16]{1,0} %Arg_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(train_step)/jit(main)/jvp(forward)/jvp(embed)/dot_general" source_file="model.py" source_line=2}
+  %loss_fusion = f32[8]{0} fusion(f32[8,16]{1,0} %dot.5), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(train_step)/jit(main)/transpose(jvp(loss))/reduce_sum" source_file="loss.py" source_line=3}
+  ROOT %dot.12 = f32[8,32]{1,0} dot(f32[8,16]{1,0} %loss_fusion, f32[32,16]{1,0} %Arg_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={1}, metadata={op_name="jit(train_step)/jit(main)/transpose(jvp(forward))/jvp(encoder)/dot_general" source_file="model.py" source_line=2}
+}
+"""
+
+
+def _write_capture(root, events, run="2026_01_01_00_00_00", host="testhost"):
+    directory = os.path.join(root, "plugins", "profile", run)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{host}.trace.json.gz")
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return path
+
+
+def _op_event(name, dur_us, module="jit_train_step", tid=1):
+    return {
+        "ph": "X", "pid": 7, "tid": tid, "ts": 0.0, "dur": dur_us,
+        "name": name, "args": {"hlo_module": module, "hlo_op": name},
+    }
+
+
+# --------------------------------------------------------------------------- #
+# core: parsing + scope extraction
+# --------------------------------------------------------------------------- #
+@pytest.mark.core
+def test_scope_of_sees_through_transform_wrappers():
+    assert scope_of("jit(f)/jit(main)/jvp(forward)/dot_general") == "forward"
+    assert scope_of("jit(f)/jit(main)/transpose(jvp(loss))/add_any") == "loss"
+    assert scope_of("jit(f)/remat(encoder)/dot_general") == "encoder"
+    # the deepest (rightmost) scope wins: embed nests inside forward
+    assert scope_of("jit(f)/jvp(forward)/jvp(embed)/gather") == "embed"
+    assert scope_of("jit(f)/jit(main)/broadcast") is None
+    # substrings must not match ("forward_inference" is not "forward")
+    assert scope_of("jit(f)/forward_inference/dot") is None
+
+
+@pytest.mark.core
+def test_parse_op_metadata_maps_instruction_to_op_path():
+    mapping = parse_op_metadata(_HLO_TEXT)
+    assert mapping["dot.5"].endswith("jvp(embed)/dot_general")
+    assert mapping["loss_fusion"].endswith("transpose(jvp(loss))/reduce_sum")
+    assert mapping["dot.12"].endswith("jvp(encoder)/dot_general")  # ROOT line parses
+    assert mapping["tanh.0"].endswith("jvp(encoder)/tanh")
+
+
+@pytest.mark.core
+def test_device_op_times_filters_to_hlo_events():
+    events = [
+        _op_event("dot.5", 100.0),
+        _op_event("dot.5", 50.0, tid=2),  # same op, another executor thread
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0, "dur": 999.0, "name": "python-frame"},
+        {"ph": "M", "pid": 7, "name": "process_name", "args": {"name": "/host:CPU"}},
+    ]
+    totals = device_op_times(events)
+    assert totals == {("jit_train_step", "dot.5"): pytest.approx(150e-6)}
+
+
+@pytest.mark.core
+def test_latest_capture_picks_newest_and_handles_missing(tmp_path):
+    assert latest_capture(str(tmp_path)) is None
+    older = _write_capture(str(tmp_path), [], run="2026_01_01_00_00_00")
+    newer = _write_capture(str(tmp_path), [], run="2026_01_02_00_00_00")
+    os.utime(older, (1, 1))
+    assert latest_capture(str(tmp_path)) == newer
+    assert load_capture(newer) == []
+
+
+@pytest.mark.core
+def test_attribute_capture_joins_scopes_and_balances(tmp_path):
+    _write_capture(
+        str(tmp_path),
+        [
+            _op_event("dot.5", 100.0),       # embed
+            _op_event("loss_fusion", 40.0),  # loss
+            _op_event("dot.12", 60.0),       # encoder (bwd)
+            _op_event("unknown_op.3", 30.0), # no metadata -> unattributed
+        ],
+    )
+    record = attribute_capture(str(tmp_path), _HLO_TEXT)
+    assert record["total_device_seconds"] == pytest.approx(230e-6)
+    scopes = record["scopes"]
+    assert scopes["embed"]["seconds"] == pytest.approx(100e-6)
+    assert scopes["loss"]["seconds"] == pytest.approx(40e-6)
+    assert scopes["encoder"]["seconds"] == pytest.approx(60e-6)
+    assert record["unattributed_seconds"] == pytest.approx(30e-6)
+    assert record["attributed_seconds"] + record["unattributed_seconds"] == pytest.approx(
+        record["total_device_seconds"]
+    )
+    fractions = sum(entry["fraction"] for entry in scopes.values())
+    assert 0.0 < fractions <= 1.0 + 1e-9
+    # display order follows NAMED_SCOPES
+    assert list(scopes) == [s for s in NAMED_SCOPES if s in scopes]
+
+
+@pytest.mark.core
+def test_attribution_join_is_module_keyed(tmp_path):
+    """Instruction names are module-local counters: the SAME name in two
+    programs must resolve through its OWN module's op path, not first-wins."""
+    step_hlo = (
+        "HloModule jit_step, is_scheduled=true\n"
+        "ENTRY %main {\n"
+        '  %fusion.3 = f32[8]{0} fusion(%p0), kind=kLoop, calls=%fc, metadata={op_name="jit(step)/jvp(encoder)/add" source_file="m.py" source_line=1}\n'
+        "}\n"
+    )
+    scan_hlo = (
+        "HloModule jit_scan, is_scheduled=true\n"
+        "ENTRY %main {\n"
+        '  %fusion.3 = f32[8]{0} fusion(%p0), kind=kLoop, calls=%fc, metadata={op_name="jit(scan)/transpose(jvp(loss))/add" source_file="l.py" source_line=2}\n'
+        "}\n"
+    )
+    _write_capture(
+        str(tmp_path),
+        [
+            _op_event("fusion.3", 100.0, module="jit_step"),
+            _op_event("fusion.3", 40.0, module="jit_scan"),
+        ],
+    )
+    record = attribute_capture(
+        str(tmp_path), {"train_step": step_hlo, "train_scan": scan_hlo}
+    )
+    assert record["scopes"]["encoder"]["seconds"] == pytest.approx(100e-6)
+    assert record["scopes"]["loss"]["seconds"] == pytest.approx(40e-6)
+
+
+@pytest.mark.core
+def test_attribute_capture_without_capture_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        attribute_capture(str(tmp_path / "nowhere"))
+
+
+@pytest.mark.core
+def test_attribute_capture_without_hlo_attributes_nothing(tmp_path):
+    _write_capture(str(tmp_path), [_op_event("dot.5", 10.0)])
+    record = attribute_capture(str(tmp_path), None)
+    assert record["scopes"] == {}
+    assert record["unattributed_seconds"] == pytest.approx(record["total_device_seconds"])
+
+
+# --------------------------------------------------------------------------- #
+# jax smoke: the profiled fit end-to-end (CI's profile_smoke artifact)
+# --------------------------------------------------------------------------- #
+def _run_dir(tmp_path, name):
+    base = os.environ.get("REPLAY_TPU_RUN_DIR")
+    return os.path.join(base, name) if base else str(tmp_path / name)
+
+
+def _make_trainer(num_items=50, seq_len=8, dim=16):
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec import SasRec
+
+    schema = TensorSchema(
+        TensorFeatureInfo(
+            "item_id", FeatureType.CATEGORICAL, is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+            embedding_dim=dim,
+        )
+    )
+    model = SasRec(schema=schema, embedding_dim=dim, num_blocks=1, num_heads=1,
+                   max_sequence_length=seq_len)
+    return Trainer(model=model, loss=CE(),
+                   optimizer=OptimizerFactory(learning_rate=1e-2), mesh=make_mesh())
+
+
+def _make_batches(n, num_items=50, seq_len=8, batch=8, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        items = rng.integers(0, num_items, size=(batch, seq_len + 1)).astype(np.int32)
+        mask = np.ones((batch, seq_len), dtype=bool)
+        out.append({
+            "feature_tensors": {"item_id": items[:, :-1]},
+            "padding_mask": mask,
+            "positive_labels": items[:, 1:, None],
+            "target_padding_mask": mask[:, :, None],
+        })
+    return out
+
+
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_profiled_fit_attributes_device_time(tmp_path, monkeypatch):
+    from replay_tpu.obs import JsonlLogger
+
+    # classify against an assumed chip on the CPU mesh (arithmetic, flagged)
+    monkeypatch.setenv("REPLAY_TPU_ROOFLINE_ASSUME_KIND", "v5e")
+    trainer = _make_trainer()
+    batches = _make_batches(5)
+    run_dir = _run_dir(tmp_path, "profile_smoke")
+    # mode="w": REPLAY_TPU_RUN_DIR is a fixed path in CI — re-runs must not append
+    with JsonlLogger(run_dir, mode="w") as sink:
+        trainer.fit(batches, epochs=1, loggers=sink, log_every=0,
+                    profile_steps=(1, 4), scan_chunk=2)
+
+    profile_dir = os.path.join(run_dir, "profile")
+    assert latest_capture(profile_dir) is not None, "no parseable capture produced"
+
+    events = [json.loads(line) for line in open(os.path.join(run_dir, "events.jsonl"))]
+    fit_end = [e for e in events if e["event"] == "on_fit_end"][-1]
+    device_time = fit_end["device_time"]
+    total = device_time["total_device_seconds"]
+    assert total > 0.0
+    scopes = device_time["scopes"]
+    assert scopes, "no named scope resolved from the capture"
+    # the attribution must not over-claim: scope sum <= total step device time
+    attributed = sum(entry["seconds"] for entry in scopes.values())
+    assert attributed <= total * (1.0 + 1e-9)
+    assert device_time["attributed_seconds"] == pytest.approx(attributed)
+    for entry in scopes.values():
+        assert math.isfinite(entry["fraction"]) and 0.0 <= entry["fraction"] <= 1.0
+    # the model-body scopes landed in PR 3 are now READ back
+    assert {"encoder", "loss"} <= set(scopes)
+
+    # the roofline payload rides the same event: both dispatched programs
+    # classified, the full-CE step memory-bound under the assumed v5e peaks
+    roofline = fit_end["roofline"]
+    assert {"train_step", "train_scan"} <= set(roofline)
+    for record in roofline.values():
+        assert record["hbm_peak_bytes"] > 0
+        classification = record["roofline"]
+        assert classification["bound"] == "memory"
+        assert classification["peak_assumed"] == "v5e"
+        assert 0.0 < classification["ceiling_tflops"] <= classification["peak_tflops"]
+
+
+@pytest.mark.jax
+def test_profiled_per_step_fit_attribution_and_window(tmp_path):
+    """The per-step (unchunked) path: window [1, 3) opens/closes inside the
+    fit and the attribution still resolves scopes."""
+    trainer = _make_trainer()
+    batches = _make_batches(4)
+    profile_dir = str(tmp_path / "prof")
+    trainer.fit(batches, epochs=1, log_every=0, profile_steps=(1, 3),
+                profile_dir=profile_dir)
+    record = attribute_capture(profile_dir, trainer.lowered_hlo("train_step"))
+    assert record["total_device_seconds"] > 0.0
+    assert record["scopes"], record
+
+
+@pytest.mark.jax
+def test_analyze_programs_and_lowered_hlo_roundtrip():
+    trainer = _make_trainer()
+    batches = _make_batches(1)
+    state = trainer.init_state(batches[0])
+    trainer.train_step(state, batches[0])
+    hlo = trainer.lowered_hlo("train_step")
+    assert "op_name" in hlo  # metadata survives for the attribution join
+    with pytest.raises(KeyError):
+        trainer.lowered_hlo("train_scan")  # never dispatched
+    records = trainer.analyze_programs()
+    assert "train_step" in records
+    assert records["train_step"]["hbm_peak_bytes"] > 0
+    assert records["train_step"]["collectives"]["count"] >= 0

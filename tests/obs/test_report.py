@@ -405,3 +405,178 @@ def test_compare_serve_within_threshold_passes(tmp_path):
     baseline = _write_serve_run(str(tmp_path / "base"), qps=250.0, p99_ms=4.0)
     candidate = _write_serve_run(str(tmp_path / "cand"), qps=240.0, p99_ms=4.3)
     assert main([candidate, "--compare", baseline]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# resource gates: peak memory + compile time (lower-better), bench-row skips
+# --------------------------------------------------------------------------- #
+def _write_resource_run(path, peak_memory=1_000_000, compile_seconds=2.0):
+    os.makedirs(path, exist_ok=True)
+    events = [
+        {"event": "on_fit_start", "time": 1.0, "epoch": 0, "epochs": 1},
+        {"event": "on_train_step", "time": 2.0, "step": 1, "epoch": 0, "loss": 1.0,
+         "samples_per_sec": 500.0, "steps_per_sec": 62.5},
+        {"event": "on_fit_end", "time": 3.0, "step": 1,
+         "telemetry": {"steps": 1.0, "elapsed_seconds": 0.1,
+                       "steps_per_sec": 62.5, "samples_per_sec": 500.0},
+         "compile": {"train_step": {"traces": 1, "compile_seconds": compile_seconds}},
+         "peak_memory_bytes": peak_memory, "history_len": 1, "bad_steps": 0},
+    ]
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+    return path
+
+
+def test_compare_gates_on_peak_memory_growth(tmp_path, capsys):
+    baseline = _write_resource_run(str(tmp_path / "base"), peak_memory=1_000_000)
+    candidate = _write_resource_run(str(tmp_path / "cand"), peak_memory=1_300_000)
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "peak_memory_bytes regressed" in capsys.readouterr().err
+
+
+def test_compare_peak_memory_within_threshold_passes(tmp_path):
+    baseline = _write_resource_run(str(tmp_path / "base"), peak_memory=1_000_000)
+    candidate = _write_resource_run(str(tmp_path / "cand"), peak_memory=1_050_000)
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+def test_compare_memory_threshold_is_tunable(tmp_path):
+    baseline = _write_resource_run(str(tmp_path / "base"), peak_memory=1_000_000)
+    candidate = _write_resource_run(str(tmp_path / "cand"), peak_memory=1_300_000)
+    assert main([candidate, "--compare", baseline, "--memory-threshold", "0.5"]) == 0
+
+
+def test_compare_gates_on_compile_time_growth(tmp_path, capsys):
+    baseline = _write_resource_run(str(tmp_path / "base"), compile_seconds=2.0)
+    # compile gate defaults to max(threshold, 0.5): +60% trips it
+    candidate = _write_resource_run(str(tmp_path / "cand"), compile_seconds=3.2)
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "compile_seconds regressed" in capsys.readouterr().err
+
+
+def test_compare_compile_noise_within_default_threshold_passes(tmp_path):
+    baseline = _write_resource_run(str(tmp_path / "base"), compile_seconds=2.0)
+    candidate = _write_resource_run(str(tmp_path / "cand"), compile_seconds=2.8)
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+def test_compare_memory_shrink_and_missing_are_fine(tmp_path):
+    baseline = _write_resource_run(str(tmp_path / "base"), peak_memory=2_000_000)
+    candidate = _write_resource_run(str(tmp_path / "cand"), peak_memory=1_000_000)
+    assert main([candidate, "--compare", baseline]) == 0
+    # null peaks (CPU fits) stay "not comparable", never a regression
+    base2 = _write_resource_run(str(tmp_path / "b2"), peak_memory=None)
+    cand2 = _write_resource_run(str(tmp_path / "c2"), peak_memory=None)
+    assert main([cand2, "--compare", base2]) == 0
+
+
+def _write_suite_run(path, rows):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        for row in rows:
+            fh.write(json.dumps({"event": "bench_row", "time": 1.0, **row}) + "\n")
+    return path
+
+
+def test_compare_skips_error_bench_rows(tmp_path, capsys):
+    """The by-design 1M plain-CE OOM row must not trip the gate — on either
+    side — while measured rows still gate per name."""
+    baseline = _write_suite_run(str(tmp_path / "base"), [
+        {"row": "scale_1m_ce", "error": "RESOURCE_EXHAUSTED: oom"},
+        {"row": "scale_1m_fused", "samples_per_sec": 1000.0},
+    ])
+    candidate = _write_suite_run(str(tmp_path / "cand"), [
+        {"row": "scale_1m_ce", "error": "RESOURCE_EXHAUSTED: oom"},
+        {"row": "scale_1m_fused", "samples_per_sec": 980.0},
+    ])
+    assert main([candidate, "--compare", baseline]) == 0
+    out = capsys.readouterr().out
+    assert "skipped (baseline error row)" in out
+    assert "bench_row[scale_1m_fused].samples_per_sec" in out
+
+
+def test_compare_flags_bench_row_regression_and_new_errors(tmp_path, capsys):
+    baseline = _write_suite_run(str(tmp_path / "base"), [
+        {"row": "scale_1m_fused", "samples_per_sec": 1000.0},
+        {"row": "scale_27k_tp", "samples_per_sec": 500.0},
+    ])
+    candidate = _write_suite_run(str(tmp_path / "cand"), [
+        {"row": "scale_1m_fused", "samples_per_sec": 500.0},  # -50%: regression
+        {"row": "scale_27k_tp", "error": "XlaRuntimeError: boom"},  # NEW error
+    ])
+    assert main([candidate, "--compare", baseline]) == 2
+    err = capsys.readouterr().err
+    assert "bench_row[scale_1m_fused].samples_per_sec regressed" in err
+    assert "errored in the candidate" in err
+
+
+# --------------------------------------------------------------------------- #
+# device attribution + roofline sections
+# --------------------------------------------------------------------------- #
+def _write_profiled_run(path):
+    os.makedirs(path, exist_ok=True)
+    device_time = {
+        "capture": "profile/plugins/profile/x/host.trace.json.gz",
+        "total_device_seconds": 0.010,
+        "modules": {"jit_train_step": 0.010},
+        "scopes": {
+            "encoder": {"seconds": 0.006, "fraction": 0.6},
+            "loss": {"seconds": 0.002, "fraction": 0.2},
+        },
+        "attributed_seconds": 0.008,
+        "unattributed_seconds": 0.002,
+    }
+    roofline = {
+        "train_step": {
+            "roofline": {
+                "flops": 1e9, "bytes_accessed": 1e8,
+                "arithmetic_intensity": 10.0, "critical_intensity": 240.5,
+                "bound": "memory", "ceiling_tflops": 8.19,
+                "peak_tflops": 197.0, "peak_hbm_gbps": 819.0,
+                "min_step_seconds": 1.2e-4, "peak_assumed": "v5e",
+            },
+            "hbm_peak_bytes": 50_000_000, "collective_bytes": 1_000_000,
+        }
+    }
+    events = [
+        {"event": "on_fit_start", "time": 1.0, "epoch": 0, "epochs": 1},
+        {"event": "on_fit_end", "time": 2.0, "step": 3,
+         "telemetry": {"steps": 3.0, "elapsed_seconds": 0.3,
+                       "steps_per_sec": 10.0, "samples_per_sec": 80.0},
+         "compile": {"train_step": {"traces": 1, "compile_seconds": 1.0}},
+         "peak_memory_bytes": None, "history_len": 1, "bad_steps": 0,
+         "device_time": device_time, "roofline": roofline},
+    ]
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+    return path
+
+
+def test_device_attribution_and_roofline_sections_render(tmp_path, capsys):
+    run = _write_profiled_run(str(tmp_path / "run"))
+    summary = summarize_run(run)
+    assert summary["device_time"]["scopes"]["encoder"]["fraction"] == pytest.approx(0.6)
+    assert summary["roofline"]["train_step"]["roofline"]["bound"] == "memory"
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "device attribution" in out
+    assert "encoder 60.0%" in out and "unattributed 20.0%" in out
+    assert "roofline:" in out
+    assert "memory-bound (assumed v5e peaks)" in out
+    assert "ceiling 8.19 TFLOP/s" in out
+    assert "peak HBM 50.0 MB" in out
+
+
+def test_bench_rows_render_roofline_fields(tmp_path, capsys):
+    run = _write_suite_run(str(tmp_path / "suite"), [
+        {"row": "scale_27k_fused", "samples_per_sec": 900.0, "step_ms": 2.0,
+         "num_items": 27278, "loss": "CEFused", "roofline_bound": "memory",
+         "of_roofline_ceiling": 0.42, "hbm_peak_bytes": 64_000_000,
+         "collective_bytes": 2_000_000},
+    ])
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "memory-bound (42% of ceiling)" in out
+    assert "HBM 64.0 MB" in out and "coll 2.00 MB" in out
