@@ -1,4 +1,5 @@
-"""Serving benchmark: the online scoring service under closed + open-loop load.
+"""Serving benchmark: the online scoring service under closed, open-loop,
+OVERLOAD and chaos load.
 
 Drives ``replay_tpu.serve.ScoringService`` (micro-batcher → compiled bucket
 executables → per-user state cache → optional MIPS+rerank pipeline) with a
@@ -6,9 +7,11 @@ load generator and prints ONE JSON line in bench.py's sidecar format::
 
     {"metric": "serve_qps", "value": ..., "unit": "req/s", "qps": ...,
      "p50_ms": ..., "p95_ms": ..., "p99_ms": ..., "batch_fill_ratio": ...,
-     "cache_hit_rate": ..., "closed_loop_qps": ..., "backend": ...}
+     "cache_hit_rate": ..., "closed_loop_qps": ..., "serve_shed_rate": ...,
+     "serve_deadline_miss_rate": ..., "serve_error_rate": ...,
+     "overload": {...}, "chaos": {...}, "backend": ...}
 
-Two phases after a cold-seed warmup (every program is AOT-compiled at service
+Phases after a cold-seed warmup (every program is AOT-compiled at service
 construction, so the timed phases never trace):
 
 * **closed loop** — ``CLIENTS`` threads issue synchronous requests back to
@@ -17,17 +20,31 @@ construction, so the timed phases never trace):
 * **open loop** — one generator submits with Poisson-exponential gaps at
   ``RATE`` req/s for ``SECONDS`` (the latency-under-load number: p50/p95/p99
   from submit to response, measured on completion callbacks, immune to
-  coordinated omission).
+  coordinated omission);
+* **overload** (``OVERLOAD_SECONDS > 0``, default on) — open loop at
+  ``OVERLOAD_FACTOR x`` the measured closed-loop capacity with a per-request
+  ``deadline_ms``: arrival rate ≫ service rate, so the bounded lanes MUST
+  shed and the batch builder MUST drop expired waiters — the row asserts the
+  resilience layer keeps p99 bounded (queues cannot grow without bound) with
+  explicit shed/deadline-miss accounting. The fallback floor is disabled for
+  this phase so admission control itself is what gets measured;
+* **chaos** (``--chaos`` / ``REPLAY_TPU_SERVE_CHAOS=1``) — deterministic
+  fault injection via ``replay_tpu.utils.faults``: consecutive engine errors
+  trip the circuit breaker (degraded traffic rides the cache_only/fallback
+  ladder, tagged in ``served_by``), a latency spike exercises the client-
+  abandon drop, a deadline storm exercises expiry-at-batch-build, and the
+  breaker must re-close after recovery. The row asserts zero hung futures.
 
 Request mix per returning user: mostly pure cache hits, a slice of one-step
 incremental advances, a trickle of cold full-history re-sends — the shape the
 per-user state cache exists for. ``REPLAY_TPU_SERVE_*`` env vars override
-every shape/load knob (CI smoke runs tiny configs, flagged
-``shape_override``), mirroring the ``REPLAY_TPU_BENCH_*`` convention so CI and
-the TPU sidecar share this one entrypoint. Events + trace land in
+every shape/load/resilience knob (CI smoke runs tiny configs, flagged
+``shape_override``), mirroring the ``REPLAY_TPU_BENCH_*`` convention so CI
+and the TPU sidecar share this one entrypoint. Events + trace land in
 ``runs/bench_serve/`` (the record itself is appended to events.jsonl, so
 ``python -m replay_tpu.obs.report runs/bench_serve`` renders the serving
-section from one artifact, and ``--compare`` gates QPS/p99 regressions).
+section from one artifact, and ``--compare`` gates QPS/p99 regressions plus
+the lower-better ``serve_error_rate`` / ``serve_deadline_miss_rate`` gates).
 
 Backend policy mirrors bench.py: probe the default backend in a throwaway
 subprocess; unhealthy → re-exec on clean CPU (metric renamed
@@ -83,6 +100,18 @@ LENGTH_BUCKETS = tuple(
     for b in os.environ.get("REPLAY_TPU_SERVE_LENGTH_BUCKETS", "").split(",")
     if b.strip()
 ) or None
+# resilience/chaos knobs (not shape knobs: they never flag shape_override)
+DEADLINE_MS = float(os.environ.get("REPLAY_TPU_SERVE_DEADLINE_MS", "250"))
+MAX_DEPTH = int(os.environ.get("REPLAY_TPU_SERVE_MAX_DEPTH", "0"))  # 0 = auto
+OVERLOAD_FACTOR = float(os.environ.get("REPLAY_TPU_SERVE_OVERLOAD_FACTOR", "4"))
+OVERLOAD_SECONDS = float(os.environ.get("REPLAY_TPU_SERVE_OVERLOAD_SECONDS", "3"))
+BREAKER_THRESHOLD = int(os.environ.get("REPLAY_TPU_SERVE_BREAKER_THRESHOLD", "5"))
+BREAKER_RESET_MS = float(os.environ.get("REPLAY_TPU_SERVE_BREAKER_RESET_MS", "300"))
+CHAOS = (
+    bool(int(os.environ.get("REPLAY_TPU_SERVE_CHAOS", "0"))) or "--chaos" in sys.argv
+)
+if "--no-overload" in sys.argv:
+    OVERLOAD_SECONDS = 0.0
 SHAPE_OVERRIDE = any(_knob(k) != v for k, v in _DEFAULTS.items())
 
 RUN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "runs", "bench_serve")
@@ -114,11 +143,268 @@ def _reexec_on_cpu() -> None:
     )
     env["JAX_PLATFORMS"] = "cpu"
     env["REPLAY_TPU_SERVE_FALLBACK"] = "1"
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    os.execve(
+        sys.executable,
+        [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+        env,
+    )
 
 
 def _percentile(latencies, q: float) -> float:
     return float(np.percentile(np.asarray(latencies), q)) if latencies else float("nan")
+
+
+def _classify(exc) -> str:
+    """Bucket a failed future's exception for phase accounting."""
+    from replay_tpu.serve import CircuitOpen, DeadlineExceeded, RequestShed
+
+    if isinstance(exc, RequestShed):
+        return "shed"
+    if isinstance(exc, DeadlineExceeded):
+        return "deadline_missed"
+    if isinstance(exc, CircuitOpen):
+        return "circuit_refused"
+    return "error"
+
+
+def _await_all(futures, timeout_s: float = 60.0) -> int:
+    """Wait for every future to resolve; returns how many are STILL pending
+    past the grace period — the zero-hung-requests acceptance number."""
+    deadline = time.perf_counter() + timeout_s
+    for future in futures:
+        remaining = deadline - time.perf_counter()
+        if remaining <= 0:
+            break
+        try:
+            future.result(timeout=remaining)
+        except Exception:  # noqa: BLE001 — accounting happens elsewhere
+            pass
+    return sum(1 for future in futures if not future.done())
+
+
+def _run_overload(service, one_request, rate: float):
+    """Open loop at ``rate`` ≫ capacity with per-request deadlines. The
+    fallback floor is detached for the phase so the admission-control path
+    (bounded lanes → RequestShed, expiry at batch build → DeadlineExceeded)
+    is what gets measured, not the infinite-capacity popularity scorer."""
+    fallback, service.fallback = service.fallback, None
+    rng = np.random.default_rng(11)
+    futures = []
+    latencies = []
+    lock = threading.Lock()
+    counts = {"shed": 0, "deadline_missed": 0, "circuit_refused": 0, "error": 0}
+    peak_depth = 0
+
+    def on_done(submitted_at):
+        def callback(future):
+            latency = time.perf_counter() - submitted_at
+            exc = future.exception()
+            with lock:
+                if exc is None:
+                    latencies.append(latency)
+                else:
+                    counts[_classify(exc)] += 1
+
+        return callback
+
+    start = time.perf_counter()
+    deadline = start + OVERLOAD_SECONDS
+    submitted = 0
+    try:
+        while time.perf_counter() < deadline:
+            user = int(rng.integers(0, USERS))
+            submitted_at = time.perf_counter()
+            future = one_request(rng, user, deadline_ms=DEADLINE_MS)
+            future.add_done_callback(on_done(submitted_at))
+            futures.append(future)
+            submitted += 1
+            if submitted % 64 == 0:
+                peak_depth = max(peak_depth, service.batcher.queued_depth())
+            gap = float(rng.exponential(1.0 / max(rate, 1.0)))
+            if gap > 0.0005:  # sub-granularity sleeps only slow the generator
+                time.sleep(min(gap, 1.0))
+        hung = _await_all(futures)
+        # result() waiters wake BEFORE done-callbacks run, so drain the
+        # callback tail or the phase totals undercount vs submissions
+        drain_deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < drain_deadline:
+            with lock:
+                accounted = len(latencies) + sum(counts.values())
+            if accounted >= submitted - hung:
+                break
+            time.sleep(0.005)
+    finally:
+        service.fallback = fallback
+    elapsed = time.perf_counter() - start
+    with lock:
+        completed = len(latencies)
+        phase_counts = dict(counts)
+    return {
+        "rate": round(rate, 1),
+        "factor": OVERLOAD_FACTOR,
+        "seconds": OVERLOAD_SECONDS,
+        "deadline_ms": DEADLINE_MS,
+        "submitted": submitted,
+        "completed": completed,
+        "shed": phase_counts["shed"],
+        "shed_rate": round(phase_counts["shed"] / submitted, 4) if submitted else 0.0,
+        "deadline_missed": phase_counts["deadline_missed"],
+        "deadline_miss_rate": (
+            round(phase_counts["deadline_missed"] / submitted, 4) if submitted else 0.0
+        ),
+        "circuit_refused": phase_counts["circuit_refused"],
+        "errors": phase_counts["error"],
+        "error_rate": round(phase_counts["error"] / submitted, 4) if submitted else 0.0,
+        "p50_ms": round(_percentile(latencies, 50) * 1000.0, 3),
+        "p99_ms": round(_percentile(latencies, 99) * 1000.0, 3),
+        "peak_queue_depth": peak_depth,
+        "max_queue_depth": service.batcher.max_depth,
+        "hung_requests": hung,
+        "elapsed_s": round(elapsed, 2),
+    }
+
+
+def _run_chaos(service, histories, rng):
+    """Deterministic serve-side fault injection (see utils/faults.py):
+    engine errors trip the breaker open, degraded traffic rides the ladder,
+    a latency spike exercises the client-abandon drop, a deadline storm
+    exercises expiry-at-batch-build, and recovery re-closes the breaker."""
+    from replay_tpu.utils.faults import EngineErrorAt, InjectedFault, LatencySpike, wrap_method
+
+    futures = []
+    stats_before = service.stats()
+    threshold = service.breaker.failure_threshold
+    reset_s = service.breaker.reset_timeout_s
+
+    # re-anchor the warm user with an explicit history while the engine is
+    # still healthy: the preceding overload phase may have shed its last
+    # re-encode, leaving no cached embedding for the cache_only rung to ride
+    warm_user = 0
+    service.score(warm_user, history=histories[warm_user], timeout=30)
+
+    # 1) consecutive engine failures -> breaker opens
+    error_injector = EngineErrorAt(at_calls=range(threshold))
+    original_encode = wrap_method(service.engine, "encode", error_injector)
+    injected_errors = 0
+    for i in range(threshold):
+        future = service.submit(
+            f"chaos-trip-{i}", history=rng.integers(0, NUM_ITEMS, 5).tolist()
+        )
+        futures.append(future)
+        try:
+            future.result(timeout=30)
+        except InjectedFault:
+            injected_errors += 1
+        except Exception:  # noqa: BLE001 — counted via service stats
+            pass
+    state_after_trip = service.breaker.state
+    # pin the breaker open for the ladder step: a scheduler pause longer than
+    # the (CI-tiny) reset window would otherwise let the next request become
+    # the half-open probe and come back "primary", flaking the assertions
+    service.breaker.reset_timeout_s = 3600.0
+
+    # 2) degraded traffic while open: the warm user's advance rides the
+    # cache_only rung (stale embedding, hit lane); a brand-new user lands on
+    # the fallback floor. served_by makes both visible.
+    served_by_seen = {}
+    response = service.score(warm_user, new_items=[1], timeout=30)
+    served_by_seen["advance_while_open"] = response.served_by
+    response = service.score(
+        "chaos-cold-new", history=rng.integers(0, NUM_ITEMS, 4).tolist(), timeout=30
+    )
+    served_by_seen["cold_while_open"] = response.served_by
+
+    # 3) recovery: restore the real reset window (already elapsed relative to
+    # the trip, so the next encode-needing request is the half-open probe);
+    # the injector is exhausted, so it succeeds and the breaker closes
+    service.breaker.reset_timeout_s = reset_s
+    recovered = False
+    recovery_deadline = time.perf_counter() + max(10.0, 20 * reset_s)
+    probe = 0
+    while time.perf_counter() < recovery_deadline:
+        if service.breaker.state == "closed":
+            recovered = True
+            break
+        time.sleep(reset_s / 2 + 0.01)
+        future = service.submit(
+            f"chaos-probe-{probe}", history=rng.integers(0, NUM_ITEMS, 4).tolist()
+        )
+        futures.append(future)
+        probe += 1
+        try:
+            future.result(timeout=30)
+        except Exception:  # noqa: BLE001
+            pass
+    recovered = recovered or service.breaker.state == "closed"
+
+    # 4) latency spike + client abandonment: the worker stalls on a blocker
+    # encode; a short-timeout client gives up, and its cancelled request is
+    # skipped at batch build (never burning the scoring slot)
+    spike = LatencySpike(at_calls=[0], duration_s=max(0.2, 6 * MAX_WAIT_MS / 1000.0))
+    wrap_method(service.engine, "encode", spike)
+    blocker = service.submit(
+        "chaos-blocker", history=rng.integers(0, NUM_ITEMS, 4).tolist()
+    )
+    futures.append(blocker)
+    client_abandoned = 0
+    try:
+        service.score(
+            "chaos-abandoned",
+            history=rng.integers(0, NUM_ITEMS, 4).tolist(),
+            timeout=0.03,
+        )
+    except Exception:  # noqa: BLE001 — the timeout IS the scenario
+        client_abandoned = 1
+    try:
+        blocker.result(timeout=30)
+    except Exception:  # noqa: BLE001
+        pass
+
+    # 5) deadline storm: a second spike stalls the worker while a burst of
+    # tiny-deadline requests queues up; expiry at batch build must drop them
+    # before any device work
+    storm_spike = LatencySpike(at_calls=[0], duration_s=0.25)
+    wrap_method(service.engine, "encode", storm_spike)
+    storm_blocker = service.submit(
+        "chaos-storm-blocker", history=rng.integers(0, NUM_ITEMS, 4).tolist()
+    )
+    futures.append(storm_blocker)
+    time.sleep(0.02)  # let the blocker reach the worker
+    storm = [
+        service.submit(int(rng.integers(0, USERS)), deadline_ms=50.0)
+        for _ in range(32)
+    ]
+    futures.extend(storm)
+    hung = _await_all(futures)
+    storm_missed = sum(
+        1
+        for future in storm
+        if future.done()
+        and future.exception() is not None
+        and _classify(future.exception()) == "deadline_missed"
+    )
+
+    # restore the unwrapped engine
+    service.engine.encode = original_encode
+    stats_after = service.stats()
+    served_by_delta = {
+        key: stats_after["served_by"][key] - stats_before["served_by"][key]
+        for key in stats_after["served_by"]
+    }
+    return {
+        "injected_engine_errors": injected_errors,
+        "injected_spikes": len(spike.injected_at) + len(storm_spike.injected_at),
+        "breaker_opens": stats_after["breaker"]["opens"],
+        "breaker_state_after_trip": state_after_trip,
+        "breaker_state_final": service.breaker.state,
+        "recovered": recovered,
+        "served_by_delta": served_by_delta,
+        "served_by_seen": served_by_seen,
+        "client_abandoned": client_abandoned,
+        "storm_submitted": len(storm),
+        "storm_deadline_missed": storm_missed,
+        "hung_requests": hung,
+    }
 
 
 def main() -> None:
@@ -138,7 +424,12 @@ def main() -> None:
     from replay_tpu.nn.sequential.sasrec import SasRec
     from replay_tpu.obs import JsonlLogger, Tracer
     from replay_tpu.scenarios.two_stages import LogisticReranker
-    from replay_tpu.serve import CandidatePipeline, ScoringService
+    from replay_tpu.serve import (
+        CandidatePipeline,
+        CircuitBreaker,
+        FallbackScorer,
+        ScoringService,
+    )
 
     rng = np.random.default_rng(0)
     schema = TensorSchema(
@@ -185,6 +476,11 @@ def main() -> None:
         )
         mode = "retrieval"
 
+    histories = {
+        u: rng.integers(0, NUM_ITEMS, size=int(rng.integers(1, 2 * SEQ_LEN))).tolist()
+        for u in range(USERS)
+    }
+
     tracer = Tracer()
     logger = JsonlLogger(RUN_DIR, mode="w")
     compile_start = time.perf_counter()
@@ -199,13 +495,18 @@ def main() -> None:
         tracer=tracer,
         logger=logger,
         trace_path=os.path.join(RUN_DIR, "trace.json"),
+        max_queue_depth=MAX_DEPTH if MAX_DEPTH else None,
+        breaker=CircuitBreaker(
+            failure_threshold=BREAKER_THRESHOLD,
+            reset_timeout_s=BREAKER_RESET_MS / 1000.0,
+        ),
+        # the degradation ladder's floor: popularity over the synthetic
+        # training log (the reference's PopRec, doubled as the outage answer)
+        fallback=FallbackScorer.from_interactions(
+            [item for h in histories.values() for item in h], NUM_ITEMS
+        ),
     )
     compile_seconds = time.perf_counter() - compile_start
-
-    histories = {
-        u: rng.integers(0, NUM_ITEMS, size=int(rng.integers(1, 2 * SEQ_LEN))).tolist()
-        for u in range(USERS)
-    }
 
     with service:
         # seed every user cold (also settles the executables)
@@ -215,16 +516,16 @@ def main() -> None:
         for future in seed_futures:
             future.result(timeout=120)
 
-        def one_request(thread_rng, user: int):
+        def one_request(thread_rng, user: int, deadline_ms=None):
             """The returning-user mix: mostly hits, some advances, rare colds."""
             draw = thread_rng.random()
             if draw < 0.7:
-                return service.submit(user)
+                return service.submit(user, deadline_ms=deadline_ms)
             if draw < 0.9:
                 new_item = int(thread_rng.integers(0, NUM_ITEMS))
                 histories[user].append(new_item)
-                return service.submit(user, new_items=[new_item])
-            return service.submit(user, history=histories[user])
+                return service.submit(user, new_items=[new_item], deadline_ms=deadline_ms)
+            return service.submit(user, history=histories[user], deadline_ms=deadline_ms)
 
         # ---- closed loop: saturation throughput --------------------------- #
         errors = []
@@ -285,6 +586,21 @@ def main() -> None:
             time.sleep(0.005)
         open_elapsed = time.perf_counter() - open_start
         open_qps = submitted / open_elapsed
+
+        # ---- overload: arrivals ≫ capacity, bounded lanes must shed ------- #
+        # capacity estimate: the better of the two measured loops (a closed
+        # loop with few clients is latency-bound and undersells throughput)
+        overload = None
+        if OVERLOAD_SECONDS > 0:
+            overload = _run_overload(
+                service, one_request, rate=OVERLOAD_FACTOR * max(closed_qps, open_qps)
+            )
+
+        # ---- chaos: injected engine faults, breaker round trip ------------ #
+        chaos = None
+        if CHAOS:
+            chaos = _run_chaos(service, histories, np.random.default_rng(23))
+
         stats = service.stats()
 
     metric = "serve_qps"
@@ -304,18 +620,33 @@ def main() -> None:
         "pure_hit_rate": round(stats["pure_hit_rate"], 4),
         "requests": stats["requests"],
         "request_errors": len(errors),
+        # run-wide resilience rates (all phases), the --compare gate inputs
+        "serve_shed_rate": round(stats["shed_rate"], 4),
+        "serve_deadline_miss_rate": round(stats["deadline_miss_rate"], 4),
+        "serve_error_rate": round(stats["error_rate"], 4),
+        "served_by": stats["served_by"],
+        "breaker": stats["breaker"],
+        "hung_requests": (
+            (overload["hung_requests"] if overload else 0)
+            + (chaos["hung_requests"] if chaos else 0)
+        ),
         "mode": mode,
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "batch_buckets": list(BATCH_BUCKETS),
         "length_buckets": list(service.engine.length_buckets),
         "max_wait_ms": MAX_WAIT_MS,
+        "max_queue_depth": service.batcher.max_depth,
         "open_loop_rate": RATE,
         "open_loop_seconds": SECONDS,
         "clients": CLIENTS,
         "users": USERS,
         "compile_seconds": round(compile_seconds, 2),
     }
+    if overload is not None:
+        record["overload"] = overload
+    if chaos is not None:
+        record["chaos"] = chaos
     if SHAPE_OVERRIDE:
         record["shape_override"] = {
             "L": SEQ_LEN,

@@ -29,11 +29,22 @@ Event flow emitted by ``replay_tpu.nn.Trainer.fit``::
 The serving stack (``replay_tpu.serve.ScoringService``) reuses the same sinks
 with its own event family::
 
-    on_serve_start            (mode, bucket ladders, max_wait, cache capacity)
+    on_serve_start            (mode, bucket ladders, max_wait, cache capacity,
+                               queue-depth bound, default deadline)
       on_serve_batch*         (one per dispatched micro-batch: lane, rows,
-                               bucket, fill, max queue wait)
+                               bucket, fill, max queue wait, dropped
+                               expired/cancelled counts)
+      on_shed*                (admission control refused work: lane, depth,
+                               retry-after hint; throttled, carries the
+                               coalesced `count` per emit)
+      on_breaker*             (circuit-breaker transition: from/to state,
+                               consecutive failures — one per transition)
+      on_degrade*             (traffic rerouted down the degradation ladder:
+                               to cache_only/fallback, reason; throttled)
     on_serve_end              (request totals, cache hit rate, batch fill
-                               ratio, queue-wait stats, serve goodput)
+                               ratio, queue-wait stats, shed/deadline-miss/
+                               degradation totals, breaker stats, serve
+                               goodput)
 
 Every event flattens to one JSON-able dict (``event`` + ``time`` + optional
 ``step``/``epoch`` + the payload), so a run directory's ``events.jsonl`` is a
@@ -47,6 +58,7 @@ import json
 import logging
 import math
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Mapping, Optional, Sequence
@@ -129,6 +141,11 @@ class JsonlLogger(RunLogger):
     same sink doubles as a raw-record writer (:meth:`log_record`) for driver
     artifacts like ``BENCH_TPU_SIDECAR.json`` that are single records rather
     than event streams (``mode="w"``).
+
+    Thread-safe: the serve stack emits from client threads (``on_shed``/
+    ``on_breaker``) concurrently with the worker's ``on_serve_batch``, so each
+    line is serialized first and written in one locked call — concurrent
+    emits can interleave lines, never tear one.
     """
 
     def __init__(self, run_dir: str, filename: str = "events.jsonl", mode: str = "a") -> None:
@@ -136,11 +153,13 @@ class JsonlLogger(RunLogger):
         os.makedirs(self.run_dir, exist_ok=True)
         self.path = os.path.join(self.run_dir, filename)
         self._fh = open(self.path, mode)
+        self._lock = threading.Lock()
 
     def log_record(self, record: Mapping[str, Any]) -> None:
-        self._fh.write(json.dumps(_jsonable(record), allow_nan=False))
-        self._fh.write("\n")
-        self._fh.flush()
+        line = json.dumps(_jsonable(record), allow_nan=False) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
 
     def log_event(self, event: TrainerEvent) -> None:
         self.log_record(event.to_record())

@@ -14,8 +14,12 @@ memory- vs compute-bound, predicted ceiling, HBM footprint, collective
 bytes), retraces, bad/recovered steps, the model-health record
 (obs.health: per-group norms/update ratios, activation stats, attention
 entropy, early warnings), and the serving summary (replay_tpu.serve /
-bench_serve.py: QPS, latency percentiles, batch fill, cache hit rate —
-gated on QPS drops and p99 growth). ``--compare`` diffs two runs —
+bench_serve.py: QPS, latency percentiles, batch fill, cache hit rate, plus
+the resilience rates — shed / deadline-miss / error — with degraded-traffic
+counts by ladder rung and breaker state; gated on QPS drops, p99 growth, and
+lower-better ``serve_error_rate`` / ``serve_deadline_miss_rate`` rises —
+``serve_shed_rate`` gates only when BOTH runs ran the overload phase).
+``--compare`` diffs two runs —
 either run may be a run directory, a raw ``events.jsonl``, or a single-record
 bench JSON (``BENCH_*.json`` / ``BENCH_TPU_SIDECAR.json``) — and exits
 non-zero when the candidate regresses beyond ``--threshold`` (relative):
@@ -367,11 +371,26 @@ def summarize_events(
                     "mode", "requests", "answered", "errors", "cache_hit_rate",
                     "pure_hit_rate", "batch_fill_ratio", "queue_wait_ms_mean",
                     "queue_wait_ms_max",
+                    # resilience totals (overload/chaos accounting)
+                    "shed", "deadline_misses", "cancelled", "circuit_refusals",
+                    "degraded", "shed_rate", "deadline_miss_rate", "error_rate",
                 )
                 if key in record
             }
         )
+        if isinstance(record.get("served_by"), Mapping):
+            serve["served_by"] = dict(record["served_by"])
+        if isinstance(record.get("breaker"), Mapping):
+            serve["breaker"] = dict(record["breaker"])
         serve["batches"] = len(serve_batches)
+        resilience_counts = {"on_shed": 0, "on_breaker": 0, "on_degrade": 0}
+        for e in events:
+            name = e.get("event")
+            if name in resilience_counts:
+                resilience_counts[name] += 1
+        serve["shed_events"] = resilience_counts["on_shed"]
+        serve["breaker_events"] = resilience_counts["on_breaker"]
+        serve["degrade_events"] = resilience_counts["on_degrade"]
     if bench and "serve" in str(bench[-1].get("metric", "")):
         record = bench[-1]
         serve.update(
@@ -380,10 +399,45 @@ def summarize_events(
                 for key in (
                     "qps", "p50_ms", "p95_ms", "p99_ms", "batch_fill_ratio",
                     "cache_hit_rate", "closed_loop_qps", "requests", "mode",
+                    "hung_requests",
                 )
                 if key in record
             }
         )
+        # the run-wide rates the --compare lower-better gates consume; the
+        # bench record's numbers win over on_serve_end (same totals, rounded)
+        for bench_key, serve_key in (
+            ("serve_shed_rate", "shed_rate"),
+            ("serve_deadline_miss_rate", "deadline_miss_rate"),
+            ("serve_error_rate", "error_rate"),
+        ):
+            if _finite(record.get(bench_key)) is not None:
+                serve[serve_key] = float(record[bench_key])
+        if isinstance(record.get("served_by"), Mapping):
+            serve["served_by"] = dict(record["served_by"])
+        if isinstance(record.get("breaker"), Mapping):
+            serve["breaker"] = dict(record["breaker"])
+        overload = record.get("overload")
+        if isinstance(overload, Mapping):
+            # the overload flag gates shed-rate comparability: shed rates only
+            # mean the same thing between two runs that both ran overload
+            serve["overload"] = True
+            serve["overload_p99_ms"] = _finite(overload.get("p99_ms"))
+            serve["overload_shed_rate"] = _finite(overload.get("shed_rate"))
+            serve["overload_deadline_miss_rate"] = _finite(
+                overload.get("deadline_miss_rate")
+            )
+        chaos = record.get("chaos")
+        if isinstance(chaos, Mapping):
+            serve["chaos"] = {
+                key: chaos.get(key)
+                for key in (
+                    "injected_engine_errors", "breaker_opens",
+                    "breaker_state_final", "recovered", "hung_requests",
+                    "storm_deadline_missed",
+                )
+                if key in chaos
+            }
     summary["serve"] = serve or None
     return summary
 
@@ -688,6 +742,58 @@ def render(summary: Mapping[str, Any]) -> str:
             parts.append(f"queue wait {serve['queue_wait_ms_mean']:.2f} ms mean")
         mode = f" [{serve['mode']}]" if serve.get("mode") else ""
         lines.append(f"  serving{mode}: " + " · ".join(parts))
+        # the resilience line: shed / deadline-miss / error rates, degraded
+        # traffic by ladder rung, breaker state — overload/chaos evidence
+        rates = [
+            (label, _finite(serve.get(key)))
+            for label, key in (
+                ("shed", "shed_rate"),
+                ("deadline-miss", "deadline_miss_rate"),
+                ("error", "error_rate"),
+            )
+        ]
+        if any(value is not None for _, value in rates):
+            parts = [
+                f"{label} rate {value:.2%}" for label, value in rates if value is not None
+            ]
+            served_by = serve.get("served_by")
+            if isinstance(served_by, Mapping):
+                degraded = sum(
+                    int(count) for rung, count in served_by.items() if rung != "primary"
+                )
+                shown = "/".join(
+                    f"{rung}:{served_by[rung]}" for rung in ("cache_only", "fallback")
+                    if rung in served_by
+                )
+                parts.append(f"degraded {degraded}" + (f" ({shown})" if shown else ""))
+            breaker = serve.get("breaker")
+            if isinstance(breaker, Mapping):
+                parts.append(
+                    f"breaker {breaker.get('state')} "
+                    f"({breaker.get('opens', 0)} open(s))"
+                )
+            if serve.get("hung_requests") is not None:
+                parts.append(f"hung {serve['hung_requests']}")
+            lines.append("  serving resilience: " + " · ".join(parts))
+        if serve.get("overload"):
+            parts = []
+            if serve.get("overload_p99_ms") is not None:
+                parts.append(f"p99 {serve['overload_p99_ms']:.2f} ms")
+            if serve.get("overload_shed_rate") is not None:
+                parts.append(f"shed {serve['overload_shed_rate']:.2%}")
+            if serve.get("overload_deadline_miss_rate") is not None:
+                parts.append(f"deadline-miss {serve['overload_deadline_miss_rate']:.2%}")
+            lines.append("  serving overload: " + " · ".join(parts))
+        chaos = serve.get("chaos")
+        if isinstance(chaos, Mapping):
+            lines.append(
+                "  serving chaos: "
+                f"{chaos.get('injected_engine_errors', 0)} injected error(s) · "
+                f"breaker opened {chaos.get('breaker_opens', 0)}x, "
+                f"final {chaos.get('breaker_state_final')} · "
+                f"storm missed {chaos.get('storm_deadline_missed', 0)} · "
+                f"hung {chaos.get('hung_requests', 0)}"
+            )
     return "\n".join(lines)
 
 
@@ -862,6 +968,68 @@ def compare_runs(
                 regressions.append(
                     f"serve_p99_ms regressed {delta:+.1%} (> {threshold:.0%} threshold)"
                 )
+
+        # resilience-rate gates, LOWER-better with an absolute floor: rates
+        # start at 0.0 in healthy runs, so the relative rule alone (cand >
+        # base * (1+t)) would never fire on a 0 -> 0.05 regression — a
+        # half-percent absolute rise gates regardless of the baseline
+        def check_rate(name: str, cand: Optional[float], base: Optional[float]) -> None:
+            if cand is None or base is None:
+                lines.append(
+                    f"  {name}: candidate={_fmt(cand, '{:.4f}')} "
+                    f"baseline={_fmt(base, '{:.4f}')} (not comparable)"
+                )
+                return
+            lines.append(f"  {name}: {cand:.4f} vs {base:.4f}")
+            if cand > base + max(threshold * base, 0.005):
+                regressions.append(
+                    f"{name} regressed {base:.4f} -> {cand:.4f} (lower is better)"
+                )
+
+        def surface_rate(name: str, cand: Optional[float], base: Optional[float], why: str) -> None:
+            if cand is not None or base is not None:
+                lines.append(
+                    f"  {name}: candidate={_fmt(cand, '{:.4f}')} "
+                    f"baseline={_fmt(base, '{:.4f}')} (not gated: {why})"
+                )
+
+        # the run-wide rates are dominated by the OPT-IN phases — deadline
+        # misses by overload (4x-capacity arrivals against tight deadlines by
+        # design), errors by chaos (injected engine faults) — so each gate
+        # applies only when the relevant phases match on both sides; a
+        # mismatched comparison is surfaced, never gated
+        overload_match = bool(cand_serve.get("overload")) == bool(base_serve.get("overload"))
+        chaos_match = bool(cand_serve.get("chaos")) == bool(base_serve.get("chaos"))
+        cand_err = _finite(cand_serve.get("error_rate"))
+        base_err = _finite(base_serve.get("error_rate"))
+        if chaos_match:
+            check_rate("serve_error_rate", cand_err, base_err)
+        else:
+            surface_rate(
+                "serve_error_rate", cand_err, base_err,
+                "chaos phase ran on one side only",
+            )
+        cand_dm = _finite(cand_serve.get("deadline_miss_rate"))
+        base_dm = _finite(base_serve.get("deadline_miss_rate"))
+        if overload_match:
+            check_rate("serve_deadline_miss_rate", cand_dm, base_dm)
+        else:
+            surface_rate(
+                "serve_deadline_miss_rate", cand_dm, base_dm,
+                "overload phase ran on one side only",
+            )
+        # shed rate only means the same thing between two runs that BOTH ran
+        # the overload phase (a no-overload run sheds ~nothing by design) —
+        # surfaced always, gated only when comparable
+        cand_shed = _finite(cand_serve.get("shed_rate"))
+        base_shed = _finite(base_serve.get("shed_rate"))
+        if cand_serve.get("overload") and base_serve.get("overload"):
+            check_rate("serve_shed_rate", cand_shed, base_shed)
+        else:
+            surface_rate(
+                "serve_shed_rate", cand_shed, base_shed,
+                "both sides must run overload mode",
+            )
         for name in ("batch_fill_ratio", "cache_hit_rate"):
             cand_value, base_value = _finite(cand_serve.get(name)), _finite(base_serve.get(name))
             if cand_value is not None and base_value is not None:

@@ -4,34 +4,58 @@ The production-serving analog of the reference's OpenVINO-compiled-model +
 ANN-index stack (SURVEY §2.8), built from this repo's own pieces:
 
 * :class:`MicroBatcher` — fills fixed ``[B, L]`` slots from concurrent
-  requests under a max-wait deadline (``batcher``).
+  requests under a max-wait deadline, with bounded per-lane queues and a
+  supervised worker (``batcher``).
 * :class:`UserStateCache` — per-user encoded-state LRU with one-step
   incremental window advances (``cache``).
 * :class:`ScoringEngine` — pre-compiled ``CompiledInference`` bucket
   executables per length bucket + cached-state scorers (``engine``).
 * :class:`CandidatePipeline` — exact sharded MIPS retrieval fused with the
   two-stage re-rank and top-k, all on device (``pipeline``).
-* :class:`ScoringService` — the end-to-end service (``service``).
+* :class:`CircuitBreaker` — closed→open→half-open supervision of the encode
+  path (``breaker``), and :class:`FallbackScorer` — the host-side popularity
+  floor of the degradation ladder (``degrade``).
+* :class:`ScoringService` — the end-to-end service (``service``), with
+  admission control (:class:`RequestShed`), per-request deadlines
+  (:class:`DeadlineExceeded`) and graceful degradation (``served_by`` tags).
 
-``bench_serve.py`` (repo root) drives it with closed/open-loop load and emits
-the QPS/latency/fill/hit-rate record ``obs.report`` renders and gates on.
-See docs/serving.md.
+``bench_serve.py`` (repo root) drives it with closed/open-loop load — plus
+open-loop OVERLOAD and ``--chaos`` fault-injection modes — and emits the
+QPS/latency/fill/hit-rate/shed-rate record ``obs.report`` renders and gates
+on. See docs/serving.md.
 """
 
 from .batcher import MicroBatcher
+from .breaker import CircuitBreaker
 from .cache import UserState, UserStateCache
+from .degrade import DEGRADATION_LADDER, FallbackScorer
 from .engine import ScoringEngine
+from .errors import (
+    CircuitOpen,
+    DeadlineExceeded,
+    RequestShed,
+    ServeError,
+    ServiceClosed,
+)
 from .pipeline import CandidatePipeline
 from .request import ScoreRequest, ScoreResponse, make_window
 from .service import ScoringService
 
 __all__ = [
+    "DEGRADATION_LADDER",
     "CandidatePipeline",
+    "CircuitBreaker",
+    "CircuitOpen",
+    "DeadlineExceeded",
+    "FallbackScorer",
     "MicroBatcher",
+    "RequestShed",
     "ScoreRequest",
     "ScoreResponse",
     "ScoringEngine",
     "ScoringService",
+    "ServeError",
+    "ServiceClosed",
     "UserState",
     "UserStateCache",
     "make_window",

@@ -12,6 +12,23 @@ validity, so a lone request costs one bucket-1 program, not a 512-wide slot.
 Single worker thread: every dispatch (and therefore every device call) runs on
 it sequentially — the accelerator is a serial resource anyway, and it keeps
 the jax side single-threaded.
+
+Resilience contract (docs/serving.md "Overload and degradation"):
+
+* **admission control** — ``max_depth`` bounds each lane's queue; a submit
+  into a full lane raises :class:`~replay_tpu.serve.errors.RequestShed`
+  (depth + retry-after hint) instead of growing the backlog without bound.
+* **supervision** — a worker crash (``on_error`` raising, or a non-``Exception``
+  ``BaseException`` escaping a dispatch) fails the in-flight batch through
+  ``on_error`` and restarts the loop, up to ``max_worker_restarts`` times;
+  past the budget every queued item is failed with
+  :class:`~replay_tpu.serve.errors.ServiceClosed` and the batcher refuses new
+  work. Plain dispatch ``Exception``s still route to ``on_error`` without
+  costing a restart.
+* **no orphaned waiters** — ``stop()`` flushes what it can through
+  ``dispatch`` and FAILS whatever remains (worker dead, or wedged past the
+  join timeout — including the in-flight batch), so no submitted item is ever
+  left unresolved.
 """
 
 from __future__ import annotations
@@ -21,21 +38,28 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
+from .errors import RequestShed, ServiceClosed
+
 
 class MicroBatcher:
     """Collects submitted items into per-lane batches; a worker thread calls
     ``dispatch(lane, items)`` when a lane fills or its oldest item times out.
 
     :param dispatch: callback run ON THE WORKER THREAD with at most
-        ``capacity(lane)`` items. Exceptions are routed to ``on_error`` (the
-        worker survives).
+        ``capacity(lane)`` items. ``Exception``s are routed to ``on_error``
+        (the worker survives); anything ``on_error`` raises crashes the worker
+        into the supervisor.
     :param capacity: max items per dispatched batch, per lane — the largest
         compiled batch bucket. Either a mapping or a default int for lanes not
         listed.
     :param max_wait: seconds a request may wait for co-riders before a partial
         batch is dispatched anyway (the latency/fill trade-off knob).
-    :param on_error: ``(lane, items, exception) -> None``; default re-raises
-        into stderr logging via the worker guard in ``dispatch`` wrappers.
+    :param on_error: ``(lane, items, exception) -> None``; resolves the failed
+        items' futures at the service layer.
+    :param max_depth: per-lane queued-item bound; ``None`` = unbounded (the
+        pre-resilience behavior). Submits beyond it raise :class:`RequestShed`.
+    :param max_worker_restarts: worker crashes tolerated before the batcher
+        gives up and fails all pending work.
     """
 
     def __init__(
@@ -44,6 +68,8 @@ class MicroBatcher:
         capacity: Any = 64,
         max_wait: float = 0.002,
         on_error: Optional[Callable[[Hashable, List[Any], BaseException], None]] = None,
+        max_depth: Optional[int] = None,
+        max_worker_restarts: int = 2,
     ) -> None:
         self._dispatch = dispatch
         self._capacity = capacity if isinstance(capacity, dict) else {}
@@ -52,33 +78,67 @@ class MicroBatcher:
             else int(capacity) if not isinstance(capacity, dict) else 64
         )
         self.max_wait = float(max_wait)
+        self.max_depth = int(max_depth) if max_depth is not None else None
+        self.max_worker_restarts = int(max_worker_restarts)
         self._on_error = on_error
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._lanes: Dict[Hashable, deque] = {}
         self._running = False
         self._worker: Optional[threading.Thread] = None
+        self._inflight: Optional[Tuple[Hashable, List[Any]]] = None
+        self._dispatch_ewma = 0.0  # recent seconds per dispatched batch
         # accounting (under _lock)
         self.submitted = 0
         self.dispatched_batches = 0
         self.dispatched_rows = 0
         self.deadline_flushes = 0
         self.full_flushes = 0
+        self.shed = 0
+        self.worker_crashes = 0
 
     def capacity(self, lane: Hashable) -> int:
         return int(self._capacity.get(lane, self._default_capacity))
 
     # -- client side -------------------------------------------------------- #
     def submit(self, lane: Hashable, item: Any) -> None:
-        """Enqueue ``item`` on ``lane`` (any thread). Raises once stopped."""
+        """Enqueue ``item`` on ``lane`` (any thread).
+
+        Raises :class:`ServiceClosed` once stopped (or crashed past the
+        restart budget) and :class:`RequestShed` when the lane is at
+        ``max_depth`` — both BEFORE the item is queued, so admission refusals
+        never leave dangling state.
+        """
         deadline = time.perf_counter() + self.max_wait
         with self._wake:
             if not self._running:
-                msg = "MicroBatcher is not running"
-                raise RuntimeError(msg)
-            self._lanes.setdefault(lane, deque()).append((deadline, item))
+                raise ServiceClosed("MicroBatcher is not running")
+            queue = self._lanes.setdefault(lane, deque())
+            if self.max_depth is not None and len(queue) >= self.max_depth:
+                self.shed += 1
+                raise RequestShed(
+                    lane,
+                    depth=len(queue),
+                    max_depth=self.max_depth,
+                    retry_after_s=self._retry_after_locked(lane, len(queue)),
+                )
+            queue.append((deadline, item))
             self.submitted += 1
             self._wake.notify()
+
+    def queued_depth(self, lane: Optional[Hashable] = None) -> int:
+        """Items currently queued on ``lane`` (or across all lanes)."""
+        with self._lock:
+            if lane is not None:
+                queue = self._lanes.get(lane)
+                return len(queue) if queue else 0
+            return sum(len(queue) for queue in self._lanes.values())
+
+    def _retry_after_locked(self, lane: Hashable, depth: int) -> float:
+        """Rough backlog-drain estimate: batches ahead x recent per-batch
+        dispatch time, plus one max-wait for the fill window."""
+        batches_ahead = max(depth, 1) / max(self.capacity(lane), 1)
+        return batches_ahead * self._dispatch_ewma + self.max_wait
 
     # -- worker ------------------------------------------------------------- #
     def _pick(self, now: float) -> Optional[Tuple[Hashable, List[Any], bool]]:
@@ -117,6 +177,37 @@ class MicroBatcher:
         return min(deadlines) if deadlines else None
 
     def _run(self) -> None:
+        """Worker main: the dispatch loop under a crash supervisor."""
+        while True:
+            try:
+                self._loop()
+                return  # clean exit: stopped and drained
+            except BaseException as exc:  # noqa: BLE001 — supervised crash
+                if not self._crashed(exc):
+                    return
+                # budget remains: loop around = the restart
+
+    def _crashed(self, exc: BaseException) -> bool:
+        """Fail the in-flight batch, decide restart vs give-up. Returns
+        whether the loop should restart."""
+        with self._wake:
+            inflight, self._inflight = self._inflight, None
+            self.worker_crashes += 1
+            restart = self._running and self.worker_crashes <= self.max_worker_restarts
+            if not restart:
+                self._running = False  # refuse new work; pending fails below
+        if inflight is not None:
+            self._safe_on_error(inflight[0], inflight[1], exc)
+        if not restart:
+            self._fail_pending(
+                ServiceClosed(
+                    f"serve worker crashed ({exc!r}) and exhausted its "
+                    f"{self.max_worker_restarts}-restart budget"
+                )
+            )
+        return restart
+
+    def _loop(self) -> None:
         while True:
             with self._wake:
                 ready = self._pick(time.perf_counter())
@@ -145,11 +236,46 @@ class MicroBatcher:
                     self.full_flushes += 1
                 else:
                     self.deadline_flushes += 1
+                self._inflight = (lane, items)
+            started = time.perf_counter()
             try:
                 self._dispatch(lane, items)
-            except BaseException as exc:  # noqa: BLE001 — worker must survive
+            except Exception as exc:  # noqa: BLE001 — worker survives
+                # on_error raising (or a BaseException from dispatch) escapes
+                # to the supervisor with _inflight still set, so the crashed
+                # batch's items are failed rather than lost
                 if self._on_error is not None:
                     self._on_error(lane, items, exc)
+            elapsed = time.perf_counter() - started
+            with self._wake:
+                self._inflight = None
+                self._dispatch_ewma = (
+                    elapsed if not self._dispatch_ewma
+                    else 0.8 * self._dispatch_ewma + 0.2 * elapsed
+                )
+
+    # -- failure resolution -------------------------------------------------- #
+    def _safe_on_error(self, lane, items: List[Any], exc: BaseException) -> None:
+        if self._on_error is None:
+            return
+        try:
+            self._on_error(lane, items, exc)
+        except Exception:  # noqa: BLE001 — resolution is best-effort by here
+            pass
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        """Drain every lane, failing each batch through ``on_error`` — the
+        no-orphaned-waiters backstop for crash/stop paths."""
+        while True:
+            with self._wake:
+                batch = None
+                for lane, queue in self._lanes.items():
+                    if queue:
+                        batch = lane, [queue.popleft()[1] for _ in range(len(queue))]
+                        break
+                if batch is None:
+                    return
+            self._safe_on_error(batch[0], batch[1], exc)
 
     # -- lifecycle ---------------------------------------------------------- #
     def start(self) -> "MicroBatcher":
@@ -157,20 +283,55 @@ class MicroBatcher:
             if self._running:
                 return self
             self._running = True
+            self.worker_crashes = 0
+            worker = self._worker
+            if worker is not None and worker.is_alive():
+                # a previous stop() timed out on a wedged dispatch: that
+                # thread still owns the dispatch loop and resumes it when the
+                # dispatch returns — spawning a second worker here would put
+                # two threads on the device path (the single-worker invariant)
+                self._wake.notify_all()
+                return self
         self._worker = threading.Thread(target=self._run, name="serve-microbatcher", daemon=True)
         self._worker.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
-        """Stop accepting work, flush queued items through ``dispatch``, join."""
+        """Stop accepting work, flush queued items through ``dispatch``, join.
+
+        If the worker is dead or wedged past ``timeout``, every remaining item
+        — queued AND in flight — is failed through ``on_error`` instead: a
+        submitted item never outlives ``stop()`` unresolved.
+        """
         with self._wake:
             if not self._running and self._worker is None:
                 return
             self._running = False
             self._wake.notify_all()
-        if self._worker is not None:
-            self._worker.join(timeout=timeout)
-            self._worker = None
+        worker = self._worker
+        if worker is not None:
+            worker.join(timeout=timeout)
+            if not worker.is_alive():
+                self._worker = None
+            # a wedged worker keeps its handle: a later start() must resume
+            # it, never run a second dispatcher beside it
+        # a healthy worker drained everything before exiting; leftovers mean
+        # it crashed out or is wedged in a dispatch — fail them, don't hang
+        self._fail_pending(
+            ServiceClosed("MicroBatcher stopped before this request was served")
+        )
+        if worker is not None and worker.is_alive():
+            with self._wake:
+                inflight, self._inflight = self._inflight, None
+            if inflight is not None:
+                self._safe_on_error(
+                    inflight[0],
+                    inflight[1],
+                    ServiceClosed(
+                        "MicroBatcher stopped while this batch was in flight "
+                        "(worker wedged past the join timeout)"
+                    ),
+                )
 
     def __enter__(self) -> "MicroBatcher":
         return self.start()
@@ -186,4 +347,8 @@ class MicroBatcher:
                 "dispatched_rows": self.dispatched_rows,
                 "deadline_flushes": self.deadline_flushes,
                 "full_flushes": self.full_flushes,
+                "shed": self.shed,
+                "worker_crashes": self.worker_crashes,
+                "queued": sum(len(queue) for queue in self._lanes.values()),
+                "max_depth": self.max_depth,
             }

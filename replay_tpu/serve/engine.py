@@ -133,6 +133,8 @@ class ScoringEngine:
         self.hit_calls = 0
         self.hit_rows = 0
         self.hit_slots = 0
+        self.encode_failures = 0
+        self.hit_failures = 0
 
     # -- routing ------------------------------------------------------------ #
     def route_length(self, length: int) -> int:
@@ -151,10 +153,22 @@ class ScoringEngine:
         mode; both cut to the real row count, device-resident."""
         compiled = self._encoders[length_bucket]
         rows = item_ids.shape[0]
+        try:
+            out = compiled(item_ids, padding_mask, candidates=self.candidates)
+            # async dispatch surfaces device-side failures at materialization,
+            # which would otherwise happen at the caller's np.asarray — block
+            # here (the worker materializes immediately anyway) so the failure
+            # lands in THIS try and the accounting below stays truthful
+            out = jax.block_until_ready(out)
+        except Exception:
+            # failed calls are not credited as served rows/slots (the fill
+            # ratio must reflect work that produced scores) but ARE counted —
+            # the breaker's raw material
+            self.encode_failures += 1
+            raise
         self.encode_calls += 1
         self.encode_rows += rows
         self.encode_slots += self.batch_bucket(rows)
-        out = compiled(item_ids, padding_mask, candidates=self.candidates)
         if self.outputs == "both":
             return out
         return None, out
@@ -168,14 +182,20 @@ class ScoringEngine:
         hidden = np.asarray(hidden, np.float32)
         rows = hidden.shape[0]
         bucket = self.batch_bucket(rows)
-        self.hit_calls += 1
-        self.hit_rows += rows
-        self.hit_slots += bucket
         if rows < bucket:
             hidden = np.concatenate(
                 [hidden, np.repeat(hidden[:1], bucket - rows, 0)]
             )
-        logits = self._hidden_scorers[bucket](hidden, self.candidates)
+        try:
+            logits = jax.block_until_ready(
+                self._hidden_scorers[bucket](hidden, self.candidates)
+            )
+        except Exception:
+            self.hit_failures += 1
+            raise
+        self.hit_calls += 1
+        self.hit_rows += rows
+        self.hit_slots += bucket
         return logits[:rows]
 
     def record_ranked_batch(self, rows: int, bucket: int) -> None:
@@ -194,5 +214,7 @@ class ScoringEngine:
             "encode_rows": self.encode_rows,
             "hit_calls": self.hit_calls,
             "hit_rows": self.hit_rows,
+            "encode_failures": self.encode_failures,
+            "hit_failures": self.hit_failures,
             "batch_fill_ratio": rows / slots if slots else 0.0,
         }

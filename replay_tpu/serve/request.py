@@ -16,7 +16,8 @@ from typing import Any, Hashable, Optional, Sequence, Tuple
 import numpy as np
 
 # how a response was produced, in decreasing order of cache leverage
-SERVED_FROM = ("hit", "advance", "cold")
+# ("fallback" is the degradation ladder's host-side floor — no device state)
+SERVED_FROM = ("hit", "advance", "cold", "fallback")
 
 
 @dataclass
@@ -34,6 +35,10 @@ class ScoreRequest:
         their pipeline's ``top_k``.
     :param candidates: per-request candidate item ids, scored by exact gather
         from the full-catalog scores (full mode only).
+    :param deadline_ms: end-to-end latency budget. A request still queued when
+        it expires is dropped at batch-build time (its future fails with
+        :class:`~replay_tpu.serve.errors.DeadlineExceeded`) and never reaches
+        the device. ``None`` = no deadline.
     """
 
     user_id: Hashable
@@ -41,6 +46,7 @@ class ScoreRequest:
     new_items: Sequence[int] = ()
     k: Optional[int] = None
     candidates: Optional[Sequence[int]] = None
+    deadline_ms: Optional[float] = None
 
 
 @dataclass
@@ -63,6 +69,11 @@ class ScoreResponse:
     # bucket program, so (lane, batch_bucket) pins the exact program whose
     # direct forward_inference output this response reproduces bit-for-bit.
     batch_bucket: int = 0
+    # which degradation-ladder rung produced this response (see serve.degrade):
+    # "primary" keeps the full bitwise parity contract; "cache_only" scored a
+    # possibly-stale cached state through the hit lane; "fallback" is the
+    # host-side popularity floor. Degraded traffic is always visible here.
+    served_by: str = "primary"
 
 
 @dataclass
@@ -84,6 +95,19 @@ class PendingRequest:
     embedding: Optional[np.ndarray] = None  # [E] — pure-hit lane only
     enqueued_at: float = 0.0
     extra: Tuple[Any, ...] = field(default=())
+    # resilience bookkeeping: expires_at is perf_counter-absolute (from the
+    # request's deadline_ms); served_by tags the ladder rung this pending was
+    # routed to; stale_embedding carries the PRE-mutation cached state so an
+    # overload/breaker reroute can still serve cache_only after the window
+    # already advanced (advance_user drops the embedding it certifies)
+    expires_at: Optional[float] = None
+    served_by: str = "primary"
+    stale_embedding: Optional[np.ndarray] = None
+    stale_length: int = 0
+    # why this pending was degraded (breaker_open/overload); the on_degrade
+    # event is emitted only AFTER its enqueue succeeds — a rerouted request
+    # must produce one degrade event, for the rung that actually took it
+    degrade_reason: Optional[str] = None
 
 
 def make_window(

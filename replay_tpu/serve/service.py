@@ -30,18 +30,33 @@ bucket qualifier is XLA reality: the same math compiled at two different batch
 shapes may differ in the last float ulp; every response carries its
 ``batch_bucket`` so the exact program is always reconstructible.)
 
+Resilience (docs/serving.md "Overload and degradation"): admission control
+bounds every lane's queue (``max_queue_depth`` — beyond it, futures fail fast
+with :class:`RequestShed`); per-request ``deadline_ms`` budgets are enforced
+at batch-build time so expired waiters never reach the device; consecutive
+engine failures open a :class:`CircuitBreaker` over the encode path; and
+under an open breaker or a saturated lane, traffic walks the degradation
+ladder — cache-only scoring (the existing hit lane, encode skipped), then the
+host-side :class:`FallbackScorer` floor. Every response's ``served_by`` names
+its rung; ``served_by == "primary"`` responses keep the full parity contract.
+
 Observability: requests record ``queue_wait`` spans (cross-thread, via
 ``obs.trace.lifecycle_span``), batches record ``batch_build``/``score`` and
 the pipeline's ``retrieve``/``rerank`` spans; ``on_serve_start`` /
 ``on_serve_batch`` / ``on_serve_end`` events flow through any
-:class:`~replay_tpu.obs.RunLogger`, and ``on_serve_end`` carries the serve
-goodput breakdown (``SERVE_GOODPUT_SPANS`` fractions, summing to 1.0).
+:class:`~replay_tpu.obs.RunLogger` — joined by ``on_shed`` / ``on_breaker`` /
+``on_degrade`` from the resilience layer — and ``on_serve_end`` carries the
+serve goodput breakdown (``SERVE_GOODPUT_SPANS`` fractions, summing to 1.0)
+plus the shed / deadline-miss / degradation totals ``obs.report`` renders and
+gates on.
 """
 
 from __future__ import annotations
 
 import threading
-from concurrent.futures import Future
+import time
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -50,14 +65,32 @@ from replay_tpu.obs import TrainerEvent, Tracer
 from replay_tpu.obs.trace import SERVE_GOODPUT_SPANS, goodput_breakdown, lifecycle_span
 
 from .batcher import MicroBatcher
+from .breaker import CircuitBreaker
 from .cache import UserState, UserStateCache
+from .degrade import FallbackScorer
 from .engine import ScoringEngine
+from .errors import CircuitOpen, DeadlineExceeded, RequestShed
 from .pipeline import CandidatePipeline
 from .request import PendingRequest, ScoreRequest, ScoreResponse, make_window
 
 
 class ScoringService:
-    """Thread-safe online scoring over a trained sequential model."""
+    """Thread-safe online scoring over a trained sequential model.
+
+    Resilience knobs (see docs/serving.md for tuning guidance):
+
+    :param max_queue_depth: per-lane queued-request bound. ``None`` (default)
+        sizes it automatically at ``16 x`` the largest batch bucket; ``0``
+        disables the bound (the pre-resilience unbounded behavior).
+    :param default_deadline_ms: end-to-end budget applied to requests that
+        don't carry their own ``deadline_ms``. ``None`` = no default deadline.
+    :param breaker: the engine :class:`CircuitBreaker`; ``None`` builds one
+        with defaults. Its ``on_transition`` is wired to ``on_breaker`` events.
+    :param fallback: optional :class:`FallbackScorer` — the degradation
+        ladder's host-side floor. Without it, requests that can't be absorbed
+        by cache-only scoring fail fast (:class:`CircuitOpen` under an open
+        breaker, :class:`RequestShed` under overload).
+    """
 
     def __init__(
         self,
@@ -74,6 +107,10 @@ class ScoringService:
         tracer: Optional[Tracer] = None,
         logger=None,
         trace_path: Optional[str] = None,
+        max_queue_depth: Optional[int] = None,
+        default_deadline_ms: Optional[float] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        fallback: Optional[FallbackScorer] = None,
     ) -> None:
         if retrieval is not None and candidates is not None:
             msg = "retrieval mode and a fixed candidate slate are mutually exclusive"
@@ -88,6 +125,7 @@ class ScoringService:
         self.tracer = tracer if tracer is not None else Tracer(enabled=False)
         self.logger = logger
         self.trace_path = trace_path
+        self.default_deadline_ms = default_deadline_ms
         self.engine = ScoringEngine(
             model,
             params,
@@ -98,16 +136,35 @@ class ScoringService:
             outputs="hidden" if retrieval is not None else "both",
         )
         self.cache = UserStateCache(cache_capacity)
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # chain, don't clobber: a caller-supplied on_transition (alerting
+        # hooks etc.) keeps firing after the service's event forwarding
+        self._chained_transition = self.breaker.on_transition
+        self.breaker.on_transition = self._on_breaker_transition
+        self.fallback = fallback
+        if max_queue_depth is None:
+            max_queue_depth = 16 * max(self.engine.batch_buckets)
         self.batcher = MicroBatcher(
             dispatch=self._dispatch,
             capacity=max(self.engine.batch_buckets),
             max_wait=max_wait_ms / 1000.0,
             on_error=self._on_dispatch_error,
+            max_depth=max_queue_depth if max_queue_depth else None,
         )
         self._count_lock = threading.Lock()
         self._requests = 0
         self._errors = 0
-        self._served_from: Dict[str, int] = {"hit": 0, "advance": 0, "cold": 0}
+        self._shed = 0
+        self._deadline_misses = 0
+        self._cancelled = 0
+        self._circuit_refusals = 0
+        self._served_from: Dict[str, int] = {
+            "hit": 0, "advance": 0, "cold": 0, "fallback": 0
+        }
+        self._served_by: Dict[str, int] = {"primary": 0, "cache_only": 0, "fallback": 0}
+        # key -> (last_emit_time, pending_count, event, payload); pending
+        # counts are flushed by the key's next post-window emit or at close()
+        self._throttle: Dict[str, Tuple[float, int, str, Dict[str, Any]]] = {}
         self._queue_wait_sum = 0.0
         self._queue_wait_max = 0.0
         self._goodput_t0: Dict[str, float] = {}
@@ -130,15 +187,22 @@ class ScoringService:
                 "batch_buckets": list(self.engine.batch_buckets),
                 "max_wait_ms": self.batcher.max_wait * 1000.0,
                 "cache_capacity": self.cache.capacity,
+                "max_queue_depth": self.batcher.max_depth,
+                "default_deadline_ms": self.default_deadline_ms,
+                "fallback": self.fallback is not None,
             },
         )
         return self
 
     def close(self) -> None:
+        """Stop the service. Every still-pending future is RESOLVED before
+        this returns — flushed through the engine when the worker is healthy,
+        failed with a real exception when it is not (never left to hang)."""
         if not self._started:
             return
         self.batcher.stop()
         self._started = False
+        self._flush_throttled()
         payload = dict(self.stats())
         snapshot = self.tracer.snapshot()
         diff = {
@@ -168,35 +232,91 @@ class ScoringService:
         new_items: Sequence[int] = (),
         k: Optional[int] = None,
         candidates: Optional[Sequence[int]] = None,
+        deadline_ms: Optional[float] = None,
     ) -> "Future[ScoreResponse]":
-        """Enqueue one scoring request; resolves to a :class:`ScoreResponse`."""
+        """Enqueue one scoring request; resolves to a :class:`ScoreResponse`.
+
+        Never blocks and never hangs: admission refusals (a full lane, an open
+        breaker with no degraded mode available) fail the returned future
+        immediately with :class:`RequestShed` / :class:`CircuitOpen`, and a
+        ``deadline_ms`` budget (default: the service's ``default_deadline_ms``)
+        drops the request at batch-build time once expired.
+        """
         future: "Future[ScoreResponse]" = Future()
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
         request = ScoreRequest(
             user_id=user_id,
             history=history,
             new_items=tuple(new_items),
             k=k,
             candidates=candidates,
+            deadline_ms=deadline_ms,
         )
         with self._count_lock:
             self._requests += 1
+        expires_at = (
+            time.perf_counter() + deadline_ms / 1000.0
+            if deadline_ms is not None  # 0.0 = already expired, NOT no-deadline
+            else None
+        )
         try:
-            lane, pending = self._resolve(request, future)
-            self.batcher.submit(lane, pending)
+            resolved = self._resolve(request, future)
+            if resolved is None:  # answered inline by the fallback floor
+                return future
+            lane, pending = resolved
+            pending.expires_at = expires_at
+            try:
+                self.batcher.submit(lane, pending)
+                self._emit_degraded(pending)
+            except RequestShed as shed:
+                if not self._absorb_overload(lane, pending, shed):
+                    with self._count_lock:
+                        self._shed += 1
+                    self._emit_throttled(
+                        f"shed:{self._lane_name(lane)}",
+                        "on_shed",
+                        {
+                            "lane": self._lane_name(lane),
+                            "depth": shed.depth,
+                            "max_depth": shed.max_depth,
+                            "retry_after_s": shed.retry_after_s,
+                        },
+                    )
+                    self._safe_fail(future, shed)
+        except CircuitOpen as exc:
+            with self._count_lock:
+                self._circuit_refusals += 1
+            self._safe_fail(future, exc)
         except Exception as exc:  # noqa: BLE001 — surface through the future
             with self._count_lock:
                 self._errors += 1
-            future.set_exception(exc)
+            self._safe_fail(future, exc)
         return future
 
     def score(self, user_id, timeout: Optional[float] = 60.0, **kwargs) -> ScoreResponse:
-        """Synchronous :meth:`submit`."""
-        return self.submit(user_id, **kwargs).result(timeout=timeout)
+        """Synchronous :meth:`submit`.
+
+        ``timeout`` doubles as the request's ``deadline_ms`` (unless one was
+        passed explicitly), and a timed-out wait CANCELS the request so the
+        batch builder skips it — an abandoned waiter never costs a scoring
+        slot (the serving analog of the cache's stale-refresh drop).
+        """
+        if timeout is not None and "deadline_ms" not in kwargs:
+            kwargs["deadline_ms"] = timeout * 1000.0
+        future = self.submit(user_id, **kwargs)
+        try:
+            return future.result(timeout=timeout)
+        except FutureTimeoutError:
+            future.cancel()
+            raise
 
     # -- request resolution (client thread) --------------------------------- #
     def _resolve(
         self, request: ScoreRequest, future: "Future[ScoreResponse]"
-    ) -> Tuple[Hashable, PendingRequest]:
+    ) -> Optional[Tuple[Hashable, PendingRequest]]:
+        """Route a request to a (lane, pending) — or answer it inline
+        (fallback floor, returning None)."""
         if request.candidates is not None and self.mode != "full":
             msg = (
                 f"per-request candidates need the full-scoring service "
@@ -229,12 +349,16 @@ class ScoringService:
                 generation=previous.generation + 1 if previous else 0,
             )
             self.cache.store(request.user_id, state)
-            return self._encode_pending(request, future, state, "cold")
+            return self._encode_or_degrade(request, future, state, "cold", previous)
 
         if request.new_items:
             # atomic lookup+advance+store: concurrent appends for one user
             # must both land (an unlocked read-modify-write would let the
-            # last store erase the other's interaction)
+            # last store erase the other's interaction). The pre-advance
+            # embedding is peeked first: it is the cache_only rung's material
+            # if the encode path is down (the interaction still lands either
+            # way — degradation never loses the event)
+            previous = self.cache.peek(request.user_id)
             advanced = self.cache.advance_user(
                 request.user_id, request.new_items, self.pad_id
             )
@@ -244,7 +368,7 @@ class ScoringService:
                     "provide history= for the cold path"
                 )
                 raise KeyError(msg)
-            return self._encode_pending(request, future, advanced, "advance")
+            return self._encode_or_degrade(request, future, advanced, "advance", previous)
         state = self.cache.lookup(request.user_id)
         if state is None:
             msg = (
@@ -264,7 +388,156 @@ class ScoringService:
             return "hit", pending
         # cached window whose embedding is still in flight (or was raced
         # away): re-encode the cached window — still no history re-send
-        return self._encode_pending(request, future, state, "advance")
+        return self._encode_or_degrade(request, future, state, "advance", state)
+
+    def _encode_or_degrade(
+        self,
+        request: ScoreRequest,
+        future: "Future[ScoreResponse]",
+        state: UserState,
+        served_from: str,
+        previous: Optional[UserState],
+    ) -> Optional[Tuple[Hashable, PendingRequest]]:
+        """The primary encode route, gated by the breaker; refused traffic
+        walks the degradation ladder instead."""
+        stale_embedding = previous.embedding if previous is not None else None
+        stale_length = previous.length if previous is not None else 0
+        if self.breaker.allow():
+            lane, pending = self._encode_pending(request, future, state, served_from)
+            pending.stale_embedding = stale_embedding
+            pending.stale_length = stale_length
+            return lane, pending
+        return self._degrade(
+            request, future, stale_embedding, stale_length, reason="breaker_open"
+        )
+
+    def _cache_only_pending(
+        self,
+        request: ScoreRequest,
+        future: "Future[ScoreResponse]",
+        embedding: np.ndarray,
+        length: int,
+        reason: str,
+        expires_at: Optional[float] = None,
+    ) -> PendingRequest:
+        """The cache_only rung's pending: the stale cached state routed to the
+        hit lane. The on_degrade emit happens at enqueue success, not here."""
+        return PendingRequest(
+            request=request,
+            future=future,
+            served_from="hit",
+            embedding=embedding,
+            length=length,
+            enqueued_at=self.tracer.now(),
+            expires_at=expires_at,
+            served_by="cache_only",
+            degrade_reason=reason,
+        )
+
+    def _emit_degraded(self, pending: PendingRequest) -> None:
+        """Called once the degraded pending is SAFELY enqueued: a cache_only
+        attempt that gets shed and re-rides the fallback floor must log one
+        degrade event — for the rung that actually took it."""
+        if pending.served_by == "cache_only" and pending.degrade_reason:
+            self._emit_throttled(
+                f"degrade:cache_only:{pending.degrade_reason}",
+                "on_degrade",
+                {"to": "cache_only", "reason": pending.degrade_reason},
+            )
+
+    def _degrade(
+        self,
+        request: ScoreRequest,
+        future: "Future[ScoreResponse]",
+        stale_embedding: Optional[np.ndarray],
+        stale_length: int,
+        reason: str,
+    ) -> Optional[Tuple[Hashable, PendingRequest]]:
+        """Walk the ladder below primary: cache_only (hit lane on the stale
+        cached state), then the fallback floor, then an explicit refusal."""
+        if stale_embedding is not None:
+            pending = self._cache_only_pending(
+                request, future, stale_embedding, stale_length, reason
+            )
+            return "hit", pending
+        if self.fallback is not None:
+            self._finish_fallback(request, future, reason=reason)
+            return None
+        raise CircuitOpen(self.breaker.retry_after_s())
+
+    def _absorb_overload(
+        self, lane, pending: PendingRequest, shed: RequestShed
+    ) -> bool:
+        """A shed encode-lane request may still ride a cheaper rung: the hit
+        lane on its stale cached state, else the fallback floor. Returns
+        whether the request was absorbed."""
+        request = pending.request
+        if lane != "hit" and pending.stale_embedding is not None:
+            degraded = self._cache_only_pending(
+                request,
+                pending.future,
+                pending.stale_embedding,
+                pending.stale_length,
+                reason="overload",
+                expires_at=pending.expires_at,
+            )
+            try:
+                self.batcher.submit("hit", degraded)
+            except RequestShed:
+                pass  # hit lane saturated too — next rung
+            else:
+                self._emit_degraded(degraded)
+                return True
+        if self.fallback is not None:
+            self._finish_fallback(request, pending.future, reason="overload")
+            return True
+        return False
+
+    def _finish_fallback(
+        self, request: ScoreRequest, future: "Future[ScoreResponse]", reason: str
+    ) -> None:
+        response = self._fallback_response(request)
+        if self._safe_set_result(future, response):
+            with self._count_lock:
+                # under _count_lock: += on the scorer attribute is a
+                # read-modify-write racing client threads otherwise
+                self.fallback.served += 1
+                self._served_by["fallback"] += 1
+                self._served_from["fallback"] += 1
+            self._emit_throttled(
+                f"degrade:fallback:{reason}",
+                "on_degrade",
+                {"to": "fallback", "reason": reason},
+            )
+
+    def _fallback_response(self, request: ScoreRequest) -> ScoreResponse:
+        """Host-side popularity answer shaped like the mode's primary one."""
+        if self.retrieval is not None:
+            k = request.k if request.k is not None else self.retrieval.top_k
+            scores, item_ids = self.fallback.score(k=k)
+        elif self.mode == "slate":
+            scores, item_ids = self.fallback.score(
+                candidates=np.asarray(self.engine.candidates, np.int64)
+            )
+            if request.k is not None:
+                order = np.argsort(-scores, kind="stable")[: request.k]
+                scores, item_ids = scores[order], item_ids[order]
+        elif request.candidates is not None:
+            scores, item_ids = self.fallback.score(candidates=request.candidates)
+        elif request.k is not None:
+            scores, item_ids = self.fallback.score(k=request.k)
+        else:
+            scores, item_ids = self.fallback.score()
+        return ScoreResponse(
+            user_id=request.user_id,
+            scores=np.asarray(scores),
+            item_ids=np.asarray(item_ids) if item_ids is not None else None,
+            served_from="fallback",
+            lane="fallback",
+            queue_wait_s=0.0,
+            batch_bucket=0,
+            served_by="fallback",
+        )
 
     def _encode_pending(
         self,
@@ -288,16 +561,65 @@ class ScoringService:
 
     # -- dispatch (serve-worker thread) ------------------------------------- #
     def _on_dispatch_error(self, lane, items: List[PendingRequest], exc: BaseException) -> None:
-        with self._count_lock:
-            self._errors += len(items)
+        failed = 0
         for item in items:
-            if not item.future.done():
-                item.future.set_exception(exc)
+            if self._safe_fail(item.future, exc):
+                failed += 1
+        with self._count_lock:
+            self._errors += failed
 
     def _lane_name(self, lane) -> str:
         return "hit" if lane == "hit" else f"encode:L={lane[1]}"
 
+    def _admit(
+        self, items: List[PendingRequest]
+    ) -> Tuple[List[PendingRequest], int, int]:
+        """Batch-build admission: drop expired-deadline and client-abandoned
+        requests BEFORE any device work, committing the survivors (their
+        futures move to RUNNING, so a late ``cancel()`` no longer bites)."""
+        now = time.perf_counter()
+        live: List[PendingRequest] = []
+        expired = abandoned = 0
+        for item in items:
+            future = item.future
+            if future.done():
+                abandoned += 1  # failed at close/crash, or cancelled+finalized
+                continue
+            if item.expires_at is not None and now >= item.expires_at:
+                deadline_s = (item.request.deadline_ms or 0.0) / 1000.0
+                waited = now - (item.expires_at - deadline_s)
+                self._safe_fail(future, DeadlineExceeded(waited, deadline_s))
+                expired += 1
+                continue
+            if not self._mark_running(future):
+                abandoned += 1  # score(timeout=...) gave up on this waiter
+                continue
+            live.append(item)
+        if expired or abandoned:
+            with self._count_lock:
+                self._deadline_misses += expired
+                self._cancelled += abandoned
+        return live, expired, abandoned
+
     def _dispatch(self, lane, items: List[PendingRequest]) -> None:
+        items, expired, abandoned = self._admit(items)
+        if not items:
+            if expired or abandoned:
+                # a fully-dropped batch (deadline storm, mass abandonment) is
+                # exactly the batch the drop accounting must not go dark on
+                self._emit(
+                    "on_serve_batch",
+                    {
+                        "lane": self._lane_name(lane),
+                        "rows": 0,
+                        "bucket": 0,
+                        "fill": 0.0,
+                        "queue_wait_ms_max": 0.0,
+                        "dropped_expired": expired,
+                        "dropped_cancelled": abandoned,
+                    },
+                )
+            return
         waits = [
             lifecycle_span(self.tracer, "queue_wait", item.enqueued_at, lane=self._lane_name(lane))
             for item in items
@@ -321,9 +643,18 @@ class ScoringService:
                 ids_batch = np.stack([item.window[-length_bucket:] for item in items])
                 mask_batch = np.stack([item.mask[-length_bucket:] for item in items])
             with self.tracer.span("score", rows=rows, lane=self._lane_name(lane)):
-                logits_dev, hidden_dev = self.engine.encode(length_bucket, ids_batch, mask_batch)
-                hidden_np = np.asarray(hidden_dev)
-                logits = np.asarray(logits_dev) if logits_dev is not None else None
+                # the breaker's raw material: one engine call = one outcome
+                # (a batch-wide exception counts once, not once per rider)
+                try:
+                    logits_dev, hidden_dev = self.engine.encode(
+                        length_bucket, ids_batch, mask_batch
+                    )
+                    hidden_np = np.asarray(hidden_dev)
+                    logits = np.asarray(logits_dev) if logits_dev is not None else None
+                except Exception:
+                    self.breaker.record_failure()
+                    raise
+                self.breaker.record_success()
             for item, embedding in zip(items, hidden_np):
                 state = item.extra[0]
                 self.cache.refresh_embedding(item.request.user_id, state, embedding)
@@ -344,15 +675,19 @@ class ScoringService:
                     ranked_ids=ids[row] if ids is not None else None,
                 )
             except Exception as exc:  # noqa: BLE001
+                if self._safe_fail(item.future, exc):
+                    with self._count_lock:
+                        self._errors += 1
+                continue
+            if not self._safe_set_result(item.future, response):
                 with self._count_lock:
-                    self._errors += 1
-                item.future.set_exception(exc)
+                    self._cancelled += 1
                 continue
             with self._count_lock:
                 self._served_from[item.served_from] += 1
+                self._served_by[item.served_by] += 1
                 self._queue_wait_sum += wait
                 self._queue_wait_max = max(self._queue_wait_max, wait)
-            item.future.set_result(response)
 
         self._emit(
             "on_serve_batch",
@@ -362,6 +697,8 @@ class ScoringService:
                 "bucket": bucket,
                 "fill": rows / bucket if bucket else 0.0,
                 "queue_wait_ms_max": max(waits) * 1000.0 if waits else 0.0,
+                "dropped_expired": expired,
+                "dropped_cancelled": abandoned,
             },
         )
 
@@ -410,12 +747,89 @@ class ScoringService:
             lane=lane_name,
             queue_wait_s=queue_wait,
             batch_bucket=batch_bucket,
+            served_by=item.served_by,
         )
+
+    # -- future resolution helpers ------------------------------------------ #
+    @staticmethod
+    def _mark_running(future: Future) -> bool:
+        try:
+            return future.set_running_or_notify_cancel()
+        except RuntimeError:
+            # a finished future raises bare RuntimeError here (NOT
+            # InvalidStateError): another thread resolved it between the
+            # done() check and this commit — treat it as abandoned
+            return False
+
+    @staticmethod
+    def _safe_fail(future: Future, exc: BaseException) -> bool:
+        try:
+            if not future.done():
+                future.set_exception(exc)
+                return True
+        except InvalidStateError:
+            pass
+        return False
+
+    @staticmethod
+    def _safe_set_result(future: Future, result: ScoreResponse) -> bool:
+        try:
+            if not future.done():
+                future.set_result(result)
+                return True
+        except InvalidStateError:
+            pass
+        return False
 
     # -- accounting --------------------------------------------------------- #
     def _emit(self, event: str, payload: Dict[str, Any]) -> None:
         if self.logger is not None:
             self.logger.log_event(TrainerEvent(event=event, payload=payload))
+
+    def _emit_throttled(
+        self, key: str, event: str, payload: Dict[str, Any], min_interval: float = 0.5
+    ) -> None:
+        """Per-key rate-limited emit: the first occurrence always lands, then
+        at most one event per ``min_interval`` carrying the coalesced
+        ``count`` — an overload storm must not flood events.jsonl."""
+        now = time.perf_counter()
+        with self._count_lock:
+            entry = self._throttle.get(key)
+            last, pending_count = (entry[0], entry[1]) if entry else (None, 0)
+            pending_count += 1
+            if last is None or now - last >= min_interval:
+                self._throttle[key] = (now, 0, event, payload)
+                emit_count = pending_count
+            else:
+                self._throttle[key] = (last, pending_count, event, payload)
+                emit_count = 0
+        if emit_count:
+            payload = dict(payload)
+            payload["count"] = emit_count
+            self._emit(event, payload)
+
+    def _flush_throttled(self) -> None:
+        """Emit every key's still-pending coalesced count (at close): a burst
+        that ends inside a throttle window must not silently lose its tail —
+        summing ``count`` over events.jsonl has to reproduce the totals."""
+        with self._count_lock:
+            pending = [
+                (event, dict(payload), count)
+                for (_, count, event, payload) in self._throttle.values()
+                if count
+            ]
+            self._throttle = {}
+        for event, payload, count in pending:
+            payload["count"] = count
+            self._emit(event, payload)
+
+    def _on_breaker_transition(self, old: str, new: str, info: Dict[str, Any]) -> None:
+        self._emit("on_breaker", {"from": old, "to": new, **info})
+        if self._chained_transition is not None:
+            try:
+                self._chained_transition(old, new, info)
+            except Exception:  # noqa: BLE001 — an alerting hook raising must
+                pass  # not poison the dispatch path that recorded the outcome
 
     def stats(self) -> Dict[str, Any]:
         engine = self.engine.stats()
@@ -423,8 +837,13 @@ class ScoringService:
         batcher = self.batcher.stats()
         with self._count_lock:
             served = dict(self._served_from)
+            served_by = dict(self._served_by)
             requests = self._requests
             errors = self._errors
+            shed = self._shed
+            deadline_misses = self._deadline_misses
+            cancelled = self._cancelled
+            circuit_refusals = self._circuit_refusals
             wait_sum = self._queue_wait_sum
             wait_max = self._queue_wait_max
         answered = sum(served.values())
@@ -435,6 +854,16 @@ class ScoringService:
             "answered": answered,
             "errors": errors,
             "served_from": served,
+            "served_by": served_by,
+            "shed": shed,
+            "deadline_misses": deadline_misses,
+            "cancelled": cancelled,
+            "circuit_refusals": circuit_refusals,
+            "degraded": served_by["cache_only"] + served_by["fallback"],
+            # the rates obs.report renders and --compare gates (lower-better)
+            "shed_rate": shed / requests if requests else 0.0,
+            "deadline_miss_rate": deadline_misses / requests if requests else 0.0,
+            "error_rate": errors / requests if requests else 0.0,
             # state reuse: requests served from cached state (pure hits +
             # one-step advances) over answered requests
             "cache_hit_rate": reused / answered if answered else 0.0,
@@ -442,6 +871,7 @@ class ScoringService:
             "batch_fill_ratio": engine["batch_fill_ratio"],
             "queue_wait_ms_mean": wait_sum / answered * 1000.0 if answered else 0.0,
             "queue_wait_ms_max": wait_max * 1000.0,
+            "breaker": self.breaker.stats(),
             "engine": engine,
             "cache": cache,
             "batcher": batcher,

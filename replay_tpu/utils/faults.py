@@ -1,11 +1,11 @@
 """Deterministic fault injection for the resilience layer (docs/robustness.md).
 
 No reference-stack counterpart (Lightning tests its fault-tolerant loop with
-ad-hoc monkeypatching); here the failure modes the trainer must survive —
-NaN batches, preemption signals, truncated checkpoint files — are injected
-through one small harness so every recovery path in
-``tests/nn/test_fault_tolerance.py`` is exercised reproducibly on the
-8-device virtual CPU mesh:
+ad-hoc monkeypatching); here the failure modes the trainer AND the scoring
+service must survive — NaN batches, preemption signals, truncated checkpoint
+files, engine exceptions, latency spikes — are injected through one small
+harness so every recovery path in ``tests/nn/test_fault_tolerance.py`` and
+``tests/serve/`` is exercised reproducibly on the 8-device virtual CPU mesh:
 
 * :class:`NaNInjector` poisons chosen batches of a stream (exercises the
   in-jit non-finite sentinel and ``RecoveryPolicy`` rollback);
@@ -13,20 +13,35 @@ through one small harness so every recovery path in
   (exercises :class:`~replay_tpu.nn.train.PreemptionHandler` end-to-end,
   through the actual OS signal machinery);
 * :func:`truncate_file` chops a checkpoint payload as a crash mid-write would
-  (exercises ``CheckpointManager``'s skip-and-report integrity scan).
+  (exercises ``CheckpointManager``'s skip-and-report integrity scan);
+* :class:`EngineErrorAt` makes a wrapped callable (e.g.
+  ``ScoringEngine.encode``) raise :class:`InjectedFault` at chosen call
+  indices (exercises the serve circuit breaker and future-failure paths);
+* :class:`LatencySpike` delays a wrapped callable at chosen call indices
+  (exercises deadline enforcement, queue-bound shedding and the client-side
+  ``score(timeout=...)`` abandonment drop).
 
-Injection positions are 0-based GLOBAL batch indices counted across every
-``wrap`` call of one injector instance, so a multi-epoch ``fit`` stream hits
-the same absolute steps regardless of epoch boundaries.
+Injection positions are 0-based GLOBAL indices (batch indices for the stream
+injectors, call indices for the callable injectors) counted across every
+``wrap`` call of one injector instance, so a multi-epoch ``fit`` stream — or
+a long-lived serve worker — hits the same absolute positions regardless of
+epoch/batch boundaries.
 """
 
 from __future__ import annotations
 
+import functools
 import os
 import signal
-from typing import Any, Dict, Iterable, Iterator, Optional, Sequence
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the chaos harness — never by real engine code, so
+    tests and the chaos bench can tell injected failures from organic ones."""
 
 
 def inject_nan(batch: Dict[str, Any], fields: Optional[Sequence[str]] = None) -> Dict[str, Any]:
@@ -111,6 +126,75 @@ class SignalAtStep:
                 signal.raise_signal(self.sig)
             self.position += 1
             yield batch
+
+
+class _CallIndexInjector:
+    """Shared scaffolding for the callable injectors: one GLOBAL call-index
+    counter across every ``wrap()`` target (the callable analog of the stream
+    injectors' global batch indices), ``injected_at`` recording the calls that
+    fired, and a :meth:`_fire` hook run BEFORE the wrapped call — raising from
+    it suppresses the call entirely, returning lets it proceed."""
+
+    def __init__(self, at_calls: Iterable[int]) -> None:
+        self.at_calls = frozenset(int(c) for c in at_calls)
+        self.position = 0  # global call index across wrap() targets
+        self.injected_at: list = []
+
+    def _fire(self, position: int) -> None:
+        raise NotImplementedError
+
+    def wrap(self, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            position = self.position
+            self.position += 1
+            if position in self.at_calls:
+                self.injected_at.append(position)
+                self._fire(position)
+            return fn(*args, **kwargs)
+
+        return wrapped
+
+
+class EngineErrorAt(_CallIndexInjector):
+    """Make a wrapped callable raise :class:`InjectedFault` at the given
+    global call indices — the serve-side chaos injector.
+
+    >>> injector = EngineErrorAt(at_calls=range(3))
+    >>> # service.engine.encode = injector.wrap(service.engine.encode)
+    >>> # the first 3 encodes now fail; consecutive failures trip the breaker
+
+    The fault raises BEFORE the wrapped call, so an injected failure costs no
+    device work — exactly like a transport/runtime error surfacing at
+    dispatch. ``injected_at`` records the call indices that actually fired.
+    """
+
+    def _fire(self, position: int) -> None:
+        msg = f"injected engine error at call {position}"
+        raise InjectedFault(msg)
+
+
+class LatencySpike(_CallIndexInjector):
+    """Delay a wrapped callable by ``duration_s`` at the given global call
+    indices — models a device stall / host GC pause / network hiccup without
+    changing any result. ``injected_at`` records the calls that slept.
+    """
+
+    def __init__(self, at_calls: Iterable[int], duration_s: float = 0.05) -> None:
+        super().__init__(at_calls)
+        self.duration_s = float(duration_s)
+
+    def _fire(self, position: int) -> None:
+        time.sleep(self.duration_s)
+
+
+def wrap_method(obj: Any, name: str, injector: Any) -> Any:
+    """Instance-patch ``obj.name`` with ``injector.wrap`` (chaos entrypoint:
+    ``wrap_method(service.engine, "encode", EngineErrorAt(...))``). Returns
+    the original bound method so callers can restore it."""
+    original = getattr(obj, name)
+    setattr(obj, name, injector.wrap(original))
+    return original
 
 
 def truncate_file(path: str, keep_fraction: float = 0.5, keep_bytes: Optional[int] = None) -> int:

@@ -408,6 +408,174 @@ def test_compare_serve_within_threshold_passes(tmp_path):
 
 
 # --------------------------------------------------------------------------- #
+# serving resilience: shed / deadline-miss / error rates, breaker, chaos
+# --------------------------------------------------------------------------- #
+def _write_resilient_serve_run(path, error_rate=0.0, deadline_miss_rate=0.0,
+                               shed_rate=0.0, overload=True, chaos=True):
+    os.makedirs(path, exist_ok=True)
+    events = [
+        {"event": "on_serve_start", "time": 1.0, "mode": "retrieval",
+         "max_queue_depth": 64, "default_deadline_ms": 250.0, "fallback": True},
+        {"event": "on_shed", "time": 1.2, "lane": "encode:L=8", "depth": 64,
+         "max_depth": 64, "retry_after_s": 0.05, "count": 17},
+        {"event": "on_breaker", "time": 1.3, "from": "closed", "to": "open",
+         "consecutive_failures": 5, "opens": 1},
+        {"event": "on_degrade", "time": 1.35, "to": "cache_only",
+         "reason": "breaker_open", "count": 3},
+        {"event": "on_breaker", "time": 1.6, "from": "open", "to": "half_open",
+         "consecutive_failures": 5, "opens": 1},
+        {"event": "on_breaker", "time": 1.7, "from": "half_open", "to": "closed",
+         "consecutive_failures": 0, "opens": 1},
+        {"event": "on_serve_end", "time": 3.0, "mode": "retrieval",
+         "requests": 100, "answered": 80, "errors": int(error_rate * 100),
+         "cache_hit_rate": 0.9, "batch_fill_ratio": 0.8,
+         "served_from": {"hit": 60, "advance": 10, "cold": 10},
+         "served_by": {"primary": 70, "cache_only": 8, "fallback": 2},
+         "shed": int(shed_rate * 100), "deadline_misses": 4, "cancelled": 1,
+         "circuit_refusals": 2, "degraded": 10,
+         "shed_rate": shed_rate, "deadline_miss_rate": deadline_miss_rate,
+         "error_rate": error_rate},
+    ]
+    record = {
+        "metric": "serve_qps", "value": 200.0, "unit": "req/s", "qps": 200.0,
+        "p50_ms": 1.2, "p95_ms": 3.1, "p99_ms": 4.5, "batch_fill_ratio": 0.8,
+        "cache_hit_rate": 0.9, "mode": "retrieval", "backend": "cpu",
+        "serve_shed_rate": shed_rate,
+        "serve_deadline_miss_rate": deadline_miss_rate,
+        "serve_error_rate": error_rate,
+        "served_by": {"primary": 70, "cache_only": 8, "fallback": 2},
+        "breaker": {"state": "closed", "opens": 1, "closes": 1},
+        "hung_requests": 0,
+    }
+    if overload:
+        record["overload"] = {
+            "rate": 800.0, "p99_ms": 40.0, "shed_rate": shed_rate,
+            "deadline_miss_rate": deadline_miss_rate, "hung_requests": 0,
+        }
+    if chaos:
+        record["chaos"] = {
+            "injected_engine_errors": 5, "breaker_opens": 1,
+            "breaker_state_final": "closed", "recovered": True,
+            "hung_requests": 0, "storm_deadline_missed": 12,
+        }
+    events.append(record)
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+    return path
+
+
+def test_serve_resilience_summarizes_and_renders(tmp_path, capsys):
+    run = _write_resilient_serve_run(
+        str(tmp_path / "serve"), error_rate=0.01, deadline_miss_rate=0.04,
+        shed_rate=0.2,
+    )
+    summary = summarize_run(run)
+    serve = summary["serve"]
+    assert serve["shed_rate"] == 0.2
+    assert serve["deadline_miss_rate"] == 0.04
+    assert serve["error_rate"] == 0.01
+    assert serve["served_by"] == {"primary": 70, "cache_only": 8, "fallback": 2}
+    assert serve["breaker"]["opens"] == 1
+    assert serve["shed_events"] == 1
+    assert serve["breaker_events"] == 3
+    assert serve["degrade_events"] == 1
+    assert serve["overload"] is True
+    assert serve["overload_p99_ms"] == 40.0
+    assert serve["chaos"]["breaker_opens"] == 1
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "serving resilience:" in out
+    assert "shed rate 20.00%" in out
+    assert "deadline-miss rate 4.00%" in out
+    assert "error rate 1.00%" in out
+    assert "degraded 10 (cache_only:8/fallback:2)" in out
+    assert "breaker closed (1 open(s))" in out
+    assert "hung 0" in out
+    assert "serving overload:" in out
+    assert "serving chaos:" in out
+    assert "5 injected error(s)" in out
+
+
+def test_compare_gates_on_serve_error_rate_rise(tmp_path, capsys):
+    baseline = _write_resilient_serve_run(str(tmp_path / "base"), error_rate=0.0)
+    candidate = _write_resilient_serve_run(str(tmp_path / "cand"), error_rate=0.05)
+    # the absolute floor matters: relative-only would never fire on 0 -> 0.05
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "serve_error_rate regressed" in capsys.readouterr().err
+
+
+def test_compare_gates_on_serve_deadline_miss_rate_rise(tmp_path, capsys):
+    baseline = _write_resilient_serve_run(
+        str(tmp_path / "base"), deadline_miss_rate=0.01
+    )
+    candidate = _write_resilient_serve_run(
+        str(tmp_path / "cand"), deadline_miss_rate=0.10
+    )
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "serve_deadline_miss_rate regressed" in capsys.readouterr().err
+
+
+def test_compare_gates_shed_rate_only_when_both_ran_overload(tmp_path, capsys):
+    baseline = _write_resilient_serve_run(
+        str(tmp_path / "base"), shed_rate=0.1, overload=True
+    )
+    worse = _write_resilient_serve_run(
+        str(tmp_path / "cand"), shed_rate=0.5, overload=True
+    )
+    assert main([worse, "--compare", baseline]) == 2
+    assert "serve_shed_rate regressed" in capsys.readouterr().err
+    # candidate without the overload phase: surfaced, NOT gated
+    no_overload = _write_resilient_serve_run(
+        str(tmp_path / "cand2"), shed_rate=0.5, overload=False
+    )
+    assert main([no_overload, "--compare", baseline]) == 0
+    assert "not gated: both sides must run overload" in capsys.readouterr().out
+
+
+def test_compare_skips_rate_gates_when_phases_mismatch(tmp_path, capsys):
+    """The run-wide rates are dominated by the opt-in phases: a chaos run's
+    injected errors (or an overload run's designed deadline misses) must not
+    gate against a baseline that never ran the phase."""
+    baseline = _write_resilient_serve_run(
+        str(tmp_path / "base"), error_rate=0.0, deadline_miss_rate=0.0,
+        overload=False, chaos=False,
+    )
+    candidate = _write_resilient_serve_run(
+        str(tmp_path / "cand"), error_rate=0.03, deadline_miss_rate=0.08,
+        overload=True, chaos=True,
+    )
+    assert main([candidate, "--compare", baseline]) == 0
+    out = capsys.readouterr().out
+    assert "serve_error_rate" in out and "chaos phase ran on one side only" in out
+    assert "overload phase ran on one side only" in out
+
+
+def test_compare_resilience_rates_within_floor_pass(tmp_path):
+    baseline = _write_resilient_serve_run(
+        str(tmp_path / "base"), error_rate=0.0, deadline_miss_rate=0.01,
+        shed_rate=0.1,
+    )
+    candidate = _write_resilient_serve_run(
+        str(tmp_path / "cand"), error_rate=0.004, deadline_miss_rate=0.012,
+        shed_rate=0.1,
+    )
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+def test_compare_resilience_improvement_passes(tmp_path):
+    baseline = _write_resilient_serve_run(
+        str(tmp_path / "base"), error_rate=0.05, deadline_miss_rate=0.1,
+        shed_rate=0.4,
+    )
+    candidate = _write_resilient_serve_run(
+        str(tmp_path / "cand"), error_rate=0.0, deadline_miss_rate=0.0,
+        shed_rate=0.1,
+    )
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+# --------------------------------------------------------------------------- #
 # resource gates: peak memory + compile time (lower-better), bench-row skips
 # --------------------------------------------------------------------------- #
 def _write_resource_run(path, peak_memory=1_000_000, compile_seconds=2.0):

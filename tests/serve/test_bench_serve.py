@@ -1,4 +1,7 @@
-"""bench_serve.py emits one parseable JSON record with finite serving metrics."""
+"""bench_serve.py emits one parseable JSON record with finite serving metrics —
+and, with overload + chaos enabled, the resilience accounting the acceptance
+criteria gate on (bounded p99 with nonzero shed, zero hung futures, a breaker
+that opens and recovers)."""
 
 import json
 import os
@@ -33,6 +36,18 @@ def test_bench_serve_one_json_line(tmp_path):
         "REPLAY_TPU_SERVE_CANDIDATES": "10",
         "REPLAY_TPU_SERVE_TOPK": "3",
         "REPLAY_TPU_SERVE_BATCH_BUCKETS": "1,4",
+        # resilience phases: open-loop overload at 4x measured capacity with
+        # per-request deadlines, then deterministic chaos injection
+        "REPLAY_TPU_SERVE_CHAOS": "1",
+        "REPLAY_TPU_SERVE_OVERLOAD_SECONDS": "1",
+        # the tiny CPU model outruns a single open-loop generator thread, so
+        # admission control must be made reachable: tight lanes + a high
+        # factor (the default 4x/auto-depth shape is for real configs)
+        "REPLAY_TPU_SERVE_MAX_DEPTH": "4",
+        "REPLAY_TPU_SERVE_OVERLOAD_FACTOR": "16",
+        "REPLAY_TPU_SERVE_DEADLINE_MS": "150",
+        "REPLAY_TPU_SERVE_BREAKER_THRESHOLD": "3",
+        "REPLAY_TPU_SERVE_BREAKER_RESET_MS": "100",
     }
     out = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "bench_serve.py")],
@@ -54,3 +69,36 @@ def test_bench_serve_one_json_line(tmp_path):
     assert record["request_errors"] == 0
     assert record["mode"] == "retrieval"
     assert record["shape_override"]["L"] == 8
+
+    # run-wide resilience rates (the --compare gate inputs) are present/finite
+    for key in ("serve_shed_rate", "serve_deadline_miss_rate", "serve_error_rate"):
+        assert 0.0 <= record[key] <= 1.0, key
+    assert record["hung_requests"] == 0
+
+    # overload: arrivals ≫ capacity, bounded lanes must shed or drop expired
+    # waiters — and p99 of COMPLETED requests stays bounded (nothing can queue
+    # past its deadline, so latency is capped near deadline + one dispatch)
+    overload = record["overload"]
+    refused = (
+        overload["shed"] + overload["deadline_missed"] + overload["circuit_refused"]
+    )
+    assert refused > 0, overload
+    assert overload["submitted"] > overload["completed"]
+    assert overload["hung_requests"] == 0
+    assert overload["p99_ms"] <= 150 + 1000, overload  # deadline + slack, not ∞
+    assert overload["errors"] == 0
+
+    # chaos: injected engine faults tripped the breaker, degraded traffic is
+    # tagged, the breaker re-closed, and no future was left hanging
+    chaos = record["chaos"]
+    assert chaos["injected_engine_errors"] == 3
+    assert chaos["breaker_opens"] >= 1
+    assert chaos["breaker_state_after_trip"] == "open"
+    assert chaos["recovered"] is True
+    assert chaos["breaker_state_final"] == "closed"
+    assert chaos["served_by_seen"]["advance_while_open"] == "cache_only"
+    assert chaos["served_by_seen"]["cold_while_open"] == "fallback"
+    assert chaos["client_abandoned"] == 1
+    assert chaos["storm_deadline_missed"] > 0
+    assert chaos["hung_requests"] == 0
+    assert record["breaker"]["opens"] >= 1

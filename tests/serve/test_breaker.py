@@ -1,0 +1,222 @@
+"""CircuitBreaker state machine: closed → open → half-open → closed (host-only).
+
+Clock is injected, so every timed transition is deterministic — no sleeps.
+"""
+
+import pytest
+
+from replay_tpu.serve import CircuitBreaker
+
+
+class Clock:
+    def __init__(self, now: float = 100.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def _breaker(clock, threshold=3, reset=2.0, probes=1, transitions=None):
+    return CircuitBreaker(
+        failure_threshold=threshold,
+        reset_timeout_s=reset,
+        half_open_max_probes=probes,
+        clock=clock,
+        on_transition=(
+            (lambda old, new, info: transitions.append((old, new)))
+            if transitions is not None
+            else None
+        ),
+    )
+
+
+class TestClosed:
+    def test_starts_closed_and_allows(self):
+        breaker = _breaker(Clock())
+        assert breaker.state == "closed"
+        assert all(breaker.allow() for _ in range(10))
+        assert breaker.retry_after_s() is None
+
+    def test_below_threshold_failures_stay_closed(self):
+        breaker = _breaker(Clock(), threshold=3)
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state == "closed"
+        assert breaker.allow()
+
+    def test_success_resets_the_consecutive_streak(self):
+        """reset-on-success: N-1 failures + success + N-1 failures never open."""
+        breaker = _breaker(Clock(), threshold=3)
+        for _ in range(2):
+            breaker.record_failure()
+        breaker.record_success()
+        assert breaker.stats()["consecutive_failures"] == 0
+        for _ in range(2):
+            breaker.record_failure()
+        assert breaker.state == "closed"
+        breaker.record_failure()  # the streak completes only uninterrupted
+        assert breaker.state == "open"
+
+    def test_non_consecutive_failures_never_trip(self):
+        breaker = _breaker(Clock(), threshold=2)
+        for _ in range(10):
+            breaker.record_failure()
+            breaker.record_success()
+        assert breaker.state == "closed"
+        assert breaker.stats()["opens"] == 0
+
+
+class TestOpen:
+    def test_threshold_consecutive_failures_open(self):
+        transitions = []
+        breaker = _breaker(Clock(), threshold=3, transitions=transitions)
+        for _ in range(3):
+            breaker.record_failure()
+        assert breaker.state == "open"
+        assert transitions == [("closed", "open")]
+        assert breaker.stats()["opens"] == 1
+
+    def test_open_refuses_and_counts_refusals(self):
+        breaker = _breaker(Clock(), threshold=1, reset=5.0)
+        breaker.record_failure()
+        assert not breaker.allow()
+        assert not breaker.allow()
+        assert breaker.stats()["refusals"] == 2
+
+    def test_retry_after_tracks_the_remaining_window(self):
+        clock = Clock()
+        breaker = _breaker(clock, threshold=1, reset=2.0)
+        breaker.record_failure()
+        assert breaker.retry_after_s() == pytest.approx(2.0)
+        clock.advance(1.5)
+        assert breaker.retry_after_s() == pytest.approx(0.5)
+        clock.advance(10.0)
+        assert breaker.retry_after_s() == 0.0  # clamped, never negative
+
+    def test_extra_failures_while_open_do_not_reopen(self):
+        breaker = _breaker(Clock(), threshold=1)
+        breaker.record_failure()
+        breaker.record_failure()  # e.g. an in-flight call landing late
+        assert breaker.stats()["opens"] == 1
+
+
+class TestHalfOpen:
+    def test_reset_timeout_admits_a_probe(self):
+        clock = Clock()
+        transitions = []
+        breaker = _breaker(clock, threshold=1, reset=2.0, transitions=transitions)
+        breaker.record_failure()
+        assert not breaker.allow()
+        clock.advance(2.0)
+        assert breaker.allow()  # the probe
+        assert breaker.state == "half_open"
+        assert transitions == [("closed", "open"), ("open", "half_open")]
+
+    def test_probe_limit_refuses_beyond_max_probes(self):
+        clock = Clock()
+        breaker = _breaker(clock, threshold=1, reset=1.0, probes=2)
+        breaker.record_failure()
+        clock.advance(1.0)
+        assert breaker.allow()  # probe 1 (open -> half_open admits it)
+        assert breaker.allow()  # probe 2
+        assert not breaker.allow()  # over the probe budget
+        assert not breaker.allow()
+        assert breaker.stats()["refusals"] == 2
+
+    def test_probe_success_closes_with_a_full_reset(self):
+        clock = Clock()
+        transitions = []
+        breaker = _breaker(clock, threshold=2, reset=1.0, transitions=transitions)
+        breaker.record_failure()
+        breaker.record_failure()
+        clock.advance(1.0)
+        assert breaker.allow()
+        breaker.record_success()
+        assert breaker.state == "closed"
+        assert transitions[-1] == ("half_open", "closed")
+        stats = breaker.stats()
+        assert stats["closes"] == 1
+        assert stats["consecutive_failures"] == 0
+        assert breaker.retry_after_s() is None
+        # fully reset: it takes the full threshold to open again
+        breaker.record_failure()
+        assert breaker.state == "closed"
+
+    def test_probe_failure_reopens_and_restarts_the_timer(self):
+        clock = Clock()
+        breaker = _breaker(clock, threshold=1, reset=2.0)
+        breaker.record_failure()
+        clock.advance(2.0)
+        assert breaker.allow()
+        clock.advance(1.7)  # mid-probe time passes before the outcome lands
+        breaker.record_failure()
+        assert breaker.state == "open"
+        assert breaker.stats()["opens"] == 2
+        # the window restarts at the REOPEN, not the original open
+        assert breaker.retry_after_s() == pytest.approx(2.0)
+        assert not breaker.allow()
+        clock.advance(2.0)
+        assert breaker.allow()
+
+    def test_abandoned_probe_slots_are_reclaimed(self):
+        """A probe admitted by allow() may never produce an outcome (shed,
+        deadline-expired or cancelled before reaching the engine). Half-open
+        must reclaim the slot after reset_timeout_s — an abandoned probe must
+        not wedge the breaker in half-open forever."""
+        clock = Clock()
+        breaker = _breaker(clock, threshold=1, reset=2.0, probes=1)
+        breaker.record_failure()
+        clock.advance(2.0)
+        assert breaker.allow()  # the probe — then it vanishes, no outcome
+        assert not breaker.allow()  # slot held within the window
+        clock.advance(2.0)
+        assert breaker.allow()  # slot reclaimed: a fresh probe is admitted
+        breaker.record_success()
+        assert breaker.state == "closed"
+
+    def test_reclaimed_probe_failure_still_reopens(self):
+        clock = Clock()
+        breaker = _breaker(clock, threshold=1, reset=1.0)
+        breaker.record_failure()
+        clock.advance(1.0)
+        assert breaker.allow()
+        clock.advance(1.0)
+        assert breaker.allow()  # reclaim
+        breaker.record_failure()
+        assert breaker.state == "open"
+        assert breaker.stats()["opens"] == 2
+
+    def test_round_trip_closed_open_half_open_closed(self):
+        clock = Clock()
+        transitions = []
+        breaker = _breaker(clock, threshold=2, reset=0.5, transitions=transitions)
+        for _ in range(2):
+            breaker.record_failure()
+        clock.advance(0.5)
+        assert breaker.allow()
+        breaker.record_success()
+        assert [t for t in transitions] == [
+            ("closed", "open"),
+            ("open", "half_open"),
+            ("half_open", "closed"),
+        ]
+
+
+class TestValidation:
+    def test_rejects_zero_threshold(self):
+        with pytest.raises(ValueError, match="failure_threshold"):
+            CircuitBreaker(failure_threshold=0)
+
+    def test_rejects_zero_probes(self):
+        with pytest.raises(ValueError, match="half_open_max_probes"):
+            CircuitBreaker(half_open_max_probes=0)
+
+    def test_stats_shape(self):
+        stats = _breaker(Clock()).stats()
+        assert set(stats) == {
+            "state", "consecutive_failures", "opens", "closes",
+            "refusals", "failures", "successes",
+        }

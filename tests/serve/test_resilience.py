@@ -1,0 +1,268 @@
+"""MicroBatcher resilience + FallbackScorer (host-only, core tier).
+
+Admission control (bounded lanes → RequestShed), worker supervision
+(crash → restart → give-up budget), and the no-orphaned-waiters contract:
+a submitted item never outlives ``stop()`` unresolved — the batcher-level
+regression tests for the serve-side orphaned-waiter bugs.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from replay_tpu.serve import (
+    FallbackScorer,
+    MicroBatcher,
+    RequestShed,
+    ServiceClosed,
+)
+
+
+class Wedge:
+    """A dispatch that blocks until released — the wedged-worker scenario."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self.batches = []
+
+    def __call__(self, lane, items):
+        self.batches.append((lane, list(items)))
+        self.entered.set()
+        self.release.wait(timeout=30.0)
+
+
+class TestAdmissionControl:
+    def test_submit_beyond_max_depth_sheds(self):
+        wedge = Wedge()
+        batcher = MicroBatcher(wedge, capacity=1, max_wait=0.001, max_depth=2).start()
+        try:
+            batcher.submit("a", "inflight")
+            assert wedge.entered.wait(timeout=5.0)  # worker wedged mid-dispatch
+            batcher.submit("a", "q1")
+            batcher.submit("a", "q2")  # queue now at max_depth
+            with pytest.raises(RequestShed) as info:
+                batcher.submit("a", "over")
+            assert info.value.lane == "a"
+            assert info.value.depth == 2
+            assert info.value.max_depth == 2
+            assert info.value.retry_after_s is not None
+            assert info.value.retry_after_s >= 0.0
+            assert batcher.stats()["shed"] == 1
+            # other lanes have their own bound — not collaterally shed
+            batcher.submit("b", "fine")
+        finally:
+            wedge.release.set()
+            batcher.stop()
+
+    def test_unbounded_by_default(self):
+        wedge = Wedge()
+        batcher = MicroBatcher(wedge, capacity=1, max_wait=0.001).start()
+        try:
+            batcher.submit("a", "inflight")
+            assert wedge.entered.wait(timeout=5.0)
+            for i in range(100):  # the pre-resilience behavior, explicitly kept
+                batcher.submit("a", i)
+            assert batcher.stats()["shed"] == 0
+        finally:
+            wedge.release.set()
+            batcher.stop()
+
+    def test_shed_happens_before_enqueue(self):
+        """A refused submit leaves no dangling state: depth is unchanged."""
+        wedge = Wedge()
+        batcher = MicroBatcher(wedge, capacity=1, max_wait=0.001, max_depth=1).start()
+        try:
+            batcher.submit("a", "inflight")
+            assert wedge.entered.wait(timeout=5.0)
+            batcher.submit("a", "queued")
+            for _ in range(3):
+                with pytest.raises(RequestShed):
+                    batcher.submit("a", "over")
+            assert batcher.queued_depth("a") == 1
+        finally:
+            wedge.release.set()
+            batcher.stop()
+
+
+class TestNoOrphanedWaiters:
+    def test_stop_fails_pending_when_worker_is_wedged(self):
+        """The orphaned-waiter regression: a wedged dispatch must not let
+        stop() hang or strand queued + in-flight items unresolved."""
+        wedge = Wedge()
+        failed = []
+        batcher = MicroBatcher(
+            wedge,
+            capacity=1,
+            max_wait=0.001,
+            on_error=lambda lane, items, exc: failed.append((list(items), exc)),
+        ).start()
+        batcher.submit("a", "inflight")
+        assert wedge.entered.wait(timeout=5.0)
+        batcher.submit("a", "queued1")
+        batcher.submit("a", "queued2")
+        start = time.perf_counter()
+        batcher.stop(timeout=0.2)  # far below the wedge's 30s
+        assert time.perf_counter() - start < 5.0
+        resolved = [item for items, _ in failed for item in items]
+        assert sorted(resolved) == ["inflight", "queued1", "queued2"]
+        assert all(isinstance(exc, ServiceClosed) for _, exc in failed)
+        wedge.release.set()  # let the daemon thread die
+
+    def test_stop_resolves_items_whose_dispatch_raises(self):
+        failed = []
+
+        def explode(lane, items):
+            raise RuntimeError("boom")
+
+        batcher = MicroBatcher(
+            explode,
+            capacity=8,
+            max_wait=60.0,  # stop() must not wait for the deadline
+            on_error=lambda lane, items, exc: failed.append((list(items), exc)),
+        ).start()
+        for i in range(5):
+            batcher.submit("a", i)
+        batcher.stop()
+        assert sorted(item for items, _ in failed for item in items) == list(range(5))
+
+    def test_restart_after_wedged_stop_never_runs_two_workers(self):
+        """stop() timing out on a wedged dispatch must not let a later
+        start() spawn a second dispatcher beside the still-alive thread —
+        the single-worker (single device caller) invariant."""
+        wedge = Wedge()
+        failed = []
+        batcher = MicroBatcher(
+            wedge,
+            capacity=1,
+            max_wait=0.001,
+            on_error=lambda lane, items, exc: failed.append(list(items)),
+        ).start()
+        batcher.submit("a", "inflight")
+        assert wedge.entered.wait(timeout=5.0)
+        batcher.stop(timeout=0.1)  # the worker is still inside the wedge
+        batcher.start()
+        workers = [
+            t for t in threading.enumerate()
+            if t.name == "serve-microbatcher" and t.is_alive()
+        ]
+        assert len(workers) == 1, f"{len(workers)} dispatcher threads alive"
+        batcher.submit("a", "after-restart")
+        wedge.release.set()  # the original worker resumes and serves on
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            if any("after-restart" in items for _, items in wedge.batches):
+                break
+            time.sleep(0.01)
+        assert any("after-restart" in items for _, items in wedge.batches)
+        batcher.stop()
+
+    def test_submit_after_stop_raises_service_closed(self):
+        batcher = MicroBatcher(lambda lane, items: None, capacity=2).start()
+        batcher.stop()
+        with pytest.raises(ServiceClosed, match="not running"):
+            batcher.submit("a", 1)
+
+
+class TestWorkerSupervision:
+    def test_on_error_raising_crashes_and_restarts_the_worker(self):
+        dispatched = []
+        on_error_calls = []
+
+        def dispatch(lane, items):
+            dispatched.append(list(items))
+            if len(dispatched) == 1:
+                raise RuntimeError("engine down")
+
+        def on_error(lane, items, exc):
+            on_error_calls.append((list(items), exc))
+            if len(on_error_calls) == 1:
+                raise RuntimeError("resolution failed too")  # crashes the worker
+
+        batcher = MicroBatcher(
+            dispatch, capacity=1, max_wait=0.001, on_error=on_error
+        ).start()
+        try:
+            batcher.submit("a", "crasher")
+            deadline = time.perf_counter() + 5.0
+            while batcher.stats()["worker_crashes"] < 1 and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert batcher.stats()["worker_crashes"] == 1
+            # the crashed batch was re-routed through on_error by the supervisor
+            assert [items for items, _ in on_error_calls] == [["crasher"], ["crasher"]]
+            batcher.submit("a", "survivor")  # the restarted worker serves on
+            deadline = time.perf_counter() + 5.0
+            while ["survivor"] not in dispatched and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert ["survivor"] in dispatched
+        finally:
+            batcher.stop()
+
+    def test_exhausted_restart_budget_fails_pending_and_refuses_new_work(self):
+        failed = []
+
+        class Hardware(BaseException):
+            """Non-Exception: escapes dispatch straight to the supervisor."""
+
+        def dispatch(lane, items):
+            raise Hardware()
+
+        batcher = MicroBatcher(
+            dispatch,
+            capacity=1,
+            max_wait=0.001,
+            on_error=lambda lane, items, exc: failed.append((list(items), exc)),
+            max_worker_restarts=1,
+        ).start()
+        batcher.submit("a", "first")
+        deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < deadline:
+            try:
+                batcher.submit("a", "feed")  # keep the crash loop fed
+            except ServiceClosed:
+                break
+            time.sleep(0.005)
+        with pytest.raises(ServiceClosed):
+            batcher.submit("a", "after-give-up")
+        assert batcher.stats()["worker_crashes"] == 2  # initial + 1 restart
+        # everything submitted before the give-up resolved through on_error
+        assert failed, "no items were failed"
+        batcher.stop()  # idempotent after the give-up
+
+
+class TestFallbackScorer:
+    def test_ranking_is_stable_descending_with_id_tiebreak(self):
+        scorer = FallbackScorer([1.0, 5.0, 5.0, 0.0])
+        np.testing.assert_array_equal(scorer.ranking, [1, 2, 0, 3])
+
+    def test_top_k(self):
+        scorer = FallbackScorer([0.0, 10.0, 3.0, 7.0])
+        scores, ids = scorer.score(k=2)
+        np.testing.assert_array_equal(ids, [1, 3])
+        np.testing.assert_array_equal(scores, [10.0, 7.0])
+
+    def test_candidate_gather(self):
+        scorer = FallbackScorer([0.0, 10.0, 3.0, 7.0])
+        scores, ids = scorer.score(candidates=[3, 0])
+        np.testing.assert_array_equal(ids, [3, 0])
+        np.testing.assert_array_equal(scores, [7.0, 0.0])
+
+    def test_full_vector_mode(self):
+        scorer = FallbackScorer([2.0, 1.0])
+        scores, ids = scorer.score()
+        assert ids is None
+        np.testing.assert_array_equal(scores, [2.0, 1.0])
+
+    def test_from_interactions_counts(self):
+        scorer = FallbackScorer.from_interactions([1, 1, 2, 1, 3], num_items=5)
+        np.testing.assert_array_equal(scorer.item_scores, [0, 3, 1, 1, 0])
+        _, ids = scorer.score(k=1)
+        assert ids[0] == 1
+
+    def test_rejects_empty_and_2d(self):
+        with pytest.raises(ValueError):
+            FallbackScorer([])
+        with pytest.raises(ValueError):
+            FallbackScorer(np.ones((2, 2)))

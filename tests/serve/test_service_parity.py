@@ -352,7 +352,7 @@ class TestObservability:
         assert "on_serve_batch" in kinds
         end = events[-1]
         assert end["requests"] == 3 and end["answered"] == 3
-        assert end["served_from"] == {"hit": 1, "advance": 1, "cold": 1}
+        assert end["served_from"] == {"hit": 1, "advance": 1, "cold": 1, "fallback": 0}
         assert end["cache_hit_rate"] == pytest.approx(2.0 / 3.0)
         fractions = end["goodput"]["fractions"]
         assert sum(fractions.values()) == pytest.approx(1.0)

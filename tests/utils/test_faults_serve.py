@@ -1,0 +1,85 @@
+"""Serve-side chaos injectors (utils/faults): deterministic, restorable (core).
+
+The stream injectors (NaNInjector / SignalAtStep) are exercised through the
+trainer's fault-tolerance suite; these cover the callable injectors the serve
+chaos harness wraps around ``ScoringEngine`` methods.
+"""
+
+import time
+
+import pytest
+
+from replay_tpu.utils.faults import (
+    EngineErrorAt,
+    InjectedFault,
+    LatencySpike,
+    wrap_method,
+)
+
+
+class TestEngineErrorAt:
+    def test_raises_at_chosen_call_indices_only(self):
+        injector = EngineErrorAt(at_calls=[1, 3])
+        calls = []
+        wrapped = injector.wrap(lambda x: calls.append(x) or x * 2)
+        assert wrapped(1) == 2
+        with pytest.raises(InjectedFault, match="call 1"):
+            wrapped(2)
+        assert wrapped(3) == 6
+        with pytest.raises(InjectedFault):
+            wrapped(4)
+        assert wrapped(5) == 10
+        assert injector.injected_at == [1, 3]
+        assert calls == [1, 3, 5]  # injected calls never reach the target
+
+    def test_positions_are_global_across_wrap_targets(self):
+        """Like the stream injectors' global batch indices: one instance, one
+        position counter, regardless of how many callables it wraps."""
+        injector = EngineErrorAt(at_calls=[2])
+        first = injector.wrap(lambda: "a")
+        second = injector.wrap(lambda: "b")
+        assert first() == "a"  # 0
+        assert second() == "b"  # 1
+        with pytest.raises(InjectedFault):
+            first()  # 2 — global index, not per-wrap
+        assert injector.injected_at == [2]
+
+    def test_injected_fault_is_distinguishable(self):
+        """Chaos accounting depends on telling injected faults from organic
+        failures — InjectedFault must be its own type."""
+        assert issubclass(InjectedFault, RuntimeError)
+        injector = EngineErrorAt(at_calls=[0])
+        with pytest.raises(InjectedFault):
+            injector.wrap(lambda: None)()
+
+
+class TestLatencySpike:
+    def test_delays_at_chosen_calls_without_changing_results(self):
+        spike = LatencySpike(at_calls=[1], duration_s=0.08)
+        wrapped = spike.wrap(lambda x: x + 1)
+        start = time.perf_counter()
+        assert wrapped(1) == 2
+        fast = time.perf_counter() - start
+        start = time.perf_counter()
+        assert wrapped(2) == 3  # the spiked call still returns the real result
+        slow = time.perf_counter() - start
+        assert slow >= 0.08
+        assert fast < slow
+        assert spike.injected_at == [1]
+
+
+class TestWrapMethod:
+    def test_patches_instance_and_returns_original_for_restore(self):
+        class Engine:
+            def encode(self, x):
+                return x * 10
+
+        engine = Engine()
+        original = wrap_method(engine, "encode", EngineErrorAt(at_calls=[0]))
+        with pytest.raises(InjectedFault):
+            engine.encode(1)
+        assert engine.encode(2) == 20  # past the injection window
+        engine.encode = original
+        assert engine.encode(1) == 10
+        # instance patch only — the class is untouched
+        assert Engine().encode(1) == 10
