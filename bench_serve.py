@@ -110,6 +110,11 @@ BREAKER_RESET_MS = float(os.environ.get("REPLAY_TPU_SERVE_BREAKER_RESET_MS", "30
 CHAOS = (
     bool(int(os.environ.get("REPLAY_TPU_SERVE_CHAOS", "0"))) or "--chaos" in sys.argv
 )
+# the live metrics plane rides every bench run: 0 = ephemeral port (the
+# default — collision-proof); -1 disables the metrics plane entirely (no
+# registry either, so the record omits its `metrics` reconciliation block —
+# CI always runs with the default and gates on that block being present)
+METRICS_PORT = int(os.environ.get("REPLAY_TPU_SERVE_METRICS_PORT", "0"))
 if "--no-overload" in sys.argv:
     OVERLOAD_SECONDS = 0.0
 SHAPE_OVERRIDE = any(_knob(k) != v for k, v in _DEFAULTS.items())
@@ -496,6 +501,7 @@ def main() -> None:
         logger=logger,
         trace_path=os.path.join(RUN_DIR, "trace.json"),
         max_queue_depth=MAX_DEPTH if MAX_DEPTH else None,
+        metrics_port=METRICS_PORT if METRICS_PORT >= 0 else None,
         breaker=CircuitBreaker(
             failure_threshold=BREAKER_THRESHOLD,
             reset_timeout_s=BREAKER_RESET_MS / 1000.0,
@@ -603,6 +609,38 @@ def main() -> None:
 
         stats = service.stats()
 
+        # ---- live scrape: the endpoint must answer WHILE serving ---------- #
+        metrics_scrape = None
+        exporter = service.metrics_exporter
+        if exporter is not None and exporter.port is not None:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"{exporter.url}/metrics", timeout=10
+            ) as response:
+                metrics_scrape = response.read().decode()
+            with open(os.path.join(RUN_DIR, "metrics.txt"), "w") as fh:
+                fh.write(metrics_scrape)
+
+    # post-close reconciliation: close() flushed the throttled on_shed tails
+    # into the bridge, so the registry counters must reproduce the service's
+    # own totals exactly — the serve_chaos CI job gates on this equality
+    metrics_record = None
+    registry = service.metrics_registry
+    if registry is not None:
+        with open(os.path.join(RUN_DIR, "metrics_snapshot.json"), "w") as fh:
+            json.dump(registry.snapshot(), fh, indent=2, default=str)
+        metrics_record = {
+            "scraped_live": metrics_scrape is not None,
+            "shed_total": registry.value("replay_serve_shed_total") or 0.0,
+            "expired_total": registry.value("replay_serve_expired_total") or 0.0,
+            "rows_total": registry.value("replay_serve_rows_total") or 0.0,
+            "qps_gauge": registry.value("replay_serve_qps"),
+            "shed_rate_gauge": registry.value("replay_serve_shed_rate"),
+            "service_shed": stats["shed"],
+            "service_deadline_misses": stats["deadline_misses"],
+        }
+
     metric = "serve_qps"
     if jax.default_backend() == "cpu" and is_fallback:
         metric += "_cpu_fallback"
@@ -643,6 +681,8 @@ def main() -> None:
         "users": USERS,
         "compile_seconds": round(compile_seconds, 2),
     }
+    if metrics_record is not None:
+        record["metrics"] = metrics_record
     if overload is not None:
         record["overload"] = overload
     if chaos is not None:

@@ -536,6 +536,11 @@ class Trainer:
         # the most recent host-fetched health record (python scalars/lists),
         # refreshed by fit every health.cadence steps
         self.last_health: Optional[Dict[str, Any]] = None
+        # live metrics plane (fit(metrics_port=...) / fit(slo_rules=...)):
+        # the registry outlives the fit for post-run inspection; the exporter
+        # handle exposes the bound port while the fit is live
+        self.metrics_registry = None
+        self.metrics_exporter = None
         self._lr_scale = 1.0  # RecoveryPolicy backoff multiplier (1.0 = none)
         self._forward_params = _signature_names(type(self.model).__call__)
         self._inference_params = (
@@ -1049,7 +1054,21 @@ class Trainer:
 
         return place
 
-    def fit(
+    def fit(self, *args, **kwargs) -> TrainState:
+        try:
+            return self._fit_impl(*args, **kwargs)
+        except BaseException:
+            # a raising fit must not leak the live metrics endpoint: the
+            # non-raising exits (and the recovery-exhausted raise) close it
+            # in finish_trace; this catches every other exit — data-pipeline
+            # errors, checkpoint failures, Ctrl-C — so a scraper never reads
+            # a crashed fit as live and the port is free for the next run
+            if self.metrics_exporter is not None:
+                self.metrics_exporter.close()
+                self.metrics_exporter = None
+            raise
+
+    def _fit_impl(
         self,
         train_batches: Iterable[Batch] | Callable[[], Iterable[Batch]],
         epochs: int = 1,
@@ -1079,6 +1098,8 @@ class Trainer:
         handle_preemption: Optional[bool] = None,
         tracer: Optional[Tracer | bool] = None,
         trace_path: Optional[str] = None,
+        metrics_port: Optional[int] = None,
+        slo_rules: Optional[Sequence[Any]] = None,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`. A dict of
@@ -1210,6 +1231,26 @@ class Trainer:
         ``trigger_recovery=True`` and a ``recovery`` policy the warning rolls
         back immediately. Enabling health is exactly one compiled train-step
         variant; the cadence is host-side, so no retraces after step 1.
+
+        Live metrics plane (docs/observability.md): ``metrics_port`` attaches
+        a :class:`~replay_tpu.obs.MetricsLogger` sink (the existing event
+        stream bridged into a thread-safe counters/gauges/histograms registry
+        — no new trainer hooks) and serves it for the duration of the fit via
+        a stdlib HTTP exporter: ``GET /metrics`` is Prometheus text,
+        ``/snapshot`` the JSON view. ``metrics_port=0`` binds an ephemeral
+        port (read it from :attr:`metrics_exporter`); a busy port degrades to
+        a logged no-op, never a failed fit. ``slo_rules`` (a sequence of
+        :class:`~replay_tpu.obs.SLORule`) attaches an
+        :class:`~replay_tpu.obs.SLOWatchdog` evaluated after every bridged
+        step event: a rule breached for its ``for_steps`` consecutive
+        evaluations emits ONE ``on_slo_violation`` through the same sinks
+        (console render, events.jsonl, ``replay_slo_violations_total``), and
+        the recovery transition emits ``on_slo_recovery`` with the breach
+        duration. Either option implies per-step events (the explicit-loggers
+        cadence); the registry stays readable after fit on
+        :attr:`metrics_registry`. Multi-host fits stamp every event with this
+        process's ``process_index`` so ``obs.report`` can merge per-process
+        shards and compute cross-host skew.
         """
         if checkpoint_manager is not None and not self.history:
             # resume: prior epoch records survive the restart (metric-history
@@ -1343,6 +1384,33 @@ class Trainer:
             explicit_loggers = (
                 [loggers] if hasattr(loggers, "log_event") else list(loggers)
             )
+        # -- live metrics plane (obs.metrics / obs.exporter / obs.slo) ------ #
+        # the MetricsLogger is an explicit sink: live gauges need per-step
+        # events, so requesting metrics/SLOs opts into the per-step device
+        # sync exactly like attaching a JsonlLogger does
+        metrics_logger = None
+        if self.metrics_exporter is not None:
+            # a previous fit raised before its terminal event: release the
+            # port before (maybe) binding a fresh exporter
+            self.metrics_exporter.close()
+            self.metrics_exporter = None
+        if metrics_port is not None or slo_rules:
+            from replay_tpu.obs.exporter import MetricsExporter
+            from replay_tpu.obs.metrics import MetricsLogger
+            from replay_tpu.obs.slo import SLOWatchdog
+
+            metrics_logger = MetricsLogger()
+            self.metrics_registry = metrics_logger.registry
+            if slo_rules:
+                # emit is pointed at the sink fan-out once run_logger exists
+                metrics_logger.watchdog = SLOWatchdog(
+                    slo_rules, metrics_logger.registry
+                )
+            explicit_loggers.append(metrics_logger)
+            if metrics_port is not None:
+                self.metrics_exporter = MetricsExporter(
+                    metrics_logger.registry, port=metrics_port
+                ).start()
         sinks: List[RunLogger] = list(explicit_loggers)
         if log_every:
             # events already arrive at log_every cadence when no explicit
@@ -1351,6 +1419,10 @@ class Trainer:
         run_logger: Optional[RunLogger] = (
             MultiLogger(sinks) if len(sinks) > 1 else (sinks[0] if sinks else None)
         )
+        if metrics_logger is not None and metrics_logger.watchdog is not None:
+            # violations ride the SAME fan-out as every other event: jsonl,
+            # console, tensorboard AND the registry's violation counter
+            metrics_logger.watchdog.emit = run_logger.log_event
         event_every = 1 if explicit_loggers else (log_every or 0)
 
         # -- span tracing + goodput accounting (replay_tpu.obs.trace) ------- #
@@ -1406,9 +1478,10 @@ class Trainer:
             return out
 
         def finish_trace() -> None:
-            """Terminal tracing work: write trace.json and detach a tracer
-            that was passed as a fit argument (a preattached :attr:`tracer`
-            stays; the argument form scopes to this fit)."""
+            """Terminal tracing work: write trace.json, stop the metrics
+            exporter, and detach a tracer that was passed as a fit argument
+            (a preattached :attr:`tracer` stays; the argument form scopes to
+            this fit)."""
             if tracing and trace_path is not None:
                 try:
                     trace.save(trace_path)
@@ -1416,9 +1489,19 @@ class Trainer:
                     logger.warning("trace.json not written to %s: %s", trace_path, exc)
             if tracer_from_arg:
                 self.tracer = prior_tracer
+            if self.metrics_exporter is not None:
+                self.metrics_exporter.close()
+                self.metrics_exporter = None
+
+        # multi-host: stamp every event with this process's index so per-
+        # process events.jsonl shards merge into ONE cross-host report
+        # (obs.report computes step-time skew / the straggler index from it)
+        event_process = jax.process_index() if jax.process_count() > 1 else None
 
         def emit(name: str, step=None, epoch=None, **payload) -> None:
             if run_logger is not None:
+                if event_process is not None:
+                    payload.setdefault("process_index", event_process)
                 run_logger.log_event(
                     TrainerEvent(event=name, step=step, epoch=epoch, payload=payload)
                 )
@@ -2269,6 +2352,10 @@ class Trainer:
         emit("on_fit_end", step=int(state.step), stopped_early=stopped_early,
              **fit_end_payload())
         return best_state if best_state is not None else state
+
+    # the public entry is the thin exception-safe wrapper above; its help()
+    # should read as the real thing
+    fit.__doc__ = _fit_impl.__doc__
 
     # -- eval / predict ---------------------------------------------------- #
     def _build_eval_logits(self):

@@ -17,6 +17,11 @@ compiled program memory- vs compute-bound against the chip's peak FLOPs/
 bandwidth tables (with HBM footprint + collective-bytes introspection via
 :mod:`replay_tpu.parallel.introspect`), and :mod:`.report` is the run-report
 CLI over the artifacts (``python -m replay_tpu.obs.report <run_dir>``).
+The LIVE half (docs/observability.md): :mod:`.metrics` keeps a thread-safe
+registry (counters/gauges/histograms) bridged from the same event stream,
+:mod:`.exporter` serves it as a scrapeable Prometheus ``/metrics`` endpoint
+(+ ``/snapshot`` JSON), and :mod:`.slo` evaluates declarative threshold rules
+at step/batch cadence, emitting ``on_slo_violation`` through the same sinks.
 Beyond-parity — SURVEY.md §5.
 """
 
@@ -30,6 +35,9 @@ from .events import (
     TensorBoardLogger,
     TrainerEvent,
 )
+from .exporter import MetricsExporter
+from .metrics import MetricsLogger, MetricsRegistry
+from .slo import SLORule, SLOWatchdog
 from .mfu import (
     PEAK_BF16_TFLOPS,
     cost_analysis,
@@ -63,8 +71,13 @@ __all__ = [
     "HealthWatcher",
     "JsonlLogger",
     "MemoryMonitor",
+    "MetricsExporter",
+    "MetricsLogger",
+    "MetricsRegistry",
     "MultiLogger",
     "NAMED_SCOPES",
+    "SLORule",
+    "SLOWatchdog",
     "PEAK_BF16_TFLOPS",
     "PEAK_HBM_GBPS",
     "RunLogger",

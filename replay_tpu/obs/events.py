@@ -146,18 +146,67 @@ class JsonlLogger(RunLogger):
     ``on_breaker``) concurrently with the worker's ``on_serve_batch``, so each
     line is serialized first and written in one locked call — concurrent
     emits can interleave lines, never tear one.
+
+    Bounded growth (week-long runs, the serving service): ``max_bytes``
+    enables size-based rotation — when appending a line would push the file
+    past the bound, ``events.jsonl`` rotates to ``events.jsonl.1`` (existing
+    backups shift up, the oldest beyond ``rotate`` is dropped) and a fresh
+    file continues the stream. ``obs.report`` reads the rotated shards oldest-
+    first, so a rotated run still summarizes as one stream (minus whatever the
+    bound evicted). A single record is never split across shards.
+
+    Multi-host runs: pass this process's ``process_index`` and non-zero
+    processes write ``events.p<i>.jsonl`` next to process 0's ``events.jsonl``
+    — the shard layout ``obs.report`` merges into one cross-host report (each
+    record additionally carries its ``process_index`` stamp).
     """
 
-    def __init__(self, run_dir: str, filename: str = "events.jsonl", mode: str = "a") -> None:
+    def __init__(
+        self,
+        run_dir: str,
+        filename: str = "events.jsonl",
+        mode: str = "a",
+        max_bytes: Optional[int] = None,
+        rotate: int = 3,
+        process_index: Optional[int] = None,
+    ) -> None:
         self.run_dir = str(run_dir)
         os.makedirs(self.run_dir, exist_ok=True)
+        if process_index:
+            root, ext = os.path.splitext(filename)
+            filename = f"{root}.p{int(process_index)}{ext}"
         self.path = os.path.join(self.run_dir, filename)
+        if max_bytes is not None and max_bytes < 1:
+            msg = "max_bytes must be a positive byte bound (or None)"
+            raise ValueError(msg)
+        if rotate < 1:
+            msg = "rotate must keep at least one backup shard"
+            raise ValueError(msg)
+        self.max_bytes = max_bytes
+        self.rotate = int(rotate)
         self._fh = open(self.path, mode)
         self._lock = threading.Lock()
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path.(i)`` → ``path.(i+1)`` (oldest dropped) and reopen a
+        fresh base file. Caller holds the lock."""
+        self._fh.close()
+        for index in range(self.rotate - 1, 0, -1):
+            source = f"{self.path}.{index}"
+            if os.path.exists(source):
+                os.replace(source, f"{self.path}.{index + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a")
 
     def log_record(self, record: Mapping[str, Any]) -> None:
         line = json.dumps(_jsonable(record), allow_nan=False) + "\n"
         with self._lock:
+            if (
+                self.max_bytes is not None
+                and self._fh.tell() > 0
+                and self._fh.tell() + len(line) > self.max_bytes
+            ):
+                self._rotate_locked()
             self._fh.write(line)
             self._fh.flush()
 
@@ -328,6 +377,51 @@ class ConsoleLogger(RunLogger):
                 "preemption (%s) at step %s: checkpoint saved, exiting",
                 event.payload.get("signal"),
                 event.step,
+            )
+        elif event.event == "on_slo_violation":
+            logger.warning(
+                "SLO violation [%s] at step %s: %s = %.4g (breached %s %.4g, "
+                "%s consecutive)",
+                event.payload.get("rule"),
+                event.step,
+                event.payload.get("metric"),
+                event.payload.get("value", float("nan")),
+                event.payload.get("op"),
+                event.payload.get("threshold", float("nan")),
+                event.payload.get("consecutive"),
+            )
+        elif event.event == "on_slo_recovery":
+            logger.info(
+                "SLO recovered [%s] at step %s: %s = %.4g after %.2fs in breach "
+                "(%s evaluation(s))",
+                event.payload.get("rule"),
+                event.step,
+                event.payload.get("metric"),
+                event.payload.get("value", float("nan")),
+                event.payload.get("breach_seconds", float("nan")),
+                event.payload.get("breached_evaluations"),
+            )
+        elif event.event == "on_shed":
+            logger.warning(
+                "overload: %s request(s) shed on lane %s (depth %s/%s)",
+                event.payload.get("count", 1),
+                event.payload.get("lane"),
+                event.payload.get("depth"),
+                event.payload.get("max_depth"),
+            )
+        elif event.event == "on_breaker":
+            logger.warning(
+                "circuit breaker %s -> %s (%s consecutive failure(s))",
+                event.payload.get("from"),
+                event.payload.get("to"),
+                event.payload.get("consecutive_failures"),
+            )
+        elif event.event == "on_degrade":
+            logger.warning(
+                "degraded: %s request(s) rerouted to %s (%s)",
+                event.payload.get("count", 1),
+                event.payload.get("to"),
+                event.payload.get("reason"),
             )
         elif event.event == "on_epoch_end":
             logger.info("epoch %s: %s", event.epoch, event.payload.get("record"))

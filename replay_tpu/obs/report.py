@@ -19,7 +19,13 @@ the resilience rates — shed / deadline-miss / error — with degraded-traffic
 counts by ladder rung and breaker state; gated on QPS drops, p99 growth, and
 lower-better ``serve_error_rate`` / ``serve_deadline_miss_rate`` rises —
 ``serve_shed_rate`` gates only when BOTH runs ran the overload phase).
-``--compare`` diffs two runs —
+A run directory is read as ONE merged stream: size-rotation backups
+(``events.jsonl.N``, oldest first) and multi-host per-process shards
+(``events.p<i>.jsonl``) fold together, each record keeping (or inheriting
+from its filename) a ``process_index`` stamp — from which the report computes
+per-host step time, the cross-host skew and the straggler index
+(max/median per-host step time). ``on_slo_violation`` events (obs.slo) are
+counted and gated lower-better. ``--compare`` diffs two runs —
 either run may be a run directory, a raw ``events.jsonl``, or a single-record
 bench JSON (``BENCH_*.json`` / ``BENCH_TPU_SIDECAR.json``) — and exits
 non-zero when the candidate regresses beyond ``--threshold`` (relative):
@@ -46,7 +52,14 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 from .trace import GOODPUT_SPANS, SERVE_GOODPUT_SPANS
 
-__all__ = ["compare_runs", "load_events", "main", "render", "summarize_run"]
+__all__ = [
+    "compare_runs",
+    "load_events",
+    "main",
+    "render",
+    "straggler_summary",
+    "summarize_run",
+]
 
 
 def _finite(value: Any) -> Optional[float]:
@@ -60,16 +73,56 @@ def _finite(value: Any) -> Optional[float]:
 # --------------------------------------------------------------------------- #
 # loading
 # --------------------------------------------------------------------------- #
-def _resolve(path: str) -> Tuple[str, Optional[str]]:
-    """(events path, trace path or None) for a run directory or a bare file."""
+def _with_rotations(path: str) -> List[str]:
+    """``path`` preceded by its size-rotation backups, oldest first
+    (``events.jsonl.3``, ``.2``, ``.1``, then ``events.jsonl`` — the order
+    :class:`~replay_tpu.obs.events.JsonlLogger(max_bytes=...)` wrote them)."""
+    import glob
+
+    rotated = []
+    for backup in glob.glob(glob.escape(path) + ".*"):
+        suffix = backup[len(path) + 1 :]
+        if suffix.isdigit():
+            rotated.append((int(suffix), backup))
+    ordered = [backup for _, backup in sorted(rotated, reverse=True)]
+    if os.path.exists(path):
+        ordered.append(path)
+    return ordered
+
+
+def _collect_event_files(run_dir: str) -> List[Tuple[str, int]]:
+    """Every events shard of a run directory as ``(path, process_index)``,
+    in merge order: process 0's rotation chain (``events.jsonl``), then each
+    non-zero process's ``events.p<i>.jsonl`` chain — the multi-host layout
+    where every process writes its own shard."""
+    import glob
+    import re
+
+    files: List[Tuple[str, int]] = [
+        (path, 0) for path in _with_rotations(os.path.join(run_dir, "events.jsonl"))
+    ]
+    shard_name = re.compile(r"events\.p(\d+)\.jsonl$")
+    shards = []
+    for path in glob.glob(os.path.join(glob.escape(run_dir), "events.p*.jsonl")):
+        match = shard_name.search(os.path.basename(path))
+        if match:
+            shards.append((int(match.group(1)), path))
+    for index, path in sorted(shards):
+        files.extend((chained, index) for chained in _with_rotations(path))
+    return files
+
+
+def _resolve(path: str) -> Tuple[List[Tuple[str, int]], Optional[str]]:
+    """([(events path, process index), ...], trace path or None) for a run
+    directory or a bare file."""
     if os.path.isdir(path):
-        events = os.path.join(path, "events.jsonl")
-        if not os.path.exists(events):
+        files = _collect_event_files(path)
+        if not files:
             msg = f"{path}: no events.jsonl in run directory"
             raise FileNotFoundError(msg)
         trace = os.path.join(path, "trace.json")
-        return events, trace if os.path.exists(trace) else None
-    return path, None
+        return files, trace if os.path.exists(trace) else None
+    return [(path, 0)], None
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -132,9 +185,40 @@ def load_trace(path: str) -> Dict[str, Dict[str, float]]:
 # --------------------------------------------------------------------------- #
 # summarizing
 # --------------------------------------------------------------------------- #
+def straggler_summary(per_process: Mapping[Any, float]) -> Dict[str, Any]:
+    """Cross-host step-time spread from per-process mean step seconds.
+
+    ``straggler_index`` is max/median (1.0 = perfectly balanced; 2.0 = the
+    slowest host takes twice the typical step), ``skew`` is the relative
+    spread ``(max - min) / median``; ``straggler`` names the slowest process.
+    Pure host math — also used by ``dryrun_multichip`` to stamp its record.
+    """
+    if not per_process:
+        msg = "straggler_summary needs at least one process"
+        raise ValueError(msg)
+    values = sorted(float(v) for v in per_process.values())
+    n = len(values)
+    median = values[n // 2] if n % 2 else 0.5 * (values[n // 2 - 1] + values[n // 2])
+    worst = max(per_process, key=lambda key: float(per_process[key]))
+    return {
+        "max_step_seconds": values[-1],
+        "median_step_seconds": median,
+        "straggler": str(worst),
+        "straggler_index": values[-1] / median if median > 0 else None,
+        "skew": (values[-1] - values[0]) / median if median > 0 else None,
+    }
+
+
 def summarize_run(path: str) -> Dict[str, Any]:
-    events_path, trace_path = _resolve(path)
-    events = load_events(events_path)
+    event_files, trace_path = _resolve(path)
+    events: List[Dict[str, Any]] = []
+    for events_path, process_index in event_files:
+        for record in load_events(events_path):
+            if process_index and "process_index" not in record:
+                # a shard written before per-record stamping existed: the
+                # filename still carries the process identity
+                record["process_index"] = process_index
+            events.append(record)
     trace = load_trace(trace_path) if trace_path else None
     summary = summarize_events(events, source=path)
     if trace is not None:
@@ -154,7 +238,9 @@ def summarize_events(
     steps = [e for e in events if e.get("event") == "on_train_step"]
     epoch_ends = [e for e in events if e.get("event") == "on_epoch_end"]
     fit_ends = [e for e in events if e.get("event") == "on_fit_end"]
-    bench = [e for e in events if "metric" in e and "value" in e]
+    # bench sidecars are RAW records (log_record, no "event" key); the guard
+    # keeps on_slo_violation — whose payload also carries metric+value — out
+    bench = [e for e in events if "metric" in e and "value" in e and "event" not in e]
     bench_rows = [e for e in events if e.get("event") == "bench_row"]
     dryruns = [e for e in events if e.get("event") == "dryrun_multichip"]
     serve_ends = [e for e in events if e.get("event") == "on_serve_end"]
@@ -184,7 +270,23 @@ def summarize_events(
         "health_warnings": sum(
             1 for e in events if e.get("event") == "on_health_warning"
         ),
+        # the SLO watchdog's transition events (obs.slo): violations are the
+        # lower-better --compare gate; recoveries separate transient spikes
+        # from breaches that were still open when the run ended
+        "slo_violations": sum(
+            1 for e in events if e.get("event") == "on_slo_violation"
+        ),
+        "slo_recoveries": sum(
+            1 for e in events if e.get("event") == "on_slo_recovery"
+        ),
     }
+    summary["slo_rules_fired"] = sorted(
+        {
+            str(e.get("rule"))
+            for e in events
+            if e.get("event") == "on_slo_violation" and e.get("rule") is not None
+        }
+    )
     summary["backend"] = next(
         (e["backend"] for e in events if isinstance(e.get("backend"), str)), None
     )
@@ -245,6 +347,34 @@ def summarize_events(
     summary["samples_per_sec"] = throughput
     summary["steps_per_sec"] = steps_per_sec
     summary["throughput_source"] = throughput_source
+
+    # multi-host view: per-process mean step time from the merged shards'
+    # stamped step events, folded into the skew/straggler record. Only
+    # rendered when any step event carries a process stamp — single-process
+    # runs stay byte-identical.
+    by_process: Dict[int, List[float]] = {}
+    stamped = False
+    for e in steps:
+        if "process_index" in e:
+            stamped = True
+        step_seconds = _finite(e.get("step_seconds"))
+        if step_seconds is not None:
+            by_process.setdefault(int(e.get("process_index") or 0), []).append(
+                step_seconds
+            )
+    if stamped and by_process:
+        per_process = {
+            pid: sum(values) / len(values) for pid, values in by_process.items()
+        }
+        summary["processes"] = {
+            "count": len(per_process),
+            "step_seconds": {
+                str(pid): value for pid, value in sorted(per_process.items())
+            },
+            **straggler_summary(per_process),
+        }
+    else:
+        summary["processes"] = None
 
     losses = [
         value
@@ -353,10 +483,17 @@ def summarize_events(
             key: record.get(key)
             for key in (
                 "mesh", "losses", "psum", "sp_ring_err", "spans", "backend",
-                "collectives", "sharding",
+                "collectives", "sharding", "processes",
             )
             if key in record
         }
+        if summary["processes"] is None and isinstance(
+            record.get("processes"), Mapping
+        ):
+            # the dry run measures its per-process timing directly (it emits
+            # no per-step events): surface its skew record at the top level
+            # so the straggler gate reads dry runs and real fits identically
+            summary["processes"] = dict(record["processes"])
 
     # the serving summary (replay_tpu.serve): service-side totals from the
     # on_serve_end event, load-side qps/latency percentiles from the
@@ -493,6 +630,27 @@ def render(summary: Mapping[str, Any]) -> str:
         ),
     ]
     lines.append("  reliability: " + " ".join(part for part in reliability if part))
+    if summary.get("slo_violations") or summary.get("slo_recoveries"):
+        fired = summary.get("slo_rules_fired") or []
+        lines.append(
+            f"  SLO: {summary.get('slo_violations', 0)} violation(s), "
+            f"{summary.get('slo_recoveries', 0)} recovered"
+            + (f" — rules: {', '.join(fired)}" if fired else "")
+        )
+    processes = summary.get("processes")
+    if processes:
+        per_host = processes.get("step_seconds") or {}
+        shown = " · ".join(
+            f"p{pid} {1000.0 * float(value):.2f}ms" for pid, value in per_host.items()
+        )
+        index = _finite(processes.get("straggler_index"))
+        skew = _finite(processes.get("skew"))
+        lines.append(
+            f"  processes: {processes.get('count')} host(s)"
+            + (f" · straggler index {index:.3f} (p{processes.get('straggler')})" if index is not None else "")
+            + (f" · skew {skew:.3f}" if skew is not None else "")
+            + (f" · step time {shown}" if shown else "")
+        )
     health = summary.get("health")
     if health:
         parts = []
@@ -935,6 +1093,9 @@ def compare_runs(
         ("bad_steps", "bad_steps"),
         ("anomalies", "anomalies"),
         ("health_warnings", "health warnings"),
+        # lower-better with a zero baseline by design: a healthy run fires no
+        # SLO rules, so ANY candidate violation against a clean baseline gates
+        ("slo_violations", "SLO violations"),
     ):
         cand_count, base_count = candidate.get(name), baseline.get(name)
         if (
@@ -1034,6 +1195,26 @@ def compare_runs(
             cand_value, base_value = _finite(cand_serve.get(name)), _finite(base_serve.get(name))
             if cand_value is not None and base_value is not None:
                 lines.append(f"  serve_{name}: {cand_value:.3f} vs {base_value:.3f}")
+    # cross-host balance: the straggler index (max/median per-host step time)
+    # gates lower-better, but ONLY between two genuinely multi-process runs —
+    # a single-process run's index is 1.0 by construction and comparing it
+    # against a real fleet would read as a free pass (or a fake regression)
+    cand_procs = candidate.get("processes") or {}
+    base_procs = baseline.get("processes") or {}
+    cand_multi = (cand_procs.get("count") or 0) > 1
+    base_multi = (base_procs.get("count") or 0) > 1
+    cand_straggler = _finite(cand_procs.get("straggler_index"))
+    base_straggler = _finite(base_procs.get("straggler_index"))
+    if cand_multi and base_multi:
+        check_lower_better(
+            "straggler_index", cand_straggler, base_straggler, threshold
+        )
+    elif cand_straggler is not None or base_straggler is not None:
+        lines.append(
+            f"  straggler_index: candidate={_fmt(cand_straggler, '{:.3f}')} "
+            f"baseline={_fmt(base_straggler, '{:.3f}')} "
+            "(not gated: both runs must be multi-process)"
+        )
     cand_gp, base_gp = candidate.get("goodput"), baseline.get("goodput")
     if cand_gp and base_gp:
         for name in (

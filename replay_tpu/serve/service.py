@@ -90,6 +90,15 @@ class ScoringService:
         ladder's host-side floor. Without it, requests that can't be absorbed
         by cache-only scoring fail fast (:class:`CircuitOpen` under an open
         breaker, :class:`RequestShed` under overload).
+    :param metrics_port: serve a live Prometheus ``/metrics`` endpoint (+
+        ``/snapshot`` JSON) for the service's lifetime — qps, batch fill,
+        queue-wait histograms, shed/degrade/breaker counters bridged from the
+        serve event stream (docs/observability.md). ``0`` binds an ephemeral
+        port (read :attr:`metrics_exporter`); a busy port degrades to a
+        logged no-op.
+    :param slo_rules: :class:`~replay_tpu.obs.SLORule` set evaluated after
+        every dispatched batch; breaches emit ``on_slo_violation`` through
+        the attached ``logger`` and count in the registry.
     """
 
     def __init__(
@@ -111,6 +120,8 @@ class ScoringService:
         default_deadline_ms: Optional[float] = None,
         breaker: Optional[CircuitBreaker] = None,
         fallback: Optional[FallbackScorer] = None,
+        metrics_port: Optional[int] = None,
+        slo_rules: Optional[Sequence[Any]] = None,
     ) -> None:
         if retrieval is not None and candidates is not None:
             msg = "retrieval mode and a fixed candidate slate are mutually exclusive"
@@ -170,6 +181,27 @@ class ScoringService:
         self._goodput_t0: Dict[str, float] = {}
         self._wall_t0 = 0.0
         self._started = False
+        # live metrics plane (obs.metrics / obs.exporter / obs.slo): the
+        # service's own event stream bridged into a scrapeable registry —
+        # no new instrumentation hooks, the _emit fan-out IS the bridge
+        self.metrics_registry = None
+        self.metrics_exporter = None
+        self._metrics_logger = None
+        if metrics_port is not None or slo_rules:
+            from replay_tpu.obs.exporter import MetricsExporter
+            from replay_tpu.obs.metrics import MetricsLogger
+            from replay_tpu.obs.slo import SLOWatchdog
+
+            self._metrics_logger = MetricsLogger()
+            self.metrics_registry = self._metrics_logger.registry
+            if slo_rules:
+                self._metrics_logger.watchdog = SLOWatchdog(
+                    slo_rules, self.metrics_registry, emit=self._route_event
+                )
+            if metrics_port is not None:
+                self.metrics_exporter = MetricsExporter(
+                    self.metrics_registry, port=metrics_port
+                )
 
     # -- lifecycle ---------------------------------------------------------- #
     def start(self) -> "ScoringService":
@@ -178,6 +210,8 @@ class ScoringService:
         self._started = True
         self._goodput_t0 = self.tracer.snapshot()
         self._wall_t0 = self.tracer.wall_seconds()
+        if self.metrics_exporter is not None:
+            self.metrics_exporter.start()
         self.batcher.start()
         self._emit(
             "on_serve_start",
@@ -215,6 +249,11 @@ class ScoringService:
             spans=SERVE_GOODPUT_SPANS,
         )
         self._emit("on_serve_end", payload)
+        if self.metrics_exporter is not None:
+            # after the terminal event: the final gauges (hit rate, shed
+            # rate) land in the registry before the endpoint disappears, and
+            # registry/snapshot stay readable on metrics_registry afterwards
+            self.metrics_exporter.close()
         if self.trace_path and self.tracer.enabled:
             self.tracer.save(self.trace_path)
 
@@ -782,9 +821,18 @@ class ScoringService:
         return False
 
     # -- accounting --------------------------------------------------------- #
-    def _emit(self, event: str, payload: Dict[str, Any]) -> None:
+    def _route_event(self, event: TrainerEvent) -> None:
+        """Fan one event out to the metrics bridge and the user sink (the
+        SLO watchdog's emit target too, so violations land in both)."""
+        if self._metrics_logger is not None:
+            self._metrics_logger.log_event(event)
         if self.logger is not None:
-            self.logger.log_event(TrainerEvent(event=event, payload=payload))
+            self.logger.log_event(event)
+
+    def _emit(self, event: str, payload: Dict[str, Any]) -> None:
+        if self._metrics_logger is None and self.logger is None:
+            return
+        self._route_event(TrainerEvent(event=event, payload=payload))
 
     def _emit_throttled(
         self, key: str, event: str, payload: Dict[str, Any], min_interval: float = 0.5

@@ -1,0 +1,137 @@
+"""Metrics exporter (obs.exporter): scrape endpoints under concurrent load.
+
+Core tier, no jax: a stdlib HTTP server over a stdlib registry. The
+concurrency test is the satellite's contract — scrapes racing writers must
+never see a torn line, a non-monotone counter, or deadlock.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from replay_tpu.obs.exporter import MetricsExporter
+from replay_tpu.obs.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.core
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as response:
+        return response.status, response.read().decode()
+
+
+@pytest.fixture
+def served_registry():
+    registry = MetricsRegistry()
+    exporter = MetricsExporter(registry, port=0).start()
+    assert exporter.port is not None
+    yield registry, exporter
+    exporter.close()
+
+
+def test_metrics_and_snapshot_endpoints(served_registry):
+    registry, exporter = served_registry
+    registry.inc("requests_total", 3)
+    registry.set("loss", 0.5)
+    registry.observe("wait", 0.2, buckets=[0.1, 1.0])
+    status, text = _get(f"{exporter.url}/metrics")
+    assert status == 200
+    assert "# TYPE requests_total counter" in text
+    assert "requests_total 3" in text
+    assert "loss 0.5" in text
+    assert 'wait_bucket{le="+Inf"} 1' in text
+    status, body = _get(f"{exporter.url}/snapshot")
+    snapshot = json.loads(body)
+    assert snapshot["requests_total"]["value"] == 3
+    assert snapshot["wait"]["count"] == 1
+    status, body = _get(f"{exporter.url}/healthz")
+    assert status == 200 and body == "ok\n"
+
+
+def test_unknown_path_is_404(served_registry):
+    _, exporter = served_registry
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(f"{exporter.url}/nope")
+    assert err.value.code == 404
+
+
+def test_busy_port_degrades_to_noop(served_registry, caplog):
+    _, exporter = served_registry
+    second = MetricsExporter(MetricsRegistry(), port=exporter.port).start()
+    try:
+        assert second.port is None and second.url is None
+        second.close()  # safe on a never-bound exporter
+        # the original endpoint is untouched
+        status, _ = _get(f"{exporter.url}/healthz")
+        assert status == 200
+    finally:
+        second.close()
+
+
+def test_close_is_idempotent_and_releases_the_port():
+    registry = MetricsRegistry()
+    exporter = MetricsExporter(registry, port=0).start()
+    port = exporter.port
+    exporter.close()
+    exporter.close()
+    assert exporter.port is None
+    # the port is actually free again: a new exporter can take it
+    reuse = MetricsExporter(registry, port=port).start()
+    assert reuse.port == port
+    reuse.close()
+
+
+def test_concurrent_scrapes_against_writers(served_registry):
+    """The satellite's load test: writer threads hammer every metric type
+    while scraper threads pull /metrics and /snapshot. Every scrape must be a
+    complete, parseable exposition with monotone counters; nothing deadlocks."""
+    registry, exporter = served_registry
+    stop = threading.Event()
+    failures = []
+
+    def writer(i):
+        n = 0
+        while not stop.is_set():
+            n += 1
+            registry.inc("w_total")
+            registry.set("g", float(n), labels={"writer": str(i)})
+            registry.observe("h", (n % 100) / 100.0, buckets=[0.25, 0.5, 0.75, 1.0])
+
+    def scraper():
+        last_total = -1.0
+        try:
+            for _ in range(25):
+                _, text = _get(f"{exporter.url}/metrics")
+                assert text.endswith("\n"), "torn exposition"
+                totals = [
+                    float(line.rsplit(" ", 1)[1])
+                    for line in text.splitlines()
+                    if line.startswith("w_total ")
+                ]
+                assert len(totals) == 1, text.splitlines()[:5]
+                assert totals[0] >= last_total, "counter went backwards"
+                last_total = totals[0]
+                # every line is "name{labels} value" or a comment
+                for line in text.splitlines():
+                    assert line.startswith("#") or len(line.rsplit(" ", 1)) == 2
+                snapshot = json.loads(_get(f"{exporter.url}/snapshot")[1])
+                h = snapshot.get("h")
+                if h:
+                    assert sum(h["buckets"].values()) + h["overflow"] == h["count"]
+        except Exception as exc:  # noqa: BLE001 — surfaced to the main thread
+            failures.append(exc)
+
+    writers = [threading.Thread(target=writer, args=(i,), daemon=True) for i in range(3)]
+    scrapers = [threading.Thread(target=scraper, daemon=True) for _ in range(3)]
+    for t in writers + scrapers:
+        t.start()
+    for t in scrapers:
+        t.join(timeout=60)
+        assert not t.is_alive(), "scraper deadlocked"
+    stop.set()
+    for t in writers:
+        t.join(timeout=10)
+    assert not failures, failures[0]

@@ -1,0 +1,316 @@
+"""Live metrics registry (obs.metrics): types, quantiles, the event bridge.
+
+Core tier, no jax: the registry and the bridge are stdlib-only by contract
+(the exporter must be able to serve from any process, including the report
+CLI's import-light world).
+"""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from replay_tpu.obs.events import TrainerEvent
+from replay_tpu.obs.metrics import (
+    FILL_BUCKETS,
+    Histogram,
+    MetricsLogger,
+    MetricsRegistry,
+)
+
+pytestmark = pytest.mark.core
+
+
+# --------------------------------------------------------------------------- #
+# registry primitives
+# --------------------------------------------------------------------------- #
+def test_counter_monotone_and_gauge_last_write():
+    registry = MetricsRegistry()
+    registry.inc("c_total")
+    registry.inc("c_total", 2.5)
+    assert registry.value("c_total") == 3.5
+    with pytest.raises(ValueError, match="monotone"):
+        registry.inc("c_total", -1)
+    registry.set("g", 1.0)
+    registry.set("g", -7.25)
+    assert registry.value("g") == -7.25
+
+
+def test_type_collision_raises():
+    registry = MetricsRegistry()
+    registry.inc("m")
+    with pytest.raises(ValueError, match="counter"):
+        registry.set("m", 1.0)
+    with pytest.raises(ValueError, match="counter"):
+        registry.observe("m", 1.0)
+
+
+def test_labeled_series_are_independent():
+    registry = MetricsRegistry()
+    registry.inc("shed_total", 2, labels={"lane": "hit"})
+    registry.inc("shed_total", 3, labels={"lane": "encode:L=16"})
+    assert registry.value("shed_total", labels={"lane": "hit"}) == 2
+    assert registry.value("shed_total", labels={"lane": "encode:L=16"}) == 3
+    assert registry.value("shed_total") is None  # the unlabeled series is absent
+    text = registry.render_prometheus()
+    assert 'shed_total{lane="hit"} 2' in text
+    assert 'shed_total{lane="encode:L=16"} 3' in text
+
+
+def test_missing_metric_reads_none():
+    registry = MetricsRegistry()
+    assert registry.value("nope") is None
+    assert registry.value("nope:p99") is None
+
+
+def test_histogram_stat_refs_and_errors():
+    registry = MetricsRegistry()
+    for v in (1.0, 2.0, 3.0, 4.0):
+        registry.observe("h", v, buckets=[1, 2, 3, 4])
+    assert registry.value("h:count") == 4
+    assert registry.value("h:sum") == 10.0
+    assert registry.value("h:mean") == 2.5
+    assert registry.value("h:max") == 4.0
+    assert registry.value("h:min") == 1.0
+    with pytest.raises(ValueError, match="unknown histogram stat"):
+        registry.value("h:pXX")
+    registry.set("g", 1.0)
+    with pytest.raises(ValueError, match="suffix is for histograms"):
+        registry.value("g:p50")
+
+
+# --------------------------------------------------------------------------- #
+# histogram quantile accuracy against numpy (the satellite's contract)
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize(
+    "name,sampler",
+    [
+        ("uniform", lambda rng, n: rng.uniform(0.0, 10.0, n)),
+        ("normal", lambda rng, n: rng.normal(5.0, 1.5, n)),
+        ("exponential", lambda rng, n: rng.exponential(2.0, n)),
+    ],
+)
+def test_quantiles_track_numpy_on_known_distributions(name, sampler):
+    rng = np.random.default_rng(0)
+    data = sampler(rng, 20_000)
+    # fine uniform ladder over the support: the estimate's error is bounded
+    # by one bucket width, so the tolerance below is the ladder pitch
+    lo, hi = float(np.min(data)), float(np.max(data))
+    pitch = (hi - lo) / 200.0
+    histogram = Histogram(buckets=np.linspace(lo, hi, 201))
+    for value in data:
+        histogram.observe(float(value))
+    for q in (0.5, 0.9, 0.99):
+        estimate = histogram.quantile(q)
+        exact = float(np.quantile(data, q))
+        assert estimate == pytest.approx(exact, abs=2 * pitch), (name, q)
+    assert histogram.quantile(0.0) == pytest.approx(lo, abs=2 * pitch)
+    assert histogram.quantile(1.0) == pytest.approx(hi, abs=2 * pitch)
+    assert histogram.mean() == pytest.approx(float(np.mean(data)), rel=0.02)
+
+
+def test_quantile_clamps_to_observed_range_and_overflow():
+    histogram = Histogram(buckets=[1.0, 2.0])
+    for value in (0.5, 0.6, 5.0):  # 5.0 lands in the +Inf bucket
+        histogram.observe(value)
+    assert histogram.quantile(0.99) == 5.0  # the best finite tail statement
+    assert histogram.quantile(0.01) >= 0.5  # clamped to the observed min
+    assert histogram.counts[-1] == 1
+    histogram.observe(float("nan"))  # ignored, never poisons sum/count
+    assert histogram.total == 3 and math.isfinite(histogram.sum)
+
+
+def test_empty_histogram_quantile_is_none():
+    assert Histogram(buckets=[1.0]).quantile(0.5) is None
+
+
+# --------------------------------------------------------------------------- #
+# prometheus rendering
+# --------------------------------------------------------------------------- #
+def test_prometheus_text_shape():
+    registry = MetricsRegistry()
+    registry.inc("req_total", 7)
+    registry.set("loss", 0.25)
+    registry.observe("lat", 0.3, buckets=[0.1, 0.5, 1.0])
+    registry.observe("lat", 0.7, buckets=[0.1, 0.5, 1.0])
+    text = registry.render_prometheus()
+    assert "# TYPE req_total counter" in text
+    assert "# TYPE loss gauge" in text
+    assert "# TYPE lat histogram" in text
+    assert 'lat_bucket{le="0.5"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 2' in text
+    assert "lat_count 2" in text and "lat_sum 1" in text
+    assert text.endswith("\n")
+
+
+def test_concurrent_writers_never_tear_a_render():
+    registry = MetricsRegistry()
+    stop = threading.Event()
+
+    def hammer(i):
+        while not stop.is_set():
+            registry.inc("w_total")
+            registry.observe("h", 0.1 * i, buckets=[0.1, 0.5, 1.0])
+            registry.set("g", float(i))
+
+    workers = [threading.Thread(target=hammer, args=(i,), daemon=True) for i in range(4)]
+    for w in workers:
+        w.start()
+    last_total = -1.0
+    try:
+        for _ in range(50):
+            text = registry.render_prometheus()
+            # every render parses and counters are monotone across renders
+            totals = [
+                float(line.split()[-1])
+                for line in text.splitlines()
+                if line.startswith("w_total ")
+            ]
+            assert len(totals) == 1
+            assert totals[0] >= last_total
+            last_total = totals[0]
+            snap = registry.snapshot()
+            h = snap.get("h")
+            if h:
+                # the snapshot is internally consistent: buckets + overflow
+                # account for every observation
+                assert sum(h["buckets"].values()) + h["overflow"] == h["count"]
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=5)
+
+
+# --------------------------------------------------------------------------- #
+# the event bridge
+# --------------------------------------------------------------------------- #
+def _step_event(step, loss=0.5, step_seconds=0.01):
+    return TrainerEvent(
+        "on_train_step",
+        step=step,
+        payload={
+            "loss": loss,
+            "lr": 1e-3,
+            "samples_per_sec": 800.0,
+            "steps_per_sec": 100.0,
+            "step_seconds": step_seconds,
+        },
+    )
+
+
+def test_bridge_train_events():
+    bridge = MetricsLogger()
+    for i in range(1, 4):
+        bridge.log_event(_step_event(i))
+    bridge.log_event(_step_event(4, loss=float("nan")))  # sentinel-skipped step
+    registry = bridge.registry
+    assert registry.value("replay_train_steps_total") == 4
+    assert registry.value("replay_train_loss") == 0.5  # NaN never overwrites
+    assert registry.value("replay_train_step_seconds:count") == 4
+    bridge.log_event(
+        TrainerEvent("on_anomaly", step=4, payload={"bad_steps_total": 1})
+    )
+    assert registry.value("replay_train_anomalies_total") == 1
+    assert registry.value("replay_train_bad_steps") == 1
+    bridge.log_event(
+        TrainerEvent(
+            "on_epoch_end",
+            epoch=0,
+            payload={
+                "record": {"train_loss": 0.4},
+                "bad_steps": 1,
+                "goodput": {
+                    "fractions": {"train_step": 0.9, "data_wait": 0.1},
+                    "input_starvation": 0.1,
+                },
+            },
+        )
+    )
+    assert registry.value("replay_train_loss_epoch") == 0.4
+    assert registry.value(
+        "replay_goodput_fraction", labels={"phase": "train_step"}
+    ) == 0.9
+    assert registry.value("replay_input_starvation") == 0.1
+
+
+def test_bridge_serve_events_and_qps_window():
+    clock = [0.0]
+    bridge = MetricsLogger(qps_window_seconds=10.0, clock=lambda: clock[0])
+    registry = bridge.registry
+    bridge.log_event(TrainerEvent("on_serve_start", payload={}))
+    assert registry.value("replay_serve_up") == 1.0
+    for i in range(5):
+        clock[0] = float(i)
+        bridge.log_event(
+            TrainerEvent(
+                "on_serve_batch",
+                payload={
+                    "lane": "hit",
+                    "rows": 8,
+                    "bucket": 8,
+                    "fill": 1.0,
+                    "queue_wait_ms_max": 2.0,
+                    "dropped_expired": 0,
+                    "dropped_cancelled": 0,
+                },
+            )
+        )
+    assert registry.value("replay_serve_rows_total") == 40
+    assert registry.value("replay_serve_batches_total") == 5
+    # 40 rows over the 4-second window span
+    assert registry.value("replay_serve_qps") == pytest.approx(10.0)
+    assert registry.value("replay_serve_batch_fill:count") == 5
+    bridge.log_event(
+        TrainerEvent(
+            "on_shed",
+            payload={"lane": "hit", "depth": 9, "max_depth": 8, "count": 4},
+        )
+    )
+    assert registry.value("replay_serve_shed_total") == 4
+    assert registry.value("replay_serve_lane_depth", labels={"lane": "hit"}) == 9
+    bridge.log_event(
+        TrainerEvent("on_breaker", payload={"from": "closed", "to": "open"})
+    )
+    assert registry.value("replay_serve_breaker_state") == 2.0
+    bridge.log_event(
+        TrainerEvent("on_degrade", payload={"to": "fallback", "reason": "overload"})
+    )
+    assert (
+        registry.value("replay_serve_degraded_total", labels={"to": "fallback"}) == 1
+    )
+    bridge.log_event(
+        TrainerEvent(
+            "on_serve_end",
+            payload={"cache_hit_rate": 0.9, "shed_rate": 0.1, "requests": 50},
+        )
+    )
+    assert registry.value("replay_serve_cache_hit_rate") == 0.9
+    assert registry.value("replay_serve_shed_rate") == pytest.approx(0.1)
+    assert registry.value("replay_serve_up") == 0.0
+
+
+def test_bridge_empty_batch_skips_fill_and_wait():
+    """A fully-dropped batch (rows=0) must not pollute the fill/wait
+    histograms with zeros — only the drop counters move."""
+    bridge = MetricsLogger()
+    bridge.log_event(
+        TrainerEvent(
+            "on_serve_batch",
+            payload={
+                "lane": "hit", "rows": 0, "bucket": 0, "fill": 0.0,
+                "queue_wait_ms_max": 0.0, "dropped_expired": 3,
+                "dropped_cancelled": 1,
+            },
+        )
+    )
+    registry = bridge.registry
+    assert registry.value("replay_serve_expired_total") == 3
+    assert registry.value("replay_serve_cancelled_total") == 1
+    assert registry.value("replay_serve_batch_fill:count") is None
+    assert registry.value("replay_serve_queue_wait_ms:count") is None
+
+
+def test_fill_buckets_cover_the_unit_interval():
+    assert FILL_BUCKETS[-1] == 1.0

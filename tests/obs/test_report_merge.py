@@ -1,0 +1,277 @@
+"""Multi-process report merge, shard rotation, and the new --compare gates.
+
+Core tier, no jax: synthetic per-process shards with engineered skew drive
+the straggler math; JsonlLogger's size rotation feeds the shard reader.
+"""
+
+import json
+import logging
+import os
+
+import pytest
+
+from replay_tpu.obs.events import ConsoleLogger, JsonlLogger, TrainerEvent
+from replay_tpu.obs.report import (
+    compare_runs,
+    render,
+    straggler_summary,
+    summarize_run,
+)
+
+pytestmark = pytest.mark.core
+
+
+def _step_record(step, process_index, step_seconds, loss=0.5):
+    return {
+        "event": "on_train_step",
+        "time": 1000.0 + step,
+        "step": step,
+        "loss": loss,
+        "samples_per_sec": 100.0,
+        "step_seconds": step_seconds,
+        "process_index": process_index,
+    }
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as fh:
+        for record in records:
+            fh.write(json.dumps(record) + "\n")
+
+
+def _write_multiprocess_run(run_dir, step_times):
+    """One shard per process (process 0 owns events.jsonl), each stamped with
+    its own synthetic per-step time."""
+    os.makedirs(run_dir, exist_ok=True)
+    for pid, step_seconds in enumerate(step_times):
+        records = [_step_record(s, pid, step_seconds) for s in range(1, 5)]
+        if pid == 0:
+            records.append({"event": "on_fit_end", "time": 2000.0, "bad_steps": 0})
+        name = "events.jsonl" if pid == 0 else f"events.p{pid}.jsonl"
+        _write_jsonl(os.path.join(run_dir, name), records)
+
+
+# --------------------------------------------------------------------------- #
+# straggler math
+# --------------------------------------------------------------------------- #
+def test_straggler_summary_math():
+    summary = straggler_summary({0: 0.10, 1: 0.10, 2: 0.10, 3: 0.25})
+    assert summary["max_step_seconds"] == 0.25
+    assert summary["median_step_seconds"] == pytest.approx(0.10)
+    assert summary["straggler"] == "3"
+    assert summary["straggler_index"] == pytest.approx(2.5)
+    assert summary["skew"] == pytest.approx(1.5)
+    balanced = straggler_summary({0: 0.1})
+    assert balanced["straggler_index"] == 1.0 and balanced["skew"] == 0.0
+    with pytest.raises(ValueError):
+        straggler_summary({})
+
+
+# --------------------------------------------------------------------------- #
+# shard merging
+# --------------------------------------------------------------------------- #
+def test_merges_per_process_shards_and_computes_skew(tmp_path):
+    run_dir = str(tmp_path / "run")
+    _write_multiprocess_run(run_dir, step_times=[0.10, 0.11, 0.40, 0.10])
+    summary = summarize_run(run_dir)
+    assert summary["train_steps"] == 16  # 4 steps x 4 processes, one stream
+    processes = summary["processes"]
+    assert processes["count"] == 4
+    assert processes["straggler"] == "2"
+    assert processes["straggler_index"] == pytest.approx(0.40 / 0.105, rel=1e-6)
+    assert processes["step_seconds"]["2"] == pytest.approx(0.40)
+    text = render(summary)
+    assert "processes: 4 host(s)" in text and "straggler index" in text
+
+
+def test_unstamped_shard_inherits_its_filename_index(tmp_path):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    _write_jsonl(
+        os.path.join(run_dir, "events.jsonl"),
+        [_step_record(s, 0, 0.1) for s in range(1, 4)],
+    )
+    records = [_step_record(s, 0, 0.3) for s in range(1, 4)]
+    for record in records:
+        del record["process_index"]
+    _write_jsonl(os.path.join(run_dir, "events.p1.jsonl"), records)
+    processes = summarize_run(run_dir)["processes"]
+    assert processes["count"] == 2
+    assert processes["step_seconds"]["1"] == pytest.approx(0.3)
+
+
+def test_single_process_run_has_no_processes_section(tmp_path):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    records = [_step_record(s, 0, 0.1) for s in range(1, 4)]
+    for record in records:
+        del record["process_index"]
+    _write_jsonl(os.path.join(run_dir, "events.jsonl"), records)
+    summary = summarize_run(run_dir)
+    assert summary["processes"] is None
+    assert "processes:" not in render(summary)
+
+
+# --------------------------------------------------------------------------- #
+# size rotation (JsonlLogger satellite) read back in order
+# --------------------------------------------------------------------------- #
+def test_jsonl_rotation_and_ordered_readback(tmp_path):
+    run_dir = str(tmp_path / "run")
+    sink = JsonlLogger(run_dir, max_bytes=600, rotate=5)
+    for step in range(1, 25):
+        sink.log_event(
+            TrainerEvent(
+                "on_train_step",
+                step=step,
+                payload={"loss": 0.5, "step_seconds": 0.1, "samples_per_sec": 10.0},
+            )
+        )
+    sink.close()
+    shards = sorted(os.listdir(run_dir))
+    assert "events.jsonl" in shards
+    assert any(name.startswith("events.jsonl.") for name in shards)
+    # every shard stays under the bound (one record never splits)
+    for name in shards:
+        assert os.path.getsize(os.path.join(run_dir, name)) <= 600
+    summary = summarize_run(run_dir)
+    assert summary["train_steps"] == 24  # nothing evicted at rotate=5
+    # the merged stream is in write order: steps ascend across shards
+    from replay_tpu.obs.report import _collect_event_files, load_events
+
+    steps = [
+        record["step"]
+        for path, _ in _collect_event_files(run_dir)
+        for record in load_events(path)
+    ]
+    assert steps == sorted(steps)
+
+
+def test_jsonl_rotation_drops_oldest_beyond_rotate(tmp_path):
+    run_dir = str(tmp_path / "run")
+    sink = JsonlLogger(run_dir, max_bytes=200, rotate=2)
+    for step in range(60):
+        sink.log_record({"event": "e", "step": step, "pad": "x" * 40})
+    sink.close()
+    names = sorted(os.listdir(run_dir))
+    assert names == ["events.jsonl", "events.jsonl.1", "events.jsonl.2"]
+
+
+def test_jsonl_process_index_filename(tmp_path):
+    sink = JsonlLogger(str(tmp_path), process_index=2)
+    sink.log_record({"event": "e"})
+    sink.close()
+    assert os.path.exists(tmp_path / "events.p2.jsonl")
+    zero = JsonlLogger(str(tmp_path), process_index=0)
+    zero.log_record({"event": "e"})
+    zero.close()
+    assert os.path.exists(tmp_path / "events.jsonl")
+
+
+# --------------------------------------------------------------------------- #
+# --compare gates: slo_violations and the straggler index
+# --------------------------------------------------------------------------- #
+def _write_slo_run(run_dir, violations):
+    os.makedirs(run_dir, exist_ok=True)
+    records = [_step_record(s, 0, 0.1) for s in range(1, 4)]
+    for record in records:
+        del record["process_index"]
+    for i in range(violations):
+        records.append(
+            {
+                "event": "on_slo_violation",
+                "time": 1500.0 + i,
+                "rule": "bad_steps",
+                "metric": "replay_train_bad_steps",
+                "value": 1.0,
+                "threshold": 0.0,
+            }
+        )
+    _write_jsonl(os.path.join(run_dir, "events.jsonl"), records)
+
+
+def test_slo_violations_gate_zero_baseline_fires_on_any(tmp_path):
+    clean = str(tmp_path / "clean")
+    dirty = str(tmp_path / "dirty")
+    _write_slo_run(clean, violations=0)
+    _write_slo_run(dirty, violations=2)
+    assert summarize_run(dirty)["slo_violations"] == 2
+    assert "SLO: 2 violation(s)" in render(summarize_run(dirty))
+    _, regressions = compare_runs(summarize_run(dirty), summarize_run(clean))
+    assert any("SLO violations increased 0 -> 2" in r for r in regressions)
+    # and the clean candidate passes against the dirty baseline
+    _, regressions = compare_runs(summarize_run(clean), summarize_run(dirty))
+    assert not any("SLO" in r for r in regressions)
+
+
+def test_straggler_gate_only_between_multiprocess_runs(tmp_path):
+    balanced = str(tmp_path / "balanced")
+    skewed = str(tmp_path / "skewed")
+    single = str(tmp_path / "single")
+    _write_multiprocess_run(balanced, step_times=[0.10, 0.10, 0.11, 0.10])
+    _write_multiprocess_run(skewed, step_times=[0.10, 0.10, 0.40, 0.10])
+    _write_multiprocess_run(single, step_times=[0.10])
+    lines, regressions = compare_runs(
+        summarize_run(skewed), summarize_run(balanced), threshold=0.1
+    )
+    assert any("straggler_index regressed" in r for r in regressions)
+    # balanced vs skewed baseline: an improvement, no regression
+    _, regressions = compare_runs(summarize_run(balanced), summarize_run(skewed))
+    assert not any("straggler" in r for r in regressions)
+    # one side single-process: surfaced, never gated
+    lines, regressions = compare_runs(summarize_run(skewed), summarize_run(single))
+    assert not any("straggler" in r for r in regressions)
+    assert any("not gated: both runs must be multi-process" in line for line in lines)
+
+
+# --------------------------------------------------------------------------- #
+# ConsoleLogger: warning-class events get a visible single-line render
+# --------------------------------------------------------------------------- #
+def test_console_renders_warning_class_events(caplog):
+    console = ConsoleLogger(every=1000)  # step cadence irrelevant here
+    with caplog.at_level(logging.INFO, logger="replay_tpu"):
+        console.log_event(
+            TrainerEvent(
+                "on_slo_violation",
+                step=7,
+                payload={
+                    "rule": "bad_steps", "metric": "replay_train_bad_steps",
+                    "op": ">", "threshold": 0.0, "value": 1.0, "consecutive": 1,
+                },
+            )
+        )
+        console.log_event(
+            TrainerEvent(
+                "on_slo_recovery",
+                step=9,
+                payload={
+                    "rule": "bad_steps", "metric": "replay_train_bad_steps",
+                    "value": 0.0, "breach_seconds": 2.0,
+                    "breached_evaluations": 2,
+                },
+            )
+        )
+        console.log_event(
+            TrainerEvent(
+                "on_shed",
+                payload={"lane": "hit", "depth": 9, "max_depth": 8, "count": 3},
+            )
+        )
+        console.log_event(
+            TrainerEvent(
+                "on_breaker",
+                payload={"from": "closed", "to": "open", "consecutive_failures": 5},
+            )
+        )
+        console.log_event(
+            TrainerEvent(
+                "on_degrade", payload={"to": "fallback", "reason": "overload"}
+            )
+        )
+    text = caplog.text
+    assert "SLO violation [bad_steps]" in text and "step 7" in text
+    assert "SLO recovered [bad_steps]" in text
+    assert "3 request(s) shed on lane hit" in text
+    assert "circuit breaker closed -> open" in text
+    assert "rerouted to fallback" in text
+    warning_count = sum(1 for r in caplog.records if r.levelno == logging.WARNING)
+    assert warning_count == 4  # recovery is INFO, the rest WARN

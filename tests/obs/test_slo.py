@@ -1,0 +1,163 @@
+"""SLO watchdog (obs.slo): the breach→recovery state machine.
+
+Core tier, no jax — rules evaluate pure registry reads with an injectable
+clock, so every transition is deterministic.
+"""
+
+import pytest
+
+from replay_tpu.obs.metrics import MetricsRegistry
+from replay_tpu.obs.slo import SLORule, SLOWatchdog
+
+pytestmark = pytest.mark.core
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+@pytest.fixture
+def harness():
+    registry = MetricsRegistry()
+    clock = FakeClock()
+    events = []
+
+    def build(*rules):
+        return SLOWatchdog(rules, registry, emit=events.append, clock=clock)
+
+    return registry, clock, events, build
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError, match="unknown op"):
+        SLORule("m", "~", 1.0)
+    with pytest.raises(ValueError, match="for_steps"):
+        SLORule("m", ">", 1.0, for_steps=0)
+    assert SLORule("m", ">", 0.5).label == "m>0.5"
+    assert SLORule("m", ">", 0.5, name="latency budget").label == "latency budget"
+
+
+def test_duplicate_rule_labels_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOWatchdog([SLORule("m", ">", 1.0), SLORule("m", ">", 1.0)], MetricsRegistry())
+
+
+def test_fires_once_then_recovers_with_duration(harness):
+    registry, clock, events, build = harness
+    watchdog = build(SLORule("g", ">", 5.0))
+    registry.set("g", 10.0)
+    clock.now = 1.0
+    watchdog.evaluate(step=1)
+    assert [e.event for e in events] == ["on_slo_violation"]
+    assert events[0].payload["value"] == 10.0 and events[0].step == 1
+    # still breaching: no re-fire, but the active set reflects it
+    clock.now = 2.0
+    watchdog.evaluate(step=2)
+    assert len(events) == 1
+    assert watchdog.active == ["g>5"]
+    assert registry.value("replay_slo_breached", labels={"rule": "g>5"}) == 1.0
+    # recovery carries the breach duration and the eval count
+    clock.now = 7.5
+    registry.set("g", 1.0)
+    watchdog.evaluate(step=3)
+    assert [e.event for e in events] == ["on_slo_violation", "on_slo_recovery"]
+    recovery = events[1].payload
+    assert recovery["breach_seconds"] == pytest.approx(6.5)
+    assert recovery["breached_evaluations"] == 2
+    assert watchdog.active == []
+    assert registry.value("replay_slo_breached", labels={"rule": "g>5"}) == 0.0
+    # a fresh breach fires again (a NEW incident, not a re-fire)
+    registry.set("g", 6.0)
+    watchdog.evaluate(step=4)
+    assert [e.event for e in events][-1] == "on_slo_violation"
+    assert watchdog.stats()["g>5"]["fired"] == 2
+
+
+def test_for_steps_debounces_transient_spikes(harness):
+    registry, clock, events, build = harness
+    watchdog = build(SLORule("g", ">", 5.0, for_steps=3, name="sustained"))
+    # a 2-evaluation spike never fires (the transient case)
+    registry.set("g", 9.0)
+    watchdog.evaluate()
+    watchdog.evaluate()
+    registry.set("g", 1.0)
+    watchdog.evaluate()
+    assert events == []
+    assert watchdog.stats()["sustained"]["consecutive"] == 0
+    # a sustained breach fires on exactly the third consecutive evaluation
+    registry.set("g", 9.0)
+    watchdog.evaluate()
+    watchdog.evaluate()
+    assert events == []
+    watchdog.evaluate()
+    assert [e.event for e in events] == ["on_slo_violation"]
+    assert events[0].payload["consecutive"] == 3
+
+
+def test_missing_metric_is_no_data_not_a_transition(harness):
+    registry, clock, events, build = harness
+    watchdog = build(SLORule("absent", ">", 0.0))
+    watchdog.evaluate()
+    assert events == [] and watchdog.active == []
+    # a rule mid-breach must not "recover" just because the metric vanished
+    # (registry metrics never vanish, but a histogram stat can read None when
+    # empty — same code path)
+    registry.set("absent", 1.0)
+    watchdog.evaluate()
+    assert [e.event for e in events] == ["on_slo_violation"]
+
+
+def test_histogram_stat_rules(harness):
+    registry, clock, events, build = harness
+    watchdog = build(SLORule("wait:p99", ">", 0.5, name="p99 budget"))
+    for value in (0.1, 0.2, 0.1):
+        registry.observe("wait", value, buckets=[0.25, 0.5, 1.0])
+    watchdog.evaluate()
+    assert events == []
+    for _ in range(50):
+        registry.observe("wait", 0.9, buckets=[0.25, 0.5, 1.0])
+    watchdog.evaluate()
+    assert [e.event for e in events] == ["on_slo_violation"]
+    assert events[0].payload["metric"] == "wait:p99"
+
+
+def test_bad_steps_rule_fires_exactly_once_per_incident(harness):
+    """The CI acceptance shape: ONE injected NaN step → the bad_steps gauge
+    jumps to 1 and stays — the rule must fire exactly once over the run."""
+    registry, clock, events, build = harness
+    watchdog = build(SLORule("replay_train_bad_steps", ">", 0, name="bad_steps"))
+    registry.set("replay_train_bad_steps", 0.0)
+    for _ in range(5):
+        watchdog.evaluate()
+    assert events == []
+    registry.set("replay_train_bad_steps", 1.0)
+    for _ in range(20):
+        watchdog.evaluate()
+    violations = [e for e in events if e.event == "on_slo_violation"]
+    assert len(violations) == 1
+    assert violations[0].payload["rule"] == "bad_steps"
+
+
+def test_labeled_metric_rules_select_one_series(harness):
+    """A metric that only exists labeled (degraded_total{to=...}) is readable
+    by a rule carrying the label set; the unlabeled read stays no-data."""
+    registry, clock, events, build = harness
+    labeled = SLORule(
+        "replay_serve_degraded_total", ">", 0, labels={"to": "fallback"}
+    )
+    assert labeled.label == "replay_serve_degraded_total{to=fallback}>0"
+    blind = SLORule("replay_serve_degraded_total", ">", 0, name="blind")
+    watchdog = build(labeled, blind)
+
+    registry.inc("replay_serve_degraded_total", labels={"to": "cache_only"})
+    assert watchdog.evaluate() == []  # wrong series: still no data for either
+
+    registry.inc("replay_serve_degraded_total", labels={"to": "fallback"})
+    emitted = watchdog.evaluate()
+    assert [e.payload["rule"] for e in emitted] == [labeled.label]
+    # the label-less rule never saw data — dead rules must not fake health
+    assert watchdog.stats()["blind"]["consecutive"] == 0
