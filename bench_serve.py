@@ -28,6 +28,12 @@ construction, so the timed phases never trace):
   resilience layer keeps p99 bounded (queues cannot grow without bound) with
   explicit shed/deadline-miss accounting. The fallback floor is disabled for
   this phase so admission control itself is what gets measured;
+* **quant A/B** (retrieval mode) — the precision ladder's serving rung: the
+  same catalog + encoder query states through a f32 and an int8-quantized
+  ``CandidatePipeline`` (``replay_tpu.serve.quant``; exact f32 rescore of the
+  retrieved candidates). The ``quant`` block records recall@C of the int8
+  sweep, end-to-end top-k agreement, per-batch rank latency and the 4× table-
+  bytes ratio; ``obs.report --compare`` gates recall/topk-match higher-better;
 * **chaos** (``--chaos`` / ``REPLAY_TPU_SERVE_CHAOS=1``) — deterministic
   fault injection via ``replay_tpu.utils.faults``: consecutive engine errors
   trip the circuit breaker (degraded traffic rides the cache_only/fallback
@@ -269,6 +275,91 @@ def _run_overload(service, one_request, rate: float):
     }
 
 
+def _run_quant_phase(model, params, item_weights, reranker_weights, rng):
+    """int8-vs-f32 retrieval A/B (the serving rung of the precision ladder,
+    docs/performance.md "The precision ladder"): the SAME catalog and query
+    states through a f32 and an int8-quantized ``CandidatePipeline``.
+
+    Measures (a) recall@C of the quantized candidate sweep vs the f32 sweep,
+    (b) the end-to-end top-k agreement AFTER the int8 pipeline's exact f32
+    rescore stage, (c) per-batch ``rank()`` latency for both, and (d) the
+    table payload bytes (the 4× claim). ``obs.report`` renders the record and
+    ``--compare`` gates recall/topk-match as higher-better.
+    """
+    from replay_tpu.models import MIPSIndex
+    from replay_tpu.serve import CandidatePipeline
+    from replay_tpu.nn.sequential.sasrec import SasRec
+
+    candidates = min(CANDIDATES, NUM_ITEMS)
+    top_k = min(TOPK, candidates)
+    query_rows = min(64, USERS)
+    ids = rng.integers(0, NUM_ITEMS, size=(query_rows, SEQ_LEN)).astype(np.int32)
+    mask = np.ones((query_rows, SEQ_LEN), bool)
+    queries = np.asarray(
+        model.apply(
+            {"params": params}, {"item_id": ids}, mask,
+            method=SasRec.get_query_embeddings,
+        )
+    )
+
+    f32_index = MIPSIndex(item_weights)
+    int8_index = MIPSIndex(item_weights, precision="int8")
+    pipelines = {
+        "f32": CandidatePipeline(
+            f32_index, num_candidates=candidates, top_k=top_k,
+            reranker_weights=reranker_weights,
+        ),
+        "int8": CandidatePipeline(
+            int8_index, num_candidates=candidates, top_k=top_k,
+            reranker_weights=reranker_weights,
+        ),
+    }
+
+    _, f32_ids = f32_index.search(queries, candidates)
+    _, int8_ids = int8_index.search(queries, candidates)
+    recall = float(
+        np.mean(
+            [
+                len(set(a.tolist()) & set(b.tolist())) / candidates
+                for a, b in zip(f32_ids, int8_ids)
+            ]
+        )
+    )
+
+    latency_ms = {}
+    topk = {}
+    for name, pipeline in pipelines.items():
+        pipeline.rank(queries)  # compile + warm
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            scores, items = pipeline.rank(queries)  # np outputs: self-fencing
+        latency_ms[name] = round((time.perf_counter() - t0) / reps * 1000.0, 3)
+        topk[name] = items
+    topk_match = float(
+        np.mean(
+            [
+                len(set(a.tolist()) & set(b.tolist())) / top_k
+                for a, b in zip(topk["f32"], topk["int8"])
+            ]
+        )
+    )
+
+    bytes_record = int8_index.table_bytes()
+    return {
+        "candidates": candidates,
+        "top_k": top_k,
+        "query_rows": query_rows,
+        "recall_at_candidates": round(recall, 4),
+        "topk_match_rate": round(topk_match, 4),
+        "f32_rank_ms": latency_ms["f32"],
+        "int8_rank_ms": latency_ms["int8"],
+        "int8_table_bytes": bytes_record["payload_bytes"],
+        "f32_table_bytes": bytes_record["f32_bytes"],
+        "bytes_ratio": round(bytes_record["bytes_ratio"], 4),
+    }
+
+
 def _run_chaos(service, histories, rng):
     """Deterministic serve-side fault injection (see utils/faults.py):
     engine errors trip the breaker open, degraded traffic rides the ladder,
@@ -461,6 +552,7 @@ def main() -> None:
     )["params"]
 
     retrieval = None
+    quant = None
     mode = "full"
     if CANDIDATES > 0:
         # the fused candidate->rank path: MIPS over the tying head's item
@@ -480,6 +572,12 @@ def main() -> None:
             reranker_weights=reranker.serving_weights,
         )
         mode = "retrieval"
+        # int8-vs-f32 retrieval A/B (the ladder's serving rung): same catalog,
+        # same query states, recall/topk-match/latency/bytes — runs before the
+        # service phases so its compile time never pollutes their latencies
+        quant = _run_quant_phase(
+            model, params, item_weights, reranker.serving_weights, rng
+        )
 
     histories = {
         u: rng.integers(0, NUM_ITEMS, size=int(rng.integers(1, 2 * SEQ_LEN))).tolist()
@@ -683,6 +781,8 @@ def main() -> None:
     }
     if metrics_record is not None:
         record["metrics"] = metrics_record
+    if quant is not None:
+        record["quant"] = quant
     if overload is not None:
         record["overload"] = overload
     if chaos is not None:

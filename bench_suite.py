@@ -25,6 +25,13 @@ produces the full measurement batch the round-4 verdict asked for:
   attention at L=4096, fwd+bwd: the single-chip long-context A/B.
 - ``sasrec_l1024`` / ``sasrec_l1024_tiled`` — the full MODEL at L=1024
   (fused-CE head): default attention vs use_flash='tiled' end-to-end.
+- ``prec_{f32,bf16}_{ce,fused,tp}`` — the precision-ladder family
+  (docs/performance.md "The precision ladder"): the SAME 27k-catalog shape per
+  head, f32 vs the sanctioned ``Trainer(precision="bf16")`` policy (bf16
+  compute, f32 master params/optimizer/loss accumulation). The claim each
+  pair must support: strictly lower ``hbm_peak_bytes`` and a moved roofline
+  (``of_roofline_ceiling``), not just step_ms — ``obs.report --compare``
+  gates the ``prec_*`` rows' ``hbm_peak_bytes`` lower-better.
 - ``scale_{27k,100k,1m}_{ce,fused,tp,sce,gbce}`` — the catalog-scaling family
   (docs/performance.md "Breaking the memory wall"): step time vs catalog size
   at 27,278 / 100,000 / 1,000,000 items for plain CE (the memory wall — the
@@ -235,7 +242,7 @@ def _sasrec_loss(loss_kind, num_items, quick):
 
 
 def run_sasrec(num_items, dim, batch, seq_len, blocks, heads, loss_kind, label, dtype,
-               quick=False):
+               quick=False, precision=None):
     from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
     from replay_tpu.nn.sequential.sasrec import SasRec
     from replay_tpu.obs.mfu import fused_ce_flops
@@ -250,6 +257,10 @@ def run_sasrec(num_items, dim, batch, seq_len, blocks, heads, loss_kind, label, 
         optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
         mesh=make_mesh(model_parallel=model_parallel),
         shard_vocab=model_parallel > 1,
+        # the precision-ladder rows go through the sanctioned policy (model
+        # compute dtype + f32 master params + f32 loss accumulation), not a
+        # hand-set model dtype — the bench measures what fit() would run
+        precision=precision,
     )
     # the pallas head is opaque to the XLA cost model: add its analytic FLOPs
     # back so the fused/TP MFU stays honest next to the plain-CE rows
@@ -260,6 +271,8 @@ def run_sasrec(num_items, dim, batch, seq_len, blocks, heads, loss_kind, label, 
     )
     meta = {"num_items": num_items, "d": dim, "B": batch, "L": seq_len,
             "loss": loss_label}
+    if precision is not None:
+        meta["precision"] = precision
     if model_parallel > 1:
         meta["model_parallel"] = model_parallel
     if loss_kind == "sce":
@@ -561,6 +574,25 @@ def main():
             rows[name] = (
                 lambda n=size_items, k=kind, lbl=name: run_sasrec(
                     n, scale_dim, B, L, 2, 2, k, lbl, dtype, q
+                )
+            )
+    # the precision-ladder family (docs/performance.md "The precision
+    # ladder"): f32 vs bf16 through the SANCTIONED Trainer(precision=...)
+    # policy at the 27k catalog shape, per head. The claim is per-pair:
+    # the bf16 row must carry strictly lower hbm_peak_bytes and a moved
+    # roofline (the of_roofline_ceiling honesty check), not just step_ms on a
+    # toy shape — obs.report --compare gates prec_* rows' hbm_peak_bytes
+    # lower-better. Model dtype is pinned f32 here so ONLY the policy differs
+    # between the two rows of a pair.
+    prec_items = 96 if q else 27278
+    prec_dim = 16 if q else 128
+    for prec_tag in ("f32", "bf16"):
+        for kind in ("ce", "fused", "tp"):
+            name = f"prec_{prec_tag}_{kind}"
+            rows[name] = (
+                lambda k=kind, lbl=name, tag=prec_tag: run_sasrec(
+                    prec_items, prec_dim, B, L, 2, 2, k, lbl, jnp.float32, q,
+                    precision=tag,
                 )
             )
     selected = list(rows) if args.rows == "all" else args.rows.split(",")

@@ -20,16 +20,53 @@ import pandas as pd
 
 
 class MIPSIndex:
-    """Exact maximum-inner-product top-k over (optionally mesh-sharded) items."""
+    """Exact maximum-inner-product top-k over (optionally mesh-sharded) items.
 
-    def __init__(self, item_vectors: np.ndarray, mesh=None, axis_name: str = "data") -> None:
+    ``precision="int8"`` stores the catalog per-row symmetrically quantized
+    (``replay_tpu.serve.quant``): the device sweep reads ¼ the bytes — the
+    traffic that dominates retrieval latency for memory-bound catalogs — and
+    scores dequantize in registers (``(q @ w_int8ᵀ) * scale``). The f32
+    master copy stays HOST-side (``host_vectors``) and feeds
+    :meth:`exact_rescore`, the full-precision candidate rescoring the
+    serving pipeline applies before its top-k cut; device HBM holds only the
+    int8 rows + f32 scales. Mesh-sharded, the int8 values keep the CEFusedTP
+    ``[I/n, E]`` row-shard layout (scales shard ``[I/n]`` alongside) — the
+    layout that lets 10M-item tables fit where f32 cannot.
+    """
+
+    def __init__(
+        self,
+        item_vectors: np.ndarray,
+        mesh=None,
+        axis_name: str = "data",
+        precision: str = "f32",
+    ) -> None:
         import jax
         import jax.numpy as jnp
 
+        if precision not in ("f32", "int8"):
+            msg = f"MIPSIndex precision must be 'f32' or 'int8', got {precision!r}"
+            raise ValueError(msg)
         self.num_items, self.dim = item_vectors.shape
-        self.host_vectors = np.asarray(item_vectors)  # unpadded host copy
+        self.host_vectors = np.asarray(item_vectors)  # unpadded f32 master copy
         self.mesh = mesh
         self.axis_name = axis_name
+        self.precision = precision
+
+        scales = None
+        if precision == "int8":
+            from replay_tpu.serve.quant import quantize_embeddings
+
+            quantized = quantize_embeddings(self.host_vectors)
+            item_vectors = quantized.values  # int8 [I, E]
+            scales = quantized.scales  # f32 [I]
+            self._payload_nbytes = quantized.nbytes
+        else:
+            item_vectors = np.asarray(item_vectors)
+            self._payload_nbytes = int(
+                self.num_items * self.dim * item_vectors.dtype.itemsize
+            )
+
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -38,25 +75,47 @@ class MIPSIndex:
             n_shards = mesh.shape[axis_name]
             padded_rows = -(-self.num_items // n_shards) * n_shards
             if padded_rows != self.num_items:
+                pad = padded_rows - self.num_items
                 item_vectors = np.concatenate(
-                    [item_vectors, np.zeros((padded_rows - self.num_items, self.dim),
-                                            item_vectors.dtype)]
+                    [item_vectors, np.zeros((pad, self.dim), item_vectors.dtype)]
                 )
+                if scales is not None:
+                    scales = np.concatenate([scales, np.zeros(pad, scales.dtype)])
             self.item_vectors = jax.device_put(
                 jnp.asarray(item_vectors), NamedSharding(mesh, P(axis_name, None))
             )
+            if scales is not None:
+                self.item_scales = jax.device_put(
+                    jnp.asarray(scales), NamedSharding(mesh, P(axis_name))
+                )
         else:
             self.item_vectors = jnp.asarray(item_vectors)
+            if scales is not None:
+                self.item_scales = jnp.asarray(scales)
+        if scales is None:
+            self.item_scales = None
 
         self._search_cache = {}
+        self._rescore_fn = None
+
+    def table_bytes(self) -> dict:
+        """Logical payload bytes of the device catalog (unpadded rows): the
+        honesty number the quant bench rows report next to the f32 baseline."""
+        f32_bytes = int(self.num_items * self.dim * 4)
+        return {
+            "precision": self.precision,
+            "payload_bytes": int(self._payload_nbytes),
+            "f32_bytes": f32_bytes,
+            "bytes_ratio": self._payload_nbytes / max(f32_bytes, 1),
+        }
 
     def _compiled_search(self, k: int):
         import jax
         import jax.numpy as jnp
-        from functools import partial
 
         if k in self._search_cache:
             return self._search_cache[k]
+        quantized = self.precision == "int8"
 
         if self.mesh is not None:
             from jax.experimental.shard_map import shard_map
@@ -69,8 +128,13 @@ class MIPSIndex:
             # sees >= k candidates because n_shards * shard_size >= num_items >= k
             local_k = min(k, shard_size)
 
-            def local_topk(queries, items):
-                scores = queries @ items.T  # [Q, I/shards]
+            def local_topk(queries, items, *scales):
+                if quantized:
+                    # weight-only dequantization: the HBM read is int8 (¼ the
+                    # bytes); the up-cast + per-row scale fuse into the matmul
+                    scores = (queries @ items.T.astype(queries.dtype)) * scales[0][None, :]
+                else:
+                    scores = queries @ items.T  # [Q, I/shards]
                 offset = jax.lax.axis_index(self.axis_name) * shard_size
                 positions = offset + jnp.arange(shard_size)
                 # catalog-padding rows can never win
@@ -78,10 +142,14 @@ class MIPSIndex:
                 values, idx = jax.lax.top_k(scores, local_k)
                 return values, idx + offset
 
+            # the int8 variant rides ONE extra [I/n] scales operand sharded
+            # alongside the rows; the f32 program is untouched
+            scale_specs = (P(self.axis_name),) if quantized else ()
+            scale_args = (self.item_scales,) if quantized else ()
             sharded = shard_map(
                 local_topk,
                 mesh=self.mesh,
-                in_specs=(P(), P(self.axis_name, None)),
+                in_specs=(P(), P(self.axis_name, None)) + scale_specs,
                 out_specs=(P(None, self.axis_name), P(None, self.axis_name)),
                 check_rep=False,
             )
@@ -89,9 +157,18 @@ class MIPSIndex:
             @jax.jit
             def search(queries):
                 # [Q, k*shards] candidates -> global top-k merge
-                values, idx = sharded(queries, self.item_vectors)
+                values, idx = sharded(queries, self.item_vectors, *scale_args)
                 merged_values, merged_pos = jax.lax.top_k(values, k)
                 return merged_values, jnp.take_along_axis(idx, merged_pos, axis=1)
+
+        elif quantized:
+
+            @jax.jit
+            def search(queries):
+                scores = (
+                    queries @ self.item_vectors.T.astype(queries.dtype)
+                ) * self.item_scales[None, :]
+                return jax.lax.top_k(scores, k)
 
         else:
 
@@ -102,6 +179,34 @@ class MIPSIndex:
 
         self._search_cache[k] = search
         return search
+
+    def exact_rescore(self, query_vectors, candidate_ids):
+        """Full-precision scores of already-retrieved candidates.
+
+        ``[Q, E]`` queries × ``[Q, C]`` candidate ids → ``[Q, C]`` exact f32
+        inner products against the MASTER (unquantized) rows — the serving
+        pipeline's re-rank input, so the quantized sweep only decides WHICH C
+        items are scored, never their final ranking scores. The f32 rows are
+        gathered from the host-side master copy (C×E×4 bytes per query — tiny
+        next to the table sweep the int8 path just avoided); for an f32 index
+        this reproduces ``search_jax``'s scores exactly (tests pin it).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._rescore_fn is None:
+
+            @jax.jit
+            def rescore(queries, rows):
+                return jnp.einsum(
+                    "qe,qce->qc",
+                    queries.astype(jnp.float32),
+                    rows.astype(jnp.float32),
+                )
+
+            self._rescore_fn = rescore
+        rows = self.host_vectors[np.asarray(candidate_ids)]  # [Q, C, E] f32
+        return self._rescore_fn(jnp.asarray(query_vectors, jnp.float32), jnp.asarray(rows))
 
     def search_jax(self, query_vectors, k: int):
         """(scores [Q, k], item ids [Q, k]) as DEVICE arrays — the fused

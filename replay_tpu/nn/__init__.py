@@ -19,6 +19,7 @@ from .mask import (
     padding_mask_from_ids,
 )
 from .postprocess import SeenItemsFilter
+from .precision import PARITY_REL_TOL, Precision, fit_parity_record
 from .vocabulary import (
     append_item_embeddings,
     get_item_embeddings,
@@ -56,7 +57,9 @@ __all__ = [
     "MultiHeadDifferentialAttention",
     "NumericalEmbedding",
     "OptimizerFactory",
+    "PARITY_REL_TOL",
     "PointWiseFeedForward",
+    "Precision",
     "PositionAwareAggregator",
     "PreemptionHandler",
     "RecoveryPolicy",
@@ -76,6 +79,7 @@ __all__ = [
     "Trainer",
     "bidirectional_attention_mask",
     "causal_attention_mask",
+    "fit_parity_record",
     "loss",
     "make_mesh",
     "padding_mask_from_ids",

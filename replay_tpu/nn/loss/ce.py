@@ -94,9 +94,12 @@ class CEFused(CE):
         Sanctioned: identical dtypes, and the flax compute-dtype split where
         one side is the float32 PARAM table (or f32 hidden) and the other a
         narrower float — the kernel accumulates in f32, exactly what
-        ``get_logits``'s einsum promotion does. Anything else (an integer /
-        quantized table, two different narrow floats) is a bug at the call
-        site, named here instead of surfacing as a wrong-loss training run.
+        ``get_logits``'s einsum promotion does. This is the precision
+        ladder's bf16 rung (``Trainer(precision="bf16")``: bf16 hidden
+        states against the f32 master table, docs/performance.md "The
+        precision ladder"). Anything else (an integer / quantized table, two
+        different narrow floats) is a bug at the call site, named here
+        instead of surfacing as a wrong-loss training run.
         """
         h_dt, t_dt = jnp.dtype(hidden.dtype), jnp.dtype(table.dtype)
         floats = jnp.issubdtype(h_dt, jnp.floating) and jnp.issubdtype(t_dt, jnp.floating)
@@ -106,10 +109,13 @@ class CEFused(CE):
         if not sanctioned:
             msg = (
                 f"{type(self).__name__}: hidden states are {h_dt} but the item "
-                f"table is {t_dt}. Only matching dtypes (or a float32 side "
-                "paired with a narrower float — the standard flax compute-vs-"
-                "param split, accumulated in f32 inside the kernel) are "
-                "supported; cast the model or the table explicitly."
+                f"table is {t_dt}. Only matching dtypes, or the sanctioned "
+                "mixed-precision split — narrow-float compute (e.g. bfloat16 "
+                "hidden states, the Trainer(precision='bf16') rung) against "
+                "the float32 master/param table, accumulated in f32 inside "
+                "the kernel — are supported; cast the model or the table "
+                "explicitly. int8 tables belong to the SERVING ladder rung "
+                "(replay_tpu.serve.quant + MIPSIndex), never to training."
             )
             raise ValueError(msg)
 

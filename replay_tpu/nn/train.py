@@ -468,6 +468,11 @@ class Trainer:
     :param optimizer: optimizer factory (default Adam 1e-3).
     :param mesh: device mesh; default = all devices, pure data parallel.
     :param shard_vocab: shard embedding tables over the ``model`` mesh axis.
+    :param precision: mixed-precision rung (``"bf16"`` / ``"f32"`` /
+        :class:`~replay_tpu.nn.Precision`): bf16 activations+compute with f32
+        master params, optimizer state and loss accumulation — loss-scale-free
+        on TPU, parity-gated against f32 (docs/performance.md "The precision
+        ladder"). ``None`` (default) changes nothing.
     :param label_field / mask fields: batch keys produced by the transform
         templates (replay_tpu.nn.transform.template).
     """
@@ -499,6 +504,14 @@ class Trainer:
     # by fit and emitted as a `health` payload (docs/performance.md "Model
     # health"). None = the step lowers exactly as before (no extra HLO).
     health: Optional[HealthConfig] = None
+    # mixed-precision policy (docs/performance.md "The precision ladder"):
+    # "bf16" / "f32" / a replay_tpu.nn.Precision. Applied at construction —
+    # the model is cloned with its flax compute `dtype` set to the rung's
+    # compute dtype (bf16 activations/compute; MASTER params and optimizer
+    # state stay f32 via flax's param_dtype default) and loss-consumed logits
+    # are up-cast to the rung's f32 accumulation dtype. None = untouched:
+    # every program lowers byte-identical to the pre-precision trainer.
+    precision: Optional[Any] = None
 
     def __post_init__(self) -> None:
         if isinstance(self.loss, str):
@@ -517,6 +530,14 @@ class Trainer:
                 )
                 raise ValueError(msg)
             self.loss = by_name[self.loss.lower()]()
+        from replay_tpu.nn.precision import Precision
+
+        self.precision = Precision.resolve(self.precision)
+        if self.precision is not None:
+            # bf16 rung: the model computes in bf16 through its flax dtype
+            # field while params (and therefore optimizer state, gradients and
+            # the sentinel arithmetic) stay f32 — loss-scale-free on TPU
+            self.model = self.precision.apply_to_model(self.model)
         if self.mesh is None:
             self.mesh = make_mesh()
         self._tx = self.optimizer.create()
@@ -701,6 +722,7 @@ class Trainer:
     # -- train ------------------------------------------------------------- #
     def _build_train_step(self, health: Optional[HealthConfig] = None):
         model, loss, tx = self.model, self.loss, self._tx
+        precision = self.precision
         if getattr(loss, "needs_item_embeddings", False) and not hasattr(
             type(model), "get_item_weights"
         ):
@@ -768,9 +790,16 @@ class Trainer:
                 logits_extra = {
                     name: batch[name] for name in self._logits_extra_params if name in batch
                 }
-                loss.logits_callback = partial(
+                logits_callback = partial(
                     model.apply, {"params": params}, method=type(model).get_logits, **logits_extra
                 )
+                if precision is not None and precision.casts_logits:
+                    # f32 loss accumulation under a narrow compute dtype:
+                    # candidate-shaped logits are a bf16×bf16 einsum and need
+                    # the explicit up-cast (full-catalog logits already
+                    # promote through the f32 item table)
+                    logits_callback = precision.wrap_logits_callback(logits_callback)
+                loss.logits_callback = logits_callback
                 if getattr(loss, "needs_item_embeddings", False):
                     # SCE-style losses mine hard negatives from the raw item table
                     loss.item_embeddings_callback = partial(
@@ -1689,6 +1718,7 @@ class Trainer:
             learning_rate=self.optimizer.learning_rate,
             mesh={axis: int(n) for axis, n in self.mesh.shape.items()},
             resumed=bool(resume and pending_restore_step is not None),
+            **(self.precision.describe() if self.precision is not None else {}),
         )
 
         if profile_steps is not None:

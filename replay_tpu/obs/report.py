@@ -450,7 +450,7 @@ def summarize_events(
             for key in (
                 "row", "samples_per_sec", "step_ms", "scan_k", "mfu",
                 "mfu_peak_assumed", "tflops_per_sec", "num_items", "d", "B",
-                "L", "loss", "model_parallel", "backend", "error",
+                "L", "loss", "precision", "model_parallel", "backend", "error",
                 # static program analyses (obs.roofline / parallel.introspect)
                 "roofline_bound", "roofline_ceiling_tflops",
                 "of_roofline_ceiling", "arithmetic_intensity",
@@ -460,6 +460,37 @@ def summarize_events(
         }
         for record in bench_rows
     ] or None
+
+    # the precision ladder's pair view (prec_{f32,bf16}_<head> bench rows):
+    # HBM/step deltas per head, the "each rung must move bytes" evidence.
+    # Rendered informationally; the CI gate is --compare's per-row lower-
+    # better hbm_peak_bytes on prec_* rows. NOTE the strictly-lower-HBM claim
+    # is a TPU claim: the CPU backend materializes f32 converts for bf16
+    # programs, so CPU smoke pairs legitimately show no byte win.
+    rows_by_name = {
+        record.get("row"): record for record in bench_rows if record.get("row")
+    }
+    pairs: Dict[str, Any] = {}
+    for name, row in rows_by_name.items():
+        if not name.startswith("prec_bf16_") or row.get("error"):
+            continue
+        head = name[len("prec_bf16_"):]
+        base = rows_by_name.get(f"prec_f32_{head}")
+        if not base or base.get("error"):
+            continue
+        pair: Dict[str, Any] = {
+            "f32_hbm_peak_bytes": _finite(base.get("hbm_peak_bytes")),
+            "bf16_hbm_peak_bytes": _finite(row.get("hbm_peak_bytes")),
+            "f32_step_ms": _finite(base.get("step_ms")),
+            "bf16_step_ms": _finite(row.get("step_ms")),
+            "backend": row.get("backend"),
+        }
+        if pair["f32_hbm_peak_bytes"] and pair["bf16_hbm_peak_bytes"] is not None:
+            pair["hbm_saved_fraction"] = (
+                1.0 - pair["bf16_hbm_peak_bytes"] / pair["f32_hbm_peak_bytes"]
+            )
+        pairs[head] = pair
+    summary["precision_pairs"] = pairs or None
 
     # peak device memory: fit telemetry first, then the bench record, then the
     # largest non-error suite row — the --compare lower-better gate's input
@@ -564,6 +595,19 @@ def summarize_events(
             serve["overload_deadline_miss_rate"] = _finite(
                 overload.get("deadline_miss_rate")
             )
+        quant = record.get("quant")
+        if isinstance(quant, Mapping):
+            # the int8-vs-f32 retrieval A/B (precision ladder's serving
+            # rung): recall/topk-match are --compare higher-better gates
+            serve["quant"] = {
+                key: quant.get(key)
+                for key in (
+                    "candidates", "top_k", "recall_at_candidates",
+                    "topk_match_rate", "f32_rank_ms", "int8_rank_ms",
+                    "int8_table_bytes", "f32_table_bytes", "bytes_ratio",
+                )
+                if key in quant
+            }
         chaos = record.get("chaos")
         if isinstance(chaos, Mapping):
             serve["chaos"] = {
@@ -862,6 +906,8 @@ def render(summary: Mapping[str, Any]) -> str:
                 parts.append(f"items {row['num_items']}")
             if row.get("loss"):
                 parts.append(str(row["loss"]))
+            if row.get("precision"):
+                parts.append(f"prec {row['precision']}")
             if row.get("roofline_bound"):
                 bound = f"{row['roofline_bound']}-bound"
                 of_ceiling = _finite(row.get("of_roofline_ceiling"))
@@ -875,6 +921,25 @@ def render(summary: Mapping[str, Any]) -> str:
             if collective:
                 parts.append(f"coll {collective / 1e6:.2f} MB")
             lines.append(f"    {row.get('row')}: " + " · ".join(parts))
+    precision_pairs = summary.get("precision_pairs")
+    if precision_pairs:
+        for head, pair in sorted(precision_pairs.items()):
+            if not isinstance(pair, Mapping):
+                continue
+            parts = []
+            f32_hbm, bf16_hbm = pair.get("f32_hbm_peak_bytes"), pair.get("bf16_hbm_peak_bytes")
+            if f32_hbm is not None and bf16_hbm is not None:
+                parts.append(f"HBM {f32_hbm / 1e6:.1f}→{bf16_hbm / 1e6:.1f} MB")
+                saved = pair.get("hbm_saved_fraction")
+                if saved is not None:
+                    parts.append(f"({saved:+.1%} saved)")
+            f32_ms, bf16_ms = pair.get("f32_step_ms"), pair.get("bf16_step_ms")
+            if f32_ms is not None and bf16_ms is not None:
+                parts.append(f"step {f32_ms:.3f}→{bf16_ms:.3f} ms")
+            if pair.get("backend") == "cpu":
+                # the byte win is a TPU claim: CPU materializes f32 converts
+                parts.append("[cpu smoke: byte win not expected]")
+            lines.append(f"  precision ladder [{head}]: " + " · ".join(parts))
     serve = summary.get("serve")
     if serve:
         parts = []
@@ -942,6 +1007,26 @@ def render(summary: Mapping[str, Any]) -> str:
             if serve.get("overload_deadline_miss_rate") is not None:
                 parts.append(f"deadline-miss {serve['overload_deadline_miss_rate']:.2%}")
             lines.append("  serving overload: " + " · ".join(parts))
+        quant = serve.get("quant")
+        if isinstance(quant, Mapping):
+            parts = []
+            recall = _finite(quant.get("recall_at_candidates"))
+            if recall is not None:
+                parts.append(
+                    f"int8 recall@{quant.get('candidates')} {recall:.4f}"
+                )
+            match = _finite(quant.get("topk_match_rate"))
+            if match is not None:
+                parts.append(f"top-{quant.get('top_k')} match {match:.4f}")
+            if _finite(quant.get("int8_rank_ms")) is not None:
+                parts.append(
+                    f"rank {quant['int8_rank_ms']:.2f} ms int8 vs "
+                    f"{_fmt(_finite(quant.get('f32_rank_ms')), '{:.2f}')} ms f32"
+                )
+            ratio = _finite(quant.get("bytes_ratio"))
+            if ratio is not None:
+                parts.append(f"table bytes ×{ratio:.3f}")
+            lines.append("  serving quant (int8 retrieval): " + " · ".join(parts))
         chaos = serve.get("chaos")
         if isinstance(chaos, Mapping):
             lines.append(
@@ -975,7 +1060,12 @@ def compare_runs(
     only catches step-function growth like a new compiled variant). Bench-suite
     rows compare per row name; rows carrying an ``error`` field on either side
     are skipped (the by-design 1M plain-CE OOM row must not trip the gate),
-    but a row that errors ONLY in the candidate is a regression.
+    but a row that errors ONLY in the candidate is a regression. ``prec_*``
+    rows (the precision-ladder family) additionally gate their per-row
+    ``hbm_peak_bytes`` lower-better on ``memory_threshold`` — a precision
+    regression that only moves bytes still fails. Serving ``quant`` blocks
+    gate ``recall_at_candidates`` / ``topk_match_rate`` higher-better with an
+    absolute 0.005 floor.
     """
     if memory_threshold is None:
         memory_threshold = threshold
@@ -1087,6 +1177,16 @@ def compare_runs(
             _finite(cand_row.get("samples_per_sec")),
             _finite(base_row.get("samples_per_sec")),
         )
+        if name.startswith("prec_"):
+            # the precision-ladder rows exist to MOVE bytes: a regression
+            # that only grows hbm_peak_bytes (throughput held) must still
+            # fail — per-row lower-better on the --memory-threshold knob
+            check_lower_better(
+                f"bench_row[{name}].hbm_peak_bytes",
+                _finite(cand_row.get("hbm_peak_bytes")),
+                _finite(base_row.get("hbm_peak_bytes")),
+                memory_threshold,
+            )
     # anomaly-count gates: a run that skips more steps (or warns more) than
     # its baseline regressed in stability even when throughput held
     for name, label in (
@@ -1195,6 +1295,31 @@ def compare_runs(
             cand_value, base_value = _finite(cand_serve.get(name)), _finite(base_serve.get(name))
             if cand_value is not None and base_value is not None:
                 lines.append(f"  serve_{name}: {cand_value:.3f} vs {base_value:.3f}")
+        # int8 retrieval quality gates (precision ladder's serving rung):
+        # recall@C and the re-ranked top-k agreement are higher-better with an
+        # ABSOLUTE floor — retrieval quality sliding within a loose relative
+        # threshold is exactly the regression the gate exists to catch, so
+        # any drop beyond 0.005 absolute fails
+        cand_quant = cand_serve.get("quant") or {}
+        base_quant = base_serve.get("quant") or {}
+        if cand_quant or base_quant:
+            for name in ("recall_at_candidates", "topk_match_rate"):
+                cand_value = _finite(cand_quant.get(name))
+                base_value = _finite(base_quant.get(name))
+                if cand_value is None or base_value is None:
+                    lines.append(
+                        f"  serve_quant_{name}: candidate={_fmt(cand_value, '{:.4f}')} "
+                        f"baseline={_fmt(base_value, '{:.4f}')} (not comparable)"
+                    )
+                    continue
+                lines.append(
+                    f"  serve_quant_{name}: {cand_value:.4f} vs {base_value:.4f}"
+                )
+                if cand_value < base_value - 0.005:
+                    regressions.append(
+                        f"serve_quant_{name} regressed "
+                        f"{base_value:.4f} -> {cand_value:.4f} (higher is better)"
+                    )
     # cross-host balance: the straggler index (max/median per-host step time)
     # gates lower-better, but ONLY between two genuinely multi-process runs —
     # a single-process run's index is 1.0 by construction and comparing it

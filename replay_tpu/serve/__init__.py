@@ -38,6 +38,7 @@ from .errors import (
     ServiceClosed,
 )
 from .pipeline import CandidatePipeline
+from .quant import QuantizedTable, quantization_error, quantize_embeddings
 from .request import ScoreRequest, ScoreResponse, make_window
 from .service import ScoringService
 
@@ -57,6 +58,9 @@ __all__ = [
     "ServeError",
     "ServiceClosed",
     "UserState",
+    "QuantizedTable",
     "UserStateCache",
     "make_window",
+    "quantization_error",
+    "quantize_embeddings",
 ]

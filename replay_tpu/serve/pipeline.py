@@ -14,6 +14,16 @@ With the dot-product :class:`~replay_tpu.nn.head.EmbeddingTyingHead` (no
 bias), MIPS scores over the item-embedding table are bitwise-identical gathers
 of the full-catalog logits — retrieval loses nothing, it only skips scoring
 items that cannot reach the top-C (tests pin this).
+
+Precision-ladder rung (docs/performance.md "The precision ladder"): an
+``int8``-quantized index (``MIPSIndex(..., precision="int8")``, backed by
+``replay_tpu.serve.quant``) changes only the candidate SELECTION sweep — the
+pipeline inserts an ``exact_rescore`` stage that re-scores the retrieved
+top-C rows at full f32 precision before the re-rank/top-k cut, so the final
+scores and ranking quality match the f32 pipeline whenever the quantized
+sweep surfaces the same candidates (recall@C ≥ 0.99 gated in
+``tests/serve/test_quant.py``), while the retrieval-dominating table bytes
+drop 4×.
 """
 
 from __future__ import annotations
@@ -97,13 +107,20 @@ class CandidatePipeline:
     def rank(self, hidden, tracer=None) -> Tuple[np.ndarray, np.ndarray]:
         """``[B, E]`` query states → (scores ``[B, k]``, item ids ``[B, k]``).
 
-        The two device stages are traced as ``retrieve`` / ``rerank`` spans
-        when a tracer is supplied."""
+        The device stages are traced as ``retrieve`` / ``rescore`` /
+        ``rerank`` spans when a tracer is supplied (``rescore`` only for a
+        quantized index: exact f32 scores of the retrieved candidates replace
+        the quantized sweep's approximate values before the re-rank cut)."""
         import contextlib
 
         span = tracer.span if tracer is not None else (lambda *_a, **_k: contextlib.nullcontext())
         with span("retrieve", rows=int(np.shape(hidden)[0]), k=self.num_candidates):
             values, ids = self.index.search_jax(hidden, self.num_candidates)
+        if getattr(self.index, "precision", "f32") != "f32":
+            # full-precision re-rank input: the int8 sweep only chose WHICH C
+            # rows to score; their ranking scores are exact f32
+            with span("rescore", rows=int(np.shape(hidden)[0]), k=self.num_candidates):
+                values = self.index.exact_rescore(hidden, ids)
         with span("rerank", rows=int(np.shape(hidden)[0]), k=self.top_k):
             scores, items = self._rerank(values, ids)
             scores = np.asarray(scores)
@@ -111,4 +128,8 @@ class CandidatePipeline:
         return scores, items
 
     def stats(self) -> Dict[str, int]:
-        return {"num_candidates": self.num_candidates, "top_k": self.top_k}
+        return {
+            "num_candidates": self.num_candidates,
+            "top_k": self.top_k,
+            "index_precision": getattr(self.index, "precision", "f32"),
+        }

@@ -748,3 +748,112 @@ def test_bench_rows_render_roofline_fields(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "memory-bound (42% of ceiling)" in out
     assert "HBM 64.0 MB" in out and "coll 2.00 MB" in out
+
+
+# --------------------------------------------------------------------------- #
+# the precision ladder (prec_* bench rows + serving quant block)
+# --------------------------------------------------------------------------- #
+def test_prec_rows_gate_hbm_lower_better(tmp_path, capsys):
+    """A prec_* row whose hbm_peak_bytes grew past --memory-threshold fails
+    even at held throughput — the precision regression that only moves bytes;
+    non-prec rows keep their throughput-only gate."""
+    baseline = _write_suite_run(str(tmp_path / "base"), [
+        {"row": "prec_bf16_fused", "samples_per_sec": 900.0,
+         "hbm_peak_bytes": 50_000_000, "precision": "bf16"},
+        {"row": "scale_27k_fused", "samples_per_sec": 900.0,
+         "hbm_peak_bytes": 50_000_000},
+    ])
+    candidate = _write_suite_run(str(tmp_path / "cand"), [
+        {"row": "prec_bf16_fused", "samples_per_sec": 910.0,
+         "hbm_peak_bytes": 80_000_000, "precision": "bf16"},
+        # same 60% HBM growth on a NON-prec row: surfaced, not gated
+        {"row": "scale_27k_fused", "samples_per_sec": 910.0,
+         "hbm_peak_bytes": 80_000_000},
+    ])
+    rc = main([candidate, "--compare", baseline])
+    err = capsys.readouterr().err
+    assert rc != 0
+    assert "bench_row[prec_bf16_fused].hbm_peak_bytes" in err
+    assert "scale_27k_fused].hbm_peak_bytes" not in err
+
+
+def test_prec_rows_hbm_gate_respects_memory_threshold(tmp_path):
+    baseline = _write_suite_run(str(tmp_path / "base"), [
+        {"row": "prec_bf16_ce", "samples_per_sec": 900.0,
+         "hbm_peak_bytes": 50_000_000},
+    ])
+    candidate = _write_suite_run(str(tmp_path / "cand"), [
+        {"row": "prec_bf16_ce", "samples_per_sec": 900.0,
+         "hbm_peak_bytes": 56_000_000},
+    ])
+    # 12% growth: fails the default 10% memory threshold, passes at 20%
+    assert main([candidate, "--compare", baseline]) != 0
+    assert main([candidate, "--compare", baseline, "--memory-threshold", "0.2"]) == 0
+
+
+def test_precision_pairs_summarize_and_render(tmp_path, capsys):
+    run = _write_suite_run(str(tmp_path / "suite"), [
+        {"row": "prec_f32_fused", "samples_per_sec": 900.0, "step_ms": 4.0,
+         "precision": "f32", "hbm_peak_bytes": 100_000_000, "backend": "tpu"},
+        {"row": "prec_bf16_fused", "samples_per_sec": 1200.0, "step_ms": 3.0,
+         "precision": "bf16", "hbm_peak_bytes": 60_000_000, "backend": "tpu"},
+    ])
+    summary = summarize_run(run)
+    pair = summary["precision_pairs"]["fused"]
+    assert pair["f32_hbm_peak_bytes"] == 100_000_000
+    assert pair["bf16_hbm_peak_bytes"] == 60_000_000
+    assert pair["hbm_saved_fraction"] == pytest.approx(0.4)
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "precision ladder [fused]" in out
+    assert "HBM 100.0→60.0 MB" in out and "+40.0% saved" in out
+    assert "prec bf16" in out  # the per-row precision tag renders too
+
+
+def _write_quant_serve_run(path, recall=0.996, topk_match=1.0):
+    os.makedirs(path, exist_ok=True)
+    record = {
+        "metric": "serve_qps", "value": 250.0, "unit": "req/s", "qps": 250.0,
+        "p50_ms": 2.0, "p95_ms": 3.5, "p99_ms": 4.5, "batch_fill_ratio": 0.8,
+        "cache_hit_rate": 0.9, "requests": 512, "mode": "retrieval",
+        "quant": {
+            "candidates": 100, "top_k": 10,
+            "recall_at_candidates": recall, "topk_match_rate": topk_match,
+            "f32_rank_ms": 0.9, "int8_rank_ms": 0.7,
+            "int8_table_bytes": 4000, "f32_table_bytes": 12800,
+            "bytes_ratio": 0.3125,
+        },
+    }
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        fh.write(json.dumps(record) + "\n")
+    return path
+
+
+def test_serve_quant_summarizes_and_renders(tmp_path, capsys):
+    run = _write_quant_serve_run(str(tmp_path / "serve"))
+    summary = summarize_run(run)
+    quant = summary["serve"]["quant"]
+    assert quant["recall_at_candidates"] == pytest.approx(0.996)
+    assert quant["bytes_ratio"] == pytest.approx(0.3125)
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "serving quant (int8 retrieval)" in out
+    assert "int8 recall@100 0.9960" in out and "table bytes" in out
+
+
+def test_serve_quant_recall_gates_higher_better(tmp_path, capsys):
+    baseline = _write_quant_serve_run(str(tmp_path / "base"), recall=0.996)
+    candidate = _write_quant_serve_run(str(tmp_path / "cand"), recall=0.95)
+    rc = main([candidate, "--compare", baseline])
+    assert rc != 0
+    assert "serve_quant_recall_at_candidates" in capsys.readouterr().err
+    # within the absolute 0.005 band: measurement noise, not a regression
+    near = _write_quant_serve_run(str(tmp_path / "near"), recall=0.993)
+    assert main([near, "--compare", baseline]) == 0
+
+
+def test_serve_quant_topk_match_gates(tmp_path, capsys):
+    baseline = _write_quant_serve_run(str(tmp_path / "base"), topk_match=1.0)
+    candidate = _write_quant_serve_run(str(tmp_path / "cand"), topk_match=0.9)
+    assert main([candidate, "--compare", baseline]) != 0
+    assert "serve_quant_topk_match_rate" in capsys.readouterr().err

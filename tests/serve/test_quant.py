@@ -1,0 +1,242 @@
+"""int8 serving retrieval — the precision ladder's serving rung.
+
+Round-trip quality gates on a synthetic catalog (ISSUE 11 acceptance):
+recall@100 of the quantized candidate sweep vs f32 MIPS ≥ 0.99, the re-ranked
+``CandidatePipeline`` top-k agreeing with the f32 pipeline on the same
+candidates (the exact-f32-rescore stage makes the quantization error pick
+candidates only, never rank them), table payload ≈ ¼ of f32, and the sharded
+``[I/n, E]`` layout reproducing the unsharded search bit-for-bit.
+
+The smoke test leaves ``REPLAY_TPU_RUN_DIR/precision_smoke/quant_gate.json``
+for the CI ``precision_smoke`` gate.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from replay_tpu.serve.quant import (
+    QuantizedTable,
+    quantization_error,
+    quantize_embeddings,
+)
+
+NUM_ITEMS = 2000
+DIM = 64
+QUERIES = 128
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    rng = np.random.default_rng(0)
+    # realistic spread: per-item norms vary (popular items larger) — the
+    # per-ROW scales are what keeps the tail's resolution
+    table = rng.normal(size=(NUM_ITEMS, DIM)).astype(np.float32)
+    table *= rng.lognormal(0.0, 0.4, size=(NUM_ITEMS, 1)).astype(np.float32)
+    queries = rng.normal(size=(QUERIES, DIM)).astype(np.float32)
+    return table, queries
+
+
+# --------------------------------------------------------------------------- #
+# host-side quantization math (no device involved)
+# --------------------------------------------------------------------------- #
+@pytest.mark.core
+def test_roundtrip_error_bounded_by_half_scale(catalog):
+    table, _ = catalog
+    quantized = quantize_embeddings(table)
+    stats = quantization_error(table, quantized)
+    # round-to-nearest on a symmetric grid: per-element error <= scale/2
+    assert stats["max_error_to_bound"] <= 1.0 + 1e-6, stats
+    assert stats["rel_frobenius_error"] < 0.01, stats
+    # int8 values + f32 scales: (E + 4) / 4E of the f32 table -> ~0.27 at E=64
+    assert stats["bytes_ratio"] <= (DIM + 4) / (4 * DIM) + 1e-9, stats
+
+
+@pytest.mark.core
+def test_zero_rows_quantize_to_exact_zero():
+    table = np.zeros((4, 8), np.float32)
+    table[1] = 3.0
+    quantized = quantize_embeddings(table)
+    assert np.array_equal(quantized.dequantize()[0], np.zeros(8))
+    assert quantized.scales[0] == 0.0
+    np.testing.assert_allclose(quantized.dequantize()[1], table[1], atol=3.0 / 254)
+
+
+@pytest.mark.core
+def test_quantize_rejects_bad_inputs():
+    with pytest.raises(ValueError, match="bits"):
+        quantize_embeddings(np.zeros((2, 2), np.float32), bits=4)
+    with pytest.raises(ValueError, match="shape"):
+        quantize_embeddings(np.zeros(8, np.float32))
+
+
+# --------------------------------------------------------------------------- #
+# device search / pipeline
+# --------------------------------------------------------------------------- #
+def _recall(reference_ids: np.ndarray, candidate_ids: np.ndarray) -> float:
+    k = reference_ids.shape[1]
+    return float(
+        np.mean(
+            [
+                len(set(a.tolist()) & set(b.tolist())) / k
+                for a, b in zip(reference_ids, candidate_ids)
+            ]
+        )
+    )
+
+
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_int8_search_recall_and_bytes(catalog):
+    """The acceptance gate: recall@100 ≥ 0.99 vs f32 MIPS, payload ≈ ¼.
+    Leaves the CI precision_smoke quant artifact."""
+    from replay_tpu.models.ann import MIPSIndex
+
+    table, queries = catalog
+    f32_index = MIPSIndex(table)
+    int8_index = MIPSIndex(table, precision="int8")
+    _, f32_ids = f32_index.search(queries, 100)
+    _, int8_ids = int8_index.search(queries, 100)
+    recall = _recall(f32_ids, int8_ids)
+    table_bytes = int8_index.table_bytes()
+    assert recall >= 0.99, recall
+    assert table_bytes["bytes_ratio"] <= (DIM + 4) / (4 * DIM) + 1e-9, table_bytes
+    assert table_bytes["payload_bytes"] == NUM_ITEMS * DIM + NUM_ITEMS * 4
+
+    base = os.environ.get("REPLAY_TPU_RUN_DIR")
+    if base:  # CI artifact: the int8 retrieval gate numbers, re-runnable
+        run_dir = os.path.join(base, "precision_smoke")
+        os.makedirs(run_dir, exist_ok=True)
+        with open(os.path.join(run_dir, "quant_gate.json"), "w") as fh:
+            json.dump(
+                {
+                    "recall_at_100": recall,
+                    "bytes_ratio": table_bytes["bytes_ratio"],
+                    "catalog": NUM_ITEMS,
+                    "dim": DIM,
+                    "queries": QUERIES,
+                },
+                fh,
+                indent=1,
+            )
+
+
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_pipeline_topk_matches_f32_via_exact_rescore(catalog):
+    """The re-ranked int8 pipeline's top-k must match the f32 pipeline's on
+    the same candidates: the rescore stage scores candidates at exact f32, so
+    whenever the quantized sweep surfaces the f32 winners the final cut is
+    IDENTICAL — quantization error selects candidates, never ranks them."""
+    from replay_tpu.models.ann import MIPSIndex
+    from replay_tpu.serve import CandidatePipeline
+
+    table, queries = catalog
+    # exercise the re-rank math without SATURATING the sigmoid: saturated
+    # scores collapse to exact 1.0 ties and top_k tie-breaks by candidate
+    # position, which legitimately differs between the two sweeps
+    weights = np.asarray([0.05, 0.1], np.float32)
+    f32_pipe = CandidatePipeline(
+        MIPSIndex(table), num_candidates=100, top_k=10, reranker_weights=weights
+    )
+    int8_pipe = CandidatePipeline(
+        MIPSIndex(table, precision="int8"),
+        num_candidates=100, top_k=10, reranker_weights=weights,
+    )
+    f32_scores, f32_topk = f32_pipe.rank(queries)
+    int8_scores, int8_topk = int8_pipe.rank(queries)
+
+    _, f32_cands = f32_pipe.index.search(queries, 100)
+    _, int8_cands = int8_pipe.index.search(queries, 100)
+    exact_rows = 0
+    for row in range(queries.shape[0]):
+        if set(f32_topk[row].tolist()) <= set(int8_cands[row].tolist()):
+            # the f32 winners were all retrieved: the exact rescore must
+            # reproduce the f32 pipeline's cut — same item SET and same
+            # scores (id ORDER may differ only under float tie-breaking: the
+            # gathered-rows einsum associates f32 adds differently than the
+            # full-table matmul, and the sigmoid saturates near-ties)
+            assert set(f32_topk[row].tolist()) == set(int8_topk[row].tolist())
+            np.testing.assert_allclose(
+                np.sort(f32_scores[row]), np.sort(int8_scores[row]),
+                rtol=1e-5, atol=1e-6,
+            )
+            exact_rows += 1
+    # with recall >= 0.99 nearly every row qualifies — the exact-match branch
+    # must be the overwhelmingly common case, not a vacuous assertion
+    assert exact_rows >= int(0.9 * queries.shape[0]), exact_rows
+    # overall agreement even counting the non-qualifying rows
+    assert _recall(f32_topk, int8_topk) >= 0.99
+
+
+@pytest.mark.jax
+def test_sharded_int8_matches_unsharded(catalog):
+    """The CEFusedTP [I/n, E] row-shard layout reuse: a mesh-sharded int8
+    index (non-divisible catalog -> zero-padded tail shard) returns the same
+    ids/scores as the unsharded int8 search."""
+    from replay_tpu.models.ann import MIPSIndex
+    from replay_tpu.nn import make_mesh
+
+    table, queries = catalog
+    odd = table[:1999]  # 1999 rows over 8 shards: padding exercised
+    unsharded = MIPSIndex(odd, precision="int8")
+    sharded = MIPSIndex(odd, mesh=make_mesh(), axis_name="data", precision="int8")
+    values_u, ids_u = unsharded.search(queries, 64)
+    values_s, ids_s = sharded.search(queries, 64)
+    np.testing.assert_allclose(values_s, values_u, rtol=1e-5, atol=1e-6)
+    assert np.array_equal(ids_s, ids_u)
+
+
+@pytest.mark.jax
+def test_exact_rescore_reproduces_f32_scores(catalog):
+    from replay_tpu.models.ann import MIPSIndex
+
+    table, queries = catalog
+    f32_index = MIPSIndex(table)
+    int8_index = MIPSIndex(table, precision="int8")
+    values, ids = f32_index.search(queries, 50)
+    rescored = np.asarray(int8_index.exact_rescore(queries, ids))
+    np.testing.assert_allclose(rescored, values, rtol=1e-5, atol=1e-6)
+    # the f32 index rescoring its own candidates is the identity check
+    own = np.asarray(f32_index.exact_rescore(queries, ids))
+    np.testing.assert_allclose(own, values, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.jax
+def test_pipeline_spans_mark_the_rescore_stage(catalog):
+    """The int8 pipeline traces retrieve → rescore → rerank; the f32 pipeline
+    must NOT grow a rescore stage (its scores are already exact)."""
+    from replay_tpu.models.ann import MIPSIndex
+    from replay_tpu.obs import Tracer
+    from replay_tpu.serve import CandidatePipeline
+
+    table, queries = catalog
+    for precision, expect_rescore in (("f32", False), ("int8", True)):
+        tracer = Tracer()
+        pipeline = CandidatePipeline(
+            MIPSIndex(table, precision=precision), num_candidates=20, top_k=5
+        )
+        pipeline.rank(queries[:8], tracer=tracer)
+        names = set(tracer.summary())
+        assert "retrieve" in names and "rerank" in names
+        assert ("rescore" in names) == expect_rescore, (precision, names)
+        assert pipeline.stats()["index_precision"] == precision
+
+
+@pytest.mark.jax
+def test_mips_rejects_unknown_precision(catalog):
+    from replay_tpu.models.ann import MIPSIndex
+
+    table, _ = catalog
+    with pytest.raises(ValueError, match="precision"):
+        MIPSIndex(table, precision="int4")
+
+
+@pytest.mark.core
+def test_quantized_table_shape_accessors():
+    quantized = quantize_embeddings(np.ones((6, 4), np.float32))
+    assert isinstance(quantized, QuantizedTable)
+    assert (quantized.num_items, quantized.dim) == (6, 4)
+    assert quantized.nbytes == 6 * 4 + 6 * 4  # int8 values + f32 scales
