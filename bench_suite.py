@@ -21,6 +21,12 @@ produces the full measurement batch the round-4 verdict asked for:
   prefetch → chunked ``train_steps``: the production input path, measured
   end-to-end against the device-resident number (ref thread-tuning note,
   replay/data/nn/parquet/parquet_dataset.py:49-52).
+- ``stream_{inmem,parquet,packed}`` — the streaming-input family
+  (docs/performance.md "Feeding the beast"): the same ragged data through the
+  fixed-shape in-memory batcher, the row-group-sharded out-of-core parquet
+  reader (read-ahead + memory budget), and first-fit sequence packing with
+  segment masks. Rows report ``effective_tokens_per_sec`` (real tokens/s) and
+  ``padding_fraction``; ``obs.report --compare`` gates packed ≥ unpacked.
 - ``attention_long``   — tiled flash kernel (ops/flash_tiled.py) vs XLA full
   attention at L=4096, fwd+bwd: the single-chip long-context A/B.
 - ``sasrec_l1024`` / ``sasrec_l1024_tiled`` — the full MODEL at L=1024
@@ -522,6 +528,162 @@ def run_pipeline_e2e(num_items, dim, batch, seq_len, quick, dtype):
         }
 
 
+def run_stream(kind, num_items, dim, batch, seq_len, quick, dtype):
+    """Streaming-input family (docs/performance.md "Feeding the beast"):
+    the SAME ragged synthetic interaction data through three input stages —
+
+    - ``stream_inmem``:   SequenceBatcher (fixed [B, L], padding waste as-is)
+    - ``stream_parquet``: row-group-sharded ParquetBatcher with read-ahead +
+                          a memory budget (the out-of-core path)
+    - ``stream_packed``:  PackedSequenceBatcher (first-fit packing + segment
+                          masks — the padding-waste cure)
+
+    each feeding chunked ``train_steps``. Rows report the feed-efficiency
+    numbers: ``effective_tokens_per_sec`` (REAL tokens/s through the device)
+    and ``padding_fraction``; ``obs.report --compare`` gates packed ≥ unpacked
+    effective tokens/s whenever both rows are present.
+    """
+    import jax
+    import pandas as pd
+
+    from replay_tpu.data.nn import (
+        PackedSequenceBatcher,
+        ParquetBatcher,
+        SequenceBatcher,
+        SequentialDataset,
+        TensorFeatureInfo,
+        TensorSchema,
+        TransformedBatches,
+        prefetch,
+        write_sequence_parquet,
+    )
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.nn.transform import Compose
+    from replay_tpu.nn.transform.template import (
+        make_default_sasrec_transforms,
+        make_packed_sasrec_transforms,
+    )
+
+    schema = TensorSchema(
+        TensorFeatureInfo(
+            "item_id", FeatureType.CATEGORICAL, is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID, cardinality=num_items,
+            embedding_dim=dim,
+        )
+    )
+    rng = np.random.default_rng(0)
+    num_rows = batch * (32 if quick else 48)
+    # short sequences (mean ~L/4): the padding-waste regime packing targets
+    lengths = rng.integers(2, max(3, seq_len // 2), size=num_rows)
+    frame = pd.DataFrame({
+        "query_id": np.arange(num_rows),
+        "item_id": [rng.integers(1, num_items, n).astype(np.int64) for n in lengths],
+    })
+    dataset = SequentialDataset(schema, "query_id", "item_id", frame)
+
+    model = SasRec(schema=schema, embedding_dim=dim, num_blocks=2, num_heads=1,
+                   max_sequence_length=seq_len, dropout_rate=0.0, dtype=dtype)
+    trainer = Trainer(model=model, loss=CE(),
+                      optimizer=OptimizerFactory(name="adam", learning_rate=1e-3),
+                      mesh=make_mesh())
+    scan_k = 4 if quick else 8
+    tmp_ctx = tempfile.TemporaryDirectory(prefix="bench_stream_")
+    extra_meta = {}
+    with tmp_ctx:
+        if kind == "packed":
+            pipeline = Compose(make_packed_sasrec_transforms(schema)["train"])
+            batcher = PackedSequenceBatcher(
+                dataset, batch_size=batch, max_sequence_length=seq_len + 1,
+                shuffle=True, seed=0,
+            )
+            extra_meta = {
+                "segments_per_row": round(
+                    batcher.packing_summary()["segments_per_row"], 3
+                )
+            }
+        elif kind == "parquet":
+            pipeline = Compose(make_default_sasrec_transforms(schema)["train"])
+            path = os.path.join(tmp_ctx.name, "stream.parquet")
+            write_sequence_parquet(path, dataset, rows_per_chunk=max(batch, 64))
+            batcher = ParquetBatcher(
+                path, batch_size=batch, shuffle=True, seed=0,
+                shard="row_groups", read_ahead=2,
+                memory_budget_bytes=8 << 20,
+                metadata={"item_id": {"shape": seq_len + 1, "padding": 0}},
+            )
+            extra_meta = {"rows_on_disk": num_rows, "shard": "row_groups"}
+        elif kind == "inmem":
+            pipeline = Compose(make_default_sasrec_transforms(schema)["train"])
+            batcher = SequenceBatcher(
+                dataset, batch_size=batch, max_sequence_length=seq_len + 1,
+                shuffle=True, seed=0,
+            )
+        else:
+            msg = f"unknown stream kind {kind!r}"
+            raise ValueError(msg)
+        stream = TransformedBatches(batcher, pipeline)
+
+        def chunks(epoch):
+            # FULL chunks only: packing can shift the epoch's batch count by
+            # one, and a differently-sized tail chunk would recompile inside
+            # the measured window — the bench times one steady program
+            stream.set_epoch(epoch)
+            buf = []
+            for b in stream:
+                buf.append(b)
+                if len(buf) == scan_k:
+                    yield buf
+                    buf = []
+
+        state = None
+        for chunk in prefetch(chunks(0), depth=2):  # warmup epoch: compile
+            if state is None:
+                state = trainer.init_state(chunk[0])
+            state, losses = trainer.train_steps(state, chunk)
+        jax.block_until_ready(losses)
+
+        steps = 0
+        tokens_real = 0
+        tokens_grid = 0
+        sequences = 0
+        t0 = time.perf_counter()
+        for chunk in prefetch(chunks(1), depth=2):
+            state, losses = trainer.train_steps(state, chunk)
+            steps += len(chunk)
+            for b in chunk:
+                mask = np.asarray(b["padding_mask"])
+                valid = np.asarray(b["valid"])
+                tokens_real += int(mask[valid].sum())
+                tokens_grid += mask.size
+                if "segment_ids" in b:
+                    seg = np.asarray(b["segment_ids"])[valid]
+                    sequences += int((np.diff(seg, prepend=0) > 0).sum())
+                else:
+                    sequences += int(valid.sum())
+        jax.block_until_ready(losses)
+        elapsed = time.perf_counter() - t0
+
+    return {
+        "row": f"stream_{kind}",
+        # samples/sec = USER SEQUENCES per second (packed rows hold several),
+        # so the three rows compare like for like
+        "samples_per_sec": round(sequences / elapsed, 1),
+        "step_ms": round(elapsed / max(steps, 1) * 1000, 3),
+        "effective_tokens_per_sec": round(tokens_real / elapsed, 1),
+        "padding_fraction": round(1.0 - tokens_real / tokens_grid, 4) if tokens_grid else None,
+        "scan_k": scan_k,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "num_items": num_items, "d": dim, "B": batch, "L": seq_len,
+        "note": "stream family: same ragged data, three input stages; "
+                "host time included",
+        **extra_meta,
+    }
+
+
 # --------------------------------------------------------------------------- #
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
@@ -556,6 +718,12 @@ def main():
         "bert4rec": lambda: run_bert4rec(27278 if not q else 96, 300 if not q else 16, B, 100 if not q else L, 4 if not q else 2, dtype),
         "twotower": lambda: run_twotower(27278 if not q else 96, 64 if not q else 16, B, L, dtype),
         "pipeline_e2e": lambda: run_pipeline_e2e(3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
+        # the streaming-input family ("Feeding the beast"): padding waste vs
+        # effective tokens/s across the three input stages; --compare gates
+        # packed >= unpacked effective tokens/s
+        "stream_inmem": lambda: run_stream("inmem", 3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
+        "stream_parquet": lambda: run_stream("parquet", 3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
+        "stream_packed": lambda: run_stream("packed", 3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
         "attention_long": lambda: run_attention_long(4096 if not q else 32, q),
         "sasrec_l1024": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, False, "sasrec_l1024", dtype, q),
         "sasrec_l1024_tiled": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, True, "sasrec_l1024_tiled", dtype, q),

@@ -4,10 +4,12 @@ from .iterator import (
     DEFAULT_GROUND_TRUTH_PADDING_VALUE,
     DEFAULT_TRAIN_PADDING_VALUE,
     SequenceBatcher,
+    TransformedBatches,
     validation_batches,
 )
 from .module import DataModule
-from .parquet import ParquetBatcher, write_sequence_parquet
+from .packing import PackedSequenceBatcher, first_fit_pack
+from .parquet import ParquetBatcher, StreamCursor, write_sequence_parquet
 from .partitioning import Partitioning, ReplicasInfo
 from .prefetch import DevicePrefetcher, prefetch
 from .schema import TensorFeatureInfo, TensorFeatureSource, TensorMap, TensorSchema
@@ -27,10 +29,14 @@ __all__ = [
     "groupby_sequences",
     "TensorSchemaBuilder",
     "DataModule",
+    "PackedSequenceBatcher",
     "ParquetBatcher",
     "Partitioning",
+    "StreamCursor",
+    "first_fit_pack",
     "ReplicasInfo",
     "SequenceBatcher",
+    "TransformedBatches",
     "DevicePrefetcher",
     "prefetch",
     "SequenceTokenizer",

@@ -268,6 +268,49 @@ class SequenceBatcher:
                 yield self._make_batch(np.asarray(queues[bucket]), bucket, dtypes)
 
 
+class TransformedBatches:
+    """Re-iterable transform view over a batcher that FORWARDS the streaming
+    protocol (``set_epoch`` / ``supports_cursor`` / ``cursor_for`` /
+    ``restore_cursor`` / ``scan_compatible``).
+
+    ``Trainer.fit`` duck-types its batch source: a bare generator applying a
+    transform pipeline would hide the underlying batcher's resumable cursor
+    (and its epoch hook), silently downgrading out-of-core resume to
+    fast-forwarding. Wrap the pipeline here instead::
+
+        fit(TransformedBatches(batcher, Compose(pipeline)), ...)
+
+    The transform must be a deterministic ``batch -> batch`` callable — the
+    cursor contract re-applies it to the same raw batches after a resume.
+    """
+
+    def __init__(self, source, transform) -> None:
+        self.source = source
+        self.transform = transform
+
+    def __iter__(self):
+        for batch in self.source:
+            yield self.transform(batch)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.source, "set_epoch"):
+            self.source.set_epoch(epoch)
+
+    @property
+    def supports_cursor(self) -> bool:
+        return bool(getattr(self.source, "supports_cursor", False))
+
+    def cursor_for(self, batches_emitted: int):
+        return self.source.cursor_for(batches_emitted)
+
+    def restore_cursor(self, cursor) -> None:
+        self.source.restore_cursor(cursor)
+
+    @property
+    def scan_compatible(self) -> bool:
+        return bool(getattr(self.source, "scan_compatible", True))
+
+
 def validation_batches(
     train: SequentialDataset,
     ground_truth: SequentialDataset,

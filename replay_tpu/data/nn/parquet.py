@@ -17,14 +17,28 @@ TPU design:
 * every emitted batch is exactly ``batch_size`` rows (the final short batch is
   padded + flagged via ``valid``), so one XLA program serves the whole epoch.
 
+Cluster-scale streaming (docs/performance.md "Feeding the beast"):
+``shard="row_groups"`` plans the epoch from parquet FOOTER metadata only (file
+paths, per-row-group row/byte counts — no data reads) and deals whole row
+groups to replicas round-robin (:meth:`Partitioning.shard_items`), so each
+multi-host process reads a DISJOINT byte range instead of every host scanning
+every slab. ``memory_budget_bytes`` splits oversized groups into sub-slabs so
+the resident working set stays bounded (datasets ≫ host RAM), ``read_ahead``
+overlaps the next slab's file I/O with batch assembly on a background thread,
+and every emitted batch boundary records a :class:`StreamCursor` — a
+JSON-serializable (epoch, slab, row-offset, carry) tuple the trainer persists
+into the checkpoint sidecar so preemption-resume seeks straight back to the
+mid-epoch position without rescanning (``Trainer.fit(resume=True)``).
+
 Metadata spec (ref metadata/metadata.py): ``{column: {"shape": L, "padding":
 v}}`` marks list columns; scalar columns need no entry.
 """
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -32,6 +46,128 @@ from replay_tpu.data.nn.partitioning import Partitioning
 from replay_tpu.native import gather_pad, gather_pad_2d
 
 Batch = Dict[str, np.ndarray]
+
+# cursor history retention: bounded so an unattended fit can't grow without
+# limit, generous enough to cover any sane read-ahead (prefetch depth + scan
+# chunk buffering put the producer at most a few dozen batches past the step
+# the trainer checkpoints)
+_CURSOR_HISTORY = 1024
+
+
+@dataclass(frozen=True)
+class StreamCursor:
+    """A resumable position in a row-group-sharded parquet stream.
+
+    Recorded at every BATCH boundary; fully describes the state needed to
+    continue the epoch bit-for-bit without rescanning what came before:
+
+    * ``slab``: index into this replica's deterministic slab sequence (the
+      epoch plan is a pure function of (source metadata, seed, epoch,
+      replica)); slabs before it are skipped WITHOUT reading.
+    * ``rows``: rows of the current slab's (deterministically shuffled) order
+      already consumed — the one slab that is re-read and fast-forwarded.
+    * ``carry``: the < batch_size leftover rows that preceded the current
+      slab (cross-slab re-chunking state), serialized as plain JSON.
+    * ``batches``: batches emitted so far this epoch — must line up with the
+      trainer's ``step_in_epoch`` checkpoint position.
+    """
+
+    epoch: int
+    slab: int
+    rows: int
+    batches: int
+    carry: Optional[Dict[str, Any]] = None
+    # shape/dtype spec of an emitted batch — set on cursors past the first
+    # batch so a resume that finds no real batches left (landing among the
+    # tail's valid=False alignment batches) can rebuild them (zero-filled)
+    # without any pre-preemption history
+    pad_spec: Optional[Dict[str, Any]] = None
+    # the plan fingerprint (replica layout, seed, shuffle, batch size): the
+    # slab sequence is only meaningful under the SAME plan — restoring a
+    # cursor under a changed replica count / seed would silently re-train
+    # consumed row groups and skip unseen ones, so mismatches fail loudly
+    plan: Optional[Dict[str, Any]] = None
+
+    def to_metadata(self) -> Dict[str, Any]:
+        """Pure-JSON form (the checkpoint sidecar is a JSON document)."""
+        return {
+            "epoch": int(self.epoch),
+            "slab": int(self.slab),
+            "rows": int(self.rows),
+            "batches": int(self.batches),
+            "carry": self.carry,
+            "pad_spec": self.pad_spec,
+            "plan": self.plan,
+        }
+
+    @classmethod
+    def from_metadata(cls, record: Dict[str, Any]) -> "StreamCursor":
+        return cls(
+            epoch=int(record["epoch"]),
+            slab=int(record["slab"]),
+            rows=int(record["rows"]),
+            batches=int(record["batches"]),
+            carry=record.get("carry"),
+            pad_spec=record.get("pad_spec"),
+            plan=record.get("plan"),
+        )
+
+
+def _serialize_carry(carry: Optional[Batch]) -> Optional[Dict[str, Any]]:
+    if carry is None:
+        return None
+    out: Dict[str, Any] = {}
+    for name, value in carry.items():
+        arr = np.asarray(value)
+        out[name] = {
+            "dtype": arr.dtype.str,
+            "shape": list(arr.shape),
+            "values": arr.reshape(-1).tolist(),
+        }
+    return out
+
+
+def _deserialize_carry(record: Optional[Dict[str, Any]]) -> Optional[Batch]:
+    if record is None:
+        return None
+    out: Batch = {}
+    for name, entry in record.items():
+        out[name] = np.asarray(entry["values"], dtype=np.dtype(entry["dtype"])).reshape(
+            entry["shape"]
+        )
+    return out
+
+
+def _batch_spec(batch: Batch) -> Dict[str, Any]:
+    """JSON shape/dtype spec of a batch (no values)."""
+    return {
+        name: {"dtype": np.asarray(v).dtype.str, "shape": list(np.asarray(v).shape)}
+        for name, v in batch.items()
+        if name != "valid"
+    }
+
+
+def _zero_batch(spec: Dict[str, Any], batch_size: int) -> Batch:
+    """A deterministic all-masked alignment batch from a shape spec: zero
+    content, ``valid`` all False — identical whether built by an uninterrupted
+    run or a resumed one."""
+    out: Batch = {
+        name: np.zeros(entry["shape"], dtype=np.dtype(entry["dtype"]))
+        for name, entry in spec.items()
+    }
+    out["valid"] = np.zeros(batch_size, bool)
+    return out
+
+
+@dataclass(frozen=True)
+class _Slab:
+    """One planned read unit: a contiguous row range of one row group."""
+
+    file_index: int
+    group: int
+    start: int  # row offset within the group
+    rows: int
+    order_seed: int  # global sub-slab index — seeds the within-slab shuffle
 
 
 @dataclass
@@ -42,6 +178,19 @@ class ParquetBatcher:
     :param metadata: list-column spec ``{name: {"shape": int, "padding": int}}``.
     :param partition_size: rows per streamed slab (reference default 2**20);
         shuffling happens within a slab, sharding across replicas per slab.
+        ``shard="rows"`` only — row-group mode streams whole row groups.
+    :param shard: ``"rows"`` (legacy: every replica scans every slab and takes
+        a strided row slice) or ``"row_groups"`` (each replica reads a DISJOINT
+        round-robin share of the row groups — the multi-host streaming mode,
+        resumable via :meth:`cursor_for`).
+    :param memory_budget_bytes: row-group mode only — split groups whose
+        uncompressed footprint (from footer metadata) exceeds this into
+        sub-slabs, bounding the resident working set; the knob that makes
+        datasets ≫ host RAM stream.
+    :param read_ahead: row-group mode only — slabs to read ahead on a
+        background thread (host file I/O overlaps batch assembly, which in
+        turn feeds the trainer's DevicePrefetcher for the full
+        disk → host → device overlap chain). 0 = synchronous reads.
     """
 
     source: str
@@ -53,11 +202,127 @@ class ParquetBatcher:
     seed: int = 0
     partitioning: Optional[Partitioning] = None
     epoch: int = 0
+    shard: str = "rows"
+    memory_budget_bytes: Optional[int] = None
+    read_ahead: int = 0
+
+    def __post_init__(self) -> None:
+        if self.shard not in ("rows", "row_groups"):
+            msg = f"shard must be 'rows' or 'row_groups', got {self.shard!r}"
+            raise ValueError(msg)
+        if self.read_ahead < 0:
+            msg = "read_ahead must be >= 0"
+            raise ValueError(msg)
+        # batch-boundary cursor history for the resumable stream: ordinal
+        # (batches emitted this epoch) -> StreamCursor. Written by __iter__
+        # (possibly on a prefetch thread), read by Trainer.save_mid_epoch.
+        self._cursor_lock = threading.Lock()
+        self._cursor_history: Dict[int, StreamCursor] = {}
+        self._pending_cursor: Optional[StreamCursor] = None
+
+    # -- cursor API (row-group mode) ------------------------------------- #
+    @property
+    def supports_cursor(self) -> bool:
+        """Whether this batcher records resumable stream positions (the
+        trainer persists them into the checkpoint sidecar when True)."""
+        return self.shard == "row_groups"
+
+    def cursor_for(self, batches_emitted: int) -> StreamCursor:
+        """The stream position after ``batches_emitted`` batches of the
+        current epoch — safe to call while a prefetch thread reads ahead
+        (cursors are recorded when batches are PRODUCED, so every consumed
+        batch's boundary is present)."""
+        if not self.supports_cursor:
+            msg = "cursor_for requires shard='row_groups'"
+            raise ValueError(msg)
+        with self._cursor_lock:
+            cursor = self._cursor_history.get(batches_emitted)
+        if cursor is None:
+            msg = (
+                f"no cursor recorded for batch ordinal {batches_emitted} "
+                f"(epoch {self.epoch}); the stream has either not reached it "
+                f"or its history entry aged out (retention {_CURSOR_HISTORY})"
+            )
+            raise KeyError(msg)
+        return cursor
+
+    def restore_cursor(self, cursor) -> None:
+        """Arm the NEXT iteration to resume from ``cursor`` (one-shot).
+
+        Accepts a :class:`StreamCursor` or its ``to_metadata()`` JSON dict
+        (the checkpoint-sidecar form). The cursor's epoch must match the
+        batcher's current epoch — ``Trainer.fit`` calls ``set_epoch`` before
+        iterating, so a stale cursor fails loudly instead of silently
+        replaying the wrong slab order.
+        """
+        if not self.supports_cursor:
+            msg = "restore_cursor requires shard='row_groups'"
+            raise ValueError(msg)
+        if isinstance(cursor, dict):
+            cursor = StreamCursor.from_metadata(cursor)
+        if cursor.plan is not None and cursor.plan != self._plan_signature():
+            msg = (
+                "stream cursor was recorded under a different epoch plan "
+                f"(cursor {cursor.plan} vs batcher {self._plan_signature()}): "
+                "its slab sequence would replay/skip the wrong row groups. "
+                "Resume with the SAME replica layout, seed, shuffle and "
+                "batch size, or restart the epoch."
+            )
+            raise ValueError(msg)
+        self._pending_cursor = cursor
+        if cursor.epoch == self.epoch:
+            # the restored position is queryable immediately (cursor_for of
+            # the resume point), before the first batch is pulled
+            self._record_cursor(cursor)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        with self._cursor_lock:
+            self._cursor_history.clear()
+        if self.supports_cursor:
+            # the epoch-start position exists before any batch is pulled
+            self._record_cursor(StreamCursor(epoch=epoch, slab=0, rows=0, batches=0))
 
-    def _slabs(self):
+    def _plan_signature(self) -> Dict[str, Any]:
+        """The config half of the epoch plan (no I/O): a cursor is only
+        replayable under an identical signature."""
+        part = self.partitioning or Partitioning(shuffle=self.shuffle, seed=self.seed)
+        shuffled = bool(self.shuffle or part.shuffle)
+        return {
+            "num_replicas": int(part.replicas.num_replicas),
+            "replica_id": int(part.replicas.replica_id),
+            "seed": int(part.seed if part.shuffle else self.seed),
+            "shuffle": shuffled,
+            "batch_size": int(self.batch_size),
+            "memory_budget_bytes": self.memory_budget_bytes,
+        }
+
+    def _record_cursor(self, cursor: StreamCursor) -> None:
+        if cursor.plan is None:
+            import dataclasses
+
+            cursor = dataclasses.replace(cursor, plan=self._plan_signature())
+        with self._cursor_lock:
+            self._cursor_history[cursor.batches] = cursor
+            if len(self._cursor_history) > _CURSOR_HISTORY:
+                for stale in sorted(self._cursor_history)[
+                    : len(self._cursor_history) - _CURSOR_HISTORY
+                ]:
+                    del self._cursor_history[stale]
+
+    # -- source plumbing --------------------------------------------------- #
+    def _filesystem(self):
+        """The arrow filesystem of a URI source (``dataset.files`` paths are
+        relative to it — every footer/row-group read must go through it), or
+        None for plain local paths."""
+        if "://" in str(self.source):
+            from pyarrow.fs import FileSystem
+
+            filesystem, _ = FileSystem.from_uri(str(self.source))
+            return filesystem
+        return None
+
+    def _dataset(self):
         import pyarrow.dataset as ds
 
         if "://" in str(self.source):
@@ -66,9 +331,11 @@ class ParquetBatcher:
             from pyarrow.fs import FileSystem
 
             filesystem, path = FileSystem.from_uri(str(self.source))
-            dataset = ds.dataset(path, format="parquet", filesystem=filesystem)
-        else:
-            dataset = ds.dataset(self.source, format="parquet")
+            return ds.dataset(path, format="parquet", filesystem=filesystem)
+        return ds.dataset(self.source, format="parquet")
+
+    def _slabs(self):
+        dataset = self._dataset()
         names = self.columns or dataset.schema.names
         yield from dataset.to_batches(columns=names, batch_size=self.partition_size)
 
@@ -137,7 +404,135 @@ class ParquetBatcher:
                 out[name] = np.asarray(column)[order]
         return out
 
+    # -- epoch planning (row-group mode) ----------------------------------- #
+    def _group_table(self) -> List[Tuple[str, int, int, int]]:
+        """(path, group_index, num_rows, uncompressed_bytes) for every row
+        group of the source, in sorted-path order — read from parquet FOOTERS
+        only, so planning an epoch over a TB-scale dataset touches no data."""
+        import pyarrow.parquet as pq
+
+        dataset = self._dataset()
+        files = sorted(dataset.files) if getattr(dataset, "files", None) else [str(self.source)]
+        filesystem = self._filesystem()
+        table: List[Tuple[str, int, int, int]] = []
+        for path in files:
+            meta = pq.ParquetFile(path, filesystem=filesystem).metadata
+            for g in range(meta.num_row_groups):
+                group = meta.row_group(g)
+                table.append((path, g, group.num_rows, group.total_byte_size))
+        return table
+
+    def _plan(self, epoch: int):
+        """The epoch plan: THIS replica's slab sequence + the globally aligned
+        batch count. Pure function of (footer metadata, seed, epoch, replica)
+        — both sides of a preemption compute the identical plan."""
+        part = self.partitioning or Partitioning(shuffle=self.shuffle, seed=self.seed)
+        if self.shuffle and not part.shuffle:
+            part = Partitioning(part.replicas, shuffle=True, seed=self.seed)
+        groups = self._group_table()
+        replicas = part.replicas
+        if groups and len(groups) < replicas.num_replicas:
+            msg = (
+                f"shard='row_groups' needs at least one row group per replica: "
+                f"{len(groups)} group(s) for {replicas.num_replicas} replicas. "
+                "Write smaller row groups "
+                "(write_sequence_parquet(rows_per_chunk=...))."
+            )
+            raise ValueError(msg)
+        # alignment: every replica must emit the same number of batches (the
+        # collective-friendly invariant) — compute each replica's row total
+        # from the shared plan and pad the short ones with valid=False batches
+        max_batches = 0
+        for replica in range(replicas.num_replicas):
+            assigned = part.shard_items(len(groups), epoch=epoch, replica_id=replica)
+            rows = int(sum(groups[i][2] for i in assigned))
+            max_batches = max(max_batches, -(-rows // self.batch_size))
+        mine = part.shard_items(len(groups), epoch=epoch)
+        slabs: List[_Slab] = []
+        paths: List[str] = []
+        for seq, index in enumerate(mine):
+            path, g, rows, nbytes = groups[index]
+            budget = self.memory_budget_bytes
+            per_slab = rows
+            if budget and rows:
+                # sub-slab size from FOOTER byte counts: the resident working
+                # set stays bounded no matter how large a group was written
+                row_bytes = max(1, nbytes // rows)
+                per_slab = max(1, min(rows, budget // row_bytes))
+            start = 0
+            sub = 0
+            while start < rows:
+                take = min(per_slab, rows - start)
+                slabs.append(
+                    _Slab(
+                        file_index=seq,
+                        group=g,
+                        start=start,
+                        rows=take,
+                        # fold the GLOBAL group index + sub-slab into the
+                        # shuffle seed so every slab shuffles differently and
+                        # identically across runs
+                        order_seed=int(index) * 4096 + sub,
+                    )
+                )
+                paths.append(path)  # slabs and paths zip by position
+                start += take
+                sub += 1
+        return slabs, paths, max_batches
+
+    def _read_slab(self, path: str, slab: _Slab):
+        """One bounded read: the slab's row range of its row group.
+
+        Sub-slabs (a ``memory_budget_bytes`` split) stream the group through
+        ``iter_batches`` in slab-sized record batches instead of
+        materializing the whole group and slicing — the resident set stays
+        ~2× the slab no matter how large the group was written. Rows before
+        ``slab.start`` are decoded-and-dropped (parquet offers no intra-group
+        row seek), so prefer writing ``rows_per_chunk`` ≤ the budget at
+        encode time; the budget split is the safety net for datasets written
+        with oversized groups.
+        """
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        handle = pq.ParquetFile(path, filesystem=self._filesystem())
+        names = self.columns or handle.schema_arrow.names
+        full_rows = handle.metadata.row_group(slab.group).num_rows
+        if slab.start == 0 and slab.rows == full_rows:
+            return handle.read_row_group(slab.group, columns=names)
+        pieces = []
+        skipped = 0
+        collected = 0
+        for record_batch in handle.iter_batches(
+            batch_size=max(slab.rows, 1), row_groups=[slab.group], columns=names
+        ):
+            if skipped < slab.start:
+                drop = min(slab.start - skipped, record_batch.num_rows)
+                skipped += drop
+                record_batch = record_batch.slice(drop)
+                if record_batch.num_rows == 0:
+                    continue
+            take = min(slab.rows - collected, record_batch.num_rows)
+            pieces.append(record_batch.slice(0, take))
+            collected += take
+            if collected == slab.rows:
+                break
+        return pa.Table.from_batches(pieces)
+
+    def _slab_order(self, slab: _Slab, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(slab.rows, dtype=np.int64)
+        rng = np.random.default_rng((self.seed, epoch, slab.order_seed))
+        return rng.permutation(slab.rows).astype(np.int64)
+
+    # -- iteration ---------------------------------------------------------- #
     def __iter__(self) -> Iterator[Batch]:
+        if self.shard == "row_groups":
+            return self._iter_row_groups()
+        return self._iter_rows()
+
+    def _iter_rows(self) -> Iterator[Batch]:
+        """Legacy mode: every replica scans every slab, strided row split."""
         part = self.partitioning or Partitioning(shuffle=self.shuffle, seed=self.seed)
         if self.shuffle and not part.shuffle:
             part = Partitioning(part.replicas, shuffle=True, seed=self.seed)
@@ -168,22 +563,214 @@ class ParquetBatcher:
             chunk["valid"] = valid
             yield chunk
 
+    def _iter_row_groups(self) -> Iterator[Batch]:
+        """Shard-aware streaming: disjoint row-group shares per replica,
+        bounded sub-slab reads, optional read-ahead, cursor recording, and
+        valid=False alignment batches so every replica steps the same count."""
+        epoch = self.epoch
+        slabs, paths, max_batches = self._plan(epoch)
+        start_cursor, self._pending_cursor = self._pending_cursor, None
+        first_slab, skip_rows, emitted = 0, 0, 0
+        carry: Optional[Batch] = None
+        pad_spec: Optional[Dict[str, Any]] = None
+        if start_cursor is not None:
+            if start_cursor.epoch != epoch:
+                msg = (
+                    f"stream cursor is for epoch {start_cursor.epoch} but the "
+                    f"batcher is at epoch {epoch}; call set_epoch first"
+                )
+                raise ValueError(msg)
+            first_slab = start_cursor.slab
+            skip_rows = start_cursor.rows
+            emitted = start_cursor.batches
+            carry = _deserialize_carry(start_cursor.carry)
+            pad_spec = start_cursor.pad_spec
+        self._record_cursor(
+            StreamCursor(
+                epoch=epoch,
+                slab=first_slab,
+                rows=skip_rows,
+                batches=emitted,
+                carry=_serialize_carry(carry),
+                pad_spec=pad_spec,
+            )
+        )
 
-def write_sequence_parquet(path: str, sequential_dataset, extra_columns: Optional[dict] = None):
+        def reads() -> Iterator[Tuple[int, Any]]:
+            for index in range(first_slab, len(slabs)):
+                yield index, self._read_slab(paths[index], slabs[index])
+
+        source: Iterator[Tuple[int, Any]] = reads()
+        if self.read_ahead:
+            from replay_tpu.data.nn.prefetch import prefetch as _prefetch
+
+            source = _prefetch(source, depth=self.read_ahead)
+        try:
+            for index, table in source:
+                slab = slabs[index]
+                order = self._slab_order(slab, epoch)
+                block = self._materialize(table, order)
+                consumed = 0
+                if index == first_slab and skip_rows:
+                    # resume mid-slab: drop what the pre-preemption run already
+                    # emitted from this slab's deterministic order
+                    block = {k: v[skip_rows:] for k, v in block.items()}
+                    consumed = skip_rows
+                carry_before = carry
+                if carry_before is not None:
+                    stream = {
+                        k: np.concatenate([carry_before[k], block[k]]) for k in block
+                    }
+                else:
+                    stream = block
+                carry_rows = (
+                    next(iter(carry_before.values())).shape[0] if carry_before else 0
+                )
+                n = next(iter(stream.values())).shape[0] if stream else 0
+                full_end = (n // self.batch_size) * self.batch_size
+                for start in range(0, full_end, self.batch_size):
+                    chunk = {
+                        k: v[start : start + self.batch_size] for k, v in stream.items()
+                    }
+                    chunk["valid"] = np.ones(self.batch_size, bool)
+                    if pad_spec is None:
+                        pad_spec = _batch_spec(chunk)
+                    emitted += 1
+                    # position after this batch: rows of THIS slab consumed =
+                    # batch end minus what the (< batch_size) carry contributed
+                    # — a batch boundary can never land INSIDE the carry.
+                    # pad_spec rides EVERY cursor so a resume that finds no
+                    # real batches left can still build the alignment tail.
+                    self._record_cursor(
+                        StreamCursor(
+                            epoch=epoch,
+                            slab=index,
+                            rows=consumed + start + self.batch_size - carry_rows,
+                            batches=emitted,
+                            pad_spec=pad_spec,
+                        )
+                    )
+                    yield chunk
+                carry = (
+                    {k: v[full_end:] for k, v in stream.items()} if full_end < n else None
+                )
+                # boundary state entering the next slab: resume skips this
+                # slab entirely instead of re-reading and dropping all of it
+                self._record_cursor(
+                    StreamCursor(
+                        epoch=epoch,
+                        slab=index + 1,
+                        rows=0,
+                        batches=emitted,
+                        carry=_serialize_carry(carry),
+                        pad_spec=pad_spec,
+                    )
+                )
+        finally:
+            if hasattr(source, "close"):
+                source.close()
+        if carry is not None:
+            n = next(iter(carry.values())).shape[0]
+            pad = self.batch_size - n
+            chunk = {
+                k: np.concatenate([v, np.repeat(v[:1], pad, axis=0)])
+                for k, v in carry.items()
+            }
+            valid = np.zeros(self.batch_size, bool)
+            valid[:n] = True
+            chunk["valid"] = valid
+            if pad_spec is None:
+                pad_spec = _batch_spec(chunk)
+            emitted += 1
+            self._record_cursor(
+                StreamCursor(
+                    epoch=epoch, slab=len(slabs), rows=0, batches=emitted,
+                    pad_spec=pad_spec,
+                )
+            )
+            yield chunk
+        # alignment batches: replicas whose round-robin share came up short
+        # emit fully-masked zero batches so all hosts take the same step count
+        # (deterministic from the shape spec alone — a resumed run landing
+        # here rebuilds them bit-for-bit from the cursor's pad_spec)
+        while emitted < max_batches:
+            if pad_spec is None:
+                msg = (
+                    "row-group shard produced no batches for this replica but "
+                    f"{max_batches} are needed for step alignment; the dataset "
+                    "is too small for this replica count"
+                )
+                raise ValueError(msg)
+            chunk = _zero_batch(pad_spec, self.batch_size)
+            emitted += 1
+            self._record_cursor(
+                StreamCursor(
+                    epoch=epoch, slab=len(slabs), rows=0, batches=emitted,
+                    pad_spec=pad_spec,
+                )
+            )
+            yield chunk
+
+
+def write_sequence_parquet(
+    path: str,
+    sequential_dataset,
+    extra_columns: Optional[dict] = None,
+    rows_per_chunk: int = 4096,
+):
     """SequentialDataset → parquet with list columns (the encode-once step that
-    feeds ParquetBatcher; ref: tokenizer output written for the parquet path)."""
+    feeds ParquetBatcher; ref: tokenizer output written for the parquet path).
+
+    Streams ``rows_per_chunk``-row tables through ``pyarrow.parquet.
+    ParquetWriter`` instead of materializing the whole dataset as python
+    lists, so the encode step itself is out-of-core; each chunk lands as one
+    row group, which is exactly the granularity ``shard="row_groups"``
+    deals out to replicas and ``StreamCursor`` seeks over.
+    """
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    frame = {}
+    if rows_per_chunk < 1:
+        msg = "rows_per_chunk must be >= 1"
+        raise ValueError(msg)
     schema = sequential_dataset.schema
-    frame[sequential_dataset.query_id_column] = sequential_dataset.query_ids.tolist()
-    for name in schema:
-        values = [
-            np.asarray(sequential_dataset.get_sequence(i, name)).tolist()
-            for i in range(len(sequential_dataset))
-        ]
-        frame[name] = values
-    for name, values in (extra_columns or {}).items():
-        frame[name] = list(values)
-    pq.write_table(pa.table(frame), path)
+    names = list(schema)
+    extra = {name: list(values) for name, values in (extra_columns or {}).items()}
+    total = len(sequential_dataset)
+    for name, values in extra.items():
+        if len(values) != total:
+            msg = (
+                f"extra column '{name}' has {len(values)} values for "
+                f"{total} dataset rows"
+            )
+            raise ValueError(msg)
+    writer: Optional[pq.ParquetWriter] = None
+    try:
+        for start in range(0, total, rows_per_chunk):
+            stop = min(start + rows_per_chunk, total)
+            frame: Dict[str, Any] = {
+                sequential_dataset.query_id_column: [
+                    sequential_dataset.get_query_id(i) for i in range(start, stop)
+                ]
+            }
+            for name in names:
+                frame[name] = [
+                    np.asarray(sequential_dataset.get_sequence(i, name)).tolist()
+                    for i in range(start, stop)
+                ]
+            for name, values in extra.items():
+                frame[name] = values[start:stop]
+            table = pa.table(frame)
+            if writer is None:
+                writer = pq.ParquetWriter(path, table.schema)
+            writer.write_table(table, row_group_size=rows_per_chunk)
+        if writer is None:  # empty dataset: still leave a valid (0-row) file
+            frame = {sequential_dataset.query_id_column: []}
+            for name in names:
+                frame[name] = pa.array([], pa.list_(pa.int64()))
+            table = pa.table(frame)
+            writer = pq.ParquetWriter(path, table.schema)
+            writer.write_table(table)
+    finally:
+        if writer is not None:
+            writer.close()

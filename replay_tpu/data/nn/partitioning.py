@@ -16,6 +16,7 @@ distributed runtime (the reference's FakeReplicasInfo trick, SURVEY.md §4).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 
@@ -86,3 +87,34 @@ class Partitioning:
 
     def generate(self, n: int, epoch: int = 0) -> np.ndarray:
         return self.replica_indices(self.generate_raw_indices(n, epoch))
+
+    # -- container (row-group) sharding ---------------------------------- #
+    def shard_items(
+        self, n: int, epoch: int = 0, replica_id: Optional[int] = None
+    ) -> np.ndarray:
+        """Deterministic round-robin share of ``n`` indivisible CONTAINERS
+        (parquet row groups) for one replica — the shard-aware streaming seam.
+
+        Unlike :meth:`generate`, there is NO wrap-around padding: containers
+        hold many rows each, so duplicating one to even out the division would
+        re-read (and re-train on) real data. The union over replicas covers
+        every container exactly once per epoch; the per-replica row counts may
+        differ by up to one container, and the streaming batcher restores the
+        equal-step-count collective invariant downstream with fully-masked
+        alignment batches (``valid`` all False).
+
+        The shuffled order folds ``epoch`` into the seed exactly like
+        :meth:`generate_raw_indices`, so each epoch deals the containers out
+        in a fresh order while two same-epoch calls are bit-identical.
+        """
+        if n == 0:
+            return np.zeros(0, dtype=np.int64)
+        order = np.arange(n, dtype=np.int64)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, epoch, 0x9E3779B9))
+            order = order[rng.permutation(n)]
+        replica = self.replicas.replica_id if replica_id is None else replica_id
+        if not 0 <= replica < self.replicas.num_replicas:
+            msg = f"replica_id {replica} out of range [0, {self.replicas.num_replicas})"
+            raise ValueError(msg)
+        return order[replica :: self.replicas.num_replicas]

@@ -69,12 +69,42 @@ class DefaultAttentionMask:
         )
 
 
+def segment_attention_mask(
+    padding_mask: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    causal: bool = True,
+    deterministic: bool = False,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Additive mask [B, 1, L, L] for PACKED rows: (causal ∧) key-padding ∧
+    same-segment.
+
+    ``segment_ids`` is 0 on padding and 1..k per packed sequence
+    (:class:`~replay_tpu.data.nn.PackedSequenceBatcher`). Attention is
+    restricted to keys of the SAME segment, so co-packed sequences are
+    mutually invisible — block-diagonal within the causal triangle. The
+    diagonal rescue keeps fully-masked (padding) rows finite, exactly like
+    the unpacked masks; padded positions carry segment 0 and attend only to
+    themselves.
+    """
+    batch, length = padding_mask.shape
+    same = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B, Lq, Lk]
+    allowed = same & padding_mask[:, None, :] & (segment_ids != 0)[:, :, None]
+    if causal:
+        allowed = allowed & jnp.tril(jnp.ones((length, length), dtype=bool))[None]
+    eye = jnp.eye(length, dtype=bool)[None]
+    allowed = allowed | eye
+    neg = jnp.array(float("-inf") if not deterministic else jnp.finfo(dtype).min, dtype=dtype)
+    return jnp.where(allowed, jnp.zeros((), dtype=dtype), neg)[:, None, :, :]
+
+
 def attention_mask_for_route(
     use_flash,
     padding_mask: jnp.ndarray,
     causal: bool = True,
     deterministic: bool = False,
     dtype=jnp.float32,
+    segment_ids: jnp.ndarray = None,
 ):
     """The additive mask a model body should hand its encoder, route-aware.
 
@@ -84,7 +114,24 @@ def attention_mask_for_route(
     Every other route gets the standard causal or bidirectional additive mask.
     One source of truth for the conditional shared by SasRec / Bert4Rec /
     TwoTower bodies.
+
+    ``segment_ids`` (packed batches) adds the same-segment constraint via
+    :func:`segment_attention_mask`. The flash kernels rebuild their masks
+    in-kernel from (causal, padding) alone and would silently attend across
+    packed segments — that combination is rejected, not degraded.
     """
+    if segment_ids is not None:
+        if use_flash:
+            msg = (
+                "packed batches (segment_ids) need the additive segment mask, "
+                "which the flash kernels cannot honor — run packing with "
+                "use_flash=False, or drop the packing for flash routes"
+            )
+            raise ValueError(msg)
+        return segment_attention_mask(
+            padding_mask, segment_ids, causal=causal,
+            deterministic=deterministic, dtype=dtype,
+        )
     if use_flash == "tiled":
         return None
     builder = causal_attention_mask if causal else bidirectional_attention_mask

@@ -88,6 +88,7 @@ class Bert4RecBody(nn.Module):
         padding_mask: jnp.ndarray,  # [B, L] bool
         token_mask: Optional[jnp.ndarray] = None,  # [B, L] (or [B, L, 1]) bool, True=visible
         deterministic: bool = True,
+        segment_ids: Optional[jnp.ndarray] = None,  # [B, L] int, packed batches
     ) -> jnp.ndarray:
         embeddings = self.embedder(feature_tensors)
         total = sum(embeddings[name] for name in sorted(embeddings))
@@ -110,9 +111,12 @@ class Bert4RecBody(nn.Module):
         x = self.input_dropout(self.input_norm(x), deterministic=deterministic)
         # model-health stage stats (no-op unless `intermediates` is mutable)
         sow_stage_stats(self, "embed", x)
+        # packed rows (segment_ids) get the block-diagonal bidirectional
+        # mask: attention never crosses a packed segment boundary
         attention_mask = attention_mask_for_route(
             self.use_flash, padding_mask, causal=False,
             deterministic=deterministic, dtype=self.dtype,
+            segment_ids=segment_ids,
         )
         for _ in range(self.num_passes_over_block):
             x = self.encoder(
@@ -200,10 +204,13 @@ class Bert4Rec(nn.Module):
         padding_mask: jnp.ndarray,
         token_mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
+        segment_ids: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
-        """Hidden states [B, L, E]; masked positions are the MLM prediction sites."""
+        """Hidden states [B, L, E]; masked positions are the MLM prediction sites.
+        ``segment_ids`` (packed batches) makes attention block-diagonal."""
         return self.body(
-            feature_tensors, padding_mask, token_mask=token_mask, deterministic=deterministic
+            feature_tensors, padding_mask, token_mask=token_mask,
+            deterministic=deterministic, segment_ids=segment_ids,
         )
 
     def get_logits(

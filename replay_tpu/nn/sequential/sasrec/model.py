@@ -100,6 +100,7 @@ class SasRecBody(nn.Module):
         feature_tensors: TensorMap,
         padding_mask: jnp.ndarray,  # [B, L] bool
         deterministic: bool = True,
+        segment_ids: Optional[jnp.ndarray] = None,  # [B, L] int, packed batches
     ) -> jnp.ndarray:
         # named scopes label the HLO per stage so device profiles line up with
         # the host-side Tracer spans (obs.trace) by name; sow_stage_stats only
@@ -109,9 +110,13 @@ class SasRecBody(nn.Module):
             x = self.aggregator(embeddings, deterministic=deterministic)
             sow_stage_stats(self, "embed", x)
         with jax.named_scope("encoder"):
+            # packed rows (segment_ids from PackedSequenceBatcher) get the
+            # block-diagonal causal mask: attention never crosses a packed
+            # segment boundary (docs/performance.md "Feeding the beast")
             attention_mask = attention_mask_for_route(
                 self.use_flash, padding_mask, causal=True,
                 deterministic=deterministic, dtype=self.dtype,
+                segment_ids=segment_ids,
             )
             x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
         with jax.named_scope("final_norm"):
@@ -198,9 +203,14 @@ class SasRec(nn.Module):
         feature_tensors: TensorMap,
         padding_mask: jnp.ndarray,
         deterministic: bool = True,
+        segment_ids: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
-        """Hidden states [B, L, E] (the training forward)."""
-        return self.body(feature_tensors, padding_mask, deterministic=deterministic)
+        """Hidden states [B, L, E] (the training forward). ``segment_ids``
+        (packed batches) makes attention block-diagonal per packed sequence."""
+        return self.body(
+            feature_tensors, padding_mask, deterministic=deterministic,
+            segment_ids=segment_ids,
+        )
 
     def get_logits(
         self, hidden: jnp.ndarray, candidates_to_score: Optional[jnp.ndarray] = None

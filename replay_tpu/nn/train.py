@@ -754,6 +754,21 @@ class Trainer:
         # yields the ONE sanctioned extra compiled variant with an auxiliary
         # `health` pytree of device scalars in the metrics (obs.health).
         def train_step(state: TrainState, batch: Batch):
+            if "segment_ids" in batch and "segment_ids" not in self._forward_params:
+                # packed batches on a model whose forward cannot take the
+                # segment mask: signature filtering would silently DROP the
+                # key and attention/loss would cross packed-sequence
+                # boundaries — reject (trace-time python check, free at run
+                # time), exactly like the flash-route refusal in nn.mask
+                msg = (
+                    f"batch carries 'segment_ids' (packed sequences) but "
+                    f"{type(model).__name__}.__call__ accepts no segment_ids "
+                    "parameter — training would silently attend and compute "
+                    "loss across packed segment boundaries. Use an unpacked "
+                    "batcher for this model, or plumb segment_ids through "
+                    "its attention path (nn.mask.segment_attention_mask)."
+                )
+                raise ValueError(msg)
             rng, dropout_rng, loss_rng = jax.random.split(state.rng, 3)
             # batch-padding rows (fixed-shape final batch) get zero loss weight:
             # gate the target mask by the `valid` row flags from the batcher
@@ -1333,6 +1348,7 @@ class Trainer:
 
         start_epoch, skip_steps, pending_restore_step = 0, 0, None
         resumed_best_step = None
+        pending_stream_cursor = None  # out-of-core resume: seek, don't rescan
         if resume:
             if checkpoint_manager is None:
                 msg = "resume=True needs a checkpoint_manager"
@@ -1350,6 +1366,11 @@ class Trainer:
                 if meta.get("mid_epoch"):
                     start_epoch = int(meta["epoch"])
                     skip_steps = int(meta["step_in_epoch"])
+                    # a streaming batcher's resumable position (the PR-2
+                    # preemption contract extended to out-of-core runs):
+                    # restore_cursor SEEKS to the exact mid-epoch state
+                    # instead of re-reading and discarding skip_steps batches
+                    pending_stream_cursor = meta.get("stream_cursor")
                 elif "epoch" in meta:
                     start_epoch = int(meta["epoch"]) + 1
                 else:
@@ -1638,6 +1659,21 @@ class Trainer:
             extra: Dict[str, Any] = {"preempted": True} if preempted else {}
             if self._lr_scale != 1.0:  # recovery backoff survives the resume
                 extra["lr_scale"] = self._lr_scale
+            if cursor_source is not None:
+                # the streaming batcher's exact position after n_steps batches
+                # rides the sidecar, so resume SEEKS instead of rescanning;
+                # cursors are recorded at produce time, so a prefetch/device-
+                # feed stage reading ahead cannot outrun this lookup
+                try:
+                    extra["stream_cursor"] = cursor_source.cursor_for(
+                        n_steps
+                    ).to_metadata()
+                except KeyError as exc:
+                    logger.warning(
+                        "stream cursor unavailable at step %d (%s); resume "
+                        "will fall back to fast-forwarding the stream",
+                        n_steps, exc,
+                    )
             with span("checkpoint"):
                 checkpoint_manager.save(
                     int(state.step),
@@ -1691,6 +1727,11 @@ class Trainer:
                 payload["peak_memory_samples"] = memory.observed_samples
             if state is not None:  # sentinel-skipped updates over the run
                 payload["bad_steps"] = int(state.bad_steps)
+            input_record = input_summary()
+            if input_record is not None:
+                # cumulative feed efficiency: real vs grid tokens and the
+                # steady effective-tokens/s (report renders, --compare gates)
+                payload["input"] = input_record
             if tracing:
                 # mirror the span layer into the event stream: whole-fit
                 # goodput + THIS fit's per-span totals ride the terminal event
@@ -1745,18 +1786,68 @@ class Trainer:
         measured_total = 0  # steps actually executed by THIS fit call
         last_emitted_at = 0
         step_base = None  # int(state.step) fetched once; then tracked on host
+        # effective-token accounting (docs/performance.md "Feeding the
+        # beast"): real (non-padding, valid-row) vs grid tokens fed to the
+        # device — the padding-waste number sequence packing exists to move
+        tokens_real_total = 0
+        tokens_grid_total = 0
+        tick_tokens_real = 0
+        tick_tokens_grid = 0
+
+        def count_tokens(batch: Batch) -> None:
+            nonlocal tokens_real_total, tokens_grid_total
+            mask = batch.get(self.padding_mask_field)
+            if mask is None or getattr(mask, "ndim", 0) != 2:
+                return
+            mask = np.asarray(mask)
+            valid = batch.get("valid")
+            if valid is not None:
+                real = int(mask[np.asarray(valid)].sum())
+            else:
+                real = int(mask.sum())
+            tokens_real_total += real
+            tokens_grid_total += mask.size
+
+        def input_summary() -> Optional[Dict[str, float]]:
+            if not tokens_grid_total:
+                return None
+            steady = telemetry.summary()
+            steps_per_sec = steady.get("steps_per_sec")
+            tokens_per_step = tokens_real_total / max(measured_total, 1)
+            effective = (
+                tokens_per_step * steps_per_sec
+                if steps_per_sec is not None and math.isfinite(steps_per_sec)
+                else float("nan")
+            )
+            return {
+                "tokens_real": tokens_real_total,
+                "tokens_grid": tokens_grid_total,
+                "padding_fraction": 1.0 - tokens_real_total / tokens_grid_total,
+                "effective_tokens_per_sec": effective,
+            }
 
         def telemetry_tick(batch: Batch) -> Dict[str, float]:
             """Fold the steps since the last tick into the telemetry window
             (shared by the per-step emit path and the epoch-tail flush)."""
-            nonlocal last_emitted_at
+            nonlocal last_emitted_at, tick_tokens_real, tick_tokens_grid
             delta = measured_total - last_emitted_at
             last_emitted_at = measured_total
             reference = batch.get(self.padding_mask_field)
             rows = (
                 int(np.asarray(reference).shape[0]) if reference is not None else None
             )
-            return telemetry.tick(samples=rows * delta if rows else None, steps=delta)
+            tick = telemetry.tick(samples=rows * delta if rows else None, steps=delta)
+            window_real = tokens_real_total - tick_tokens_real
+            window_grid = tokens_grid_total - tick_tokens_grid
+            tick_tokens_real, tick_tokens_grid = tokens_real_total, tokens_grid_total
+            nan = float("nan")
+            tick["padding_fraction"] = (
+                1.0 - window_real / window_grid if window_grid else nan
+            )
+            tick["effective_tokens_per_sec"] = (
+                window_real / delta * tick["steps_per_sec"] if delta else nan
+            )
+            return tick
 
         if pending_restore_step is not None and start_epoch >= epochs:
             # run already complete: restore the checkpoint and return it instead
@@ -1824,6 +1915,7 @@ class Trainer:
             epoch_good = good_flag if epoch_good is None else epoch_good + good_flag
             n_steps += 1
             measured_total += 1
+            count_tokens(batch)
             last_grad_norm = step_metrics["grad_norm"]
             if (
                 health_cfg is not None
@@ -1905,6 +1997,10 @@ class Trainer:
                     samples_per_sec=tick["samples_per_sec"],
                     steps_per_sec=tick["steps_per_sec"],
                     step_seconds=tick["step_seconds"],
+                    # padding-waste telemetry: the feed-efficiency numbers
+                    # packing/bucketing exist to move (obs gauges + SLOs)
+                    effective_tokens_per_sec=tick["effective_tokens_per_sec"],
+                    padding_fraction=tick["padding_fraction"],
                     # a health record fetched since the last emission
                     # rides the next step event (cadences may differ)
                     **({"health": pending_health} if pending_health is not None else {}),
@@ -1913,6 +2009,7 @@ class Trainer:
             return rolled_back
 
         stopped_early = False
+        cursor_source = None  # the current epoch's resumable batch source
         # the per-epoch goodput window: opens here and RE-opens right after
         # each on_epoch_end, so the inter-epoch tail (the end-of-epoch
         # checkpoint save, best tracking) lands in the NEXT epoch's window —
@@ -1933,6 +2030,30 @@ class Trainer:
                 epoch_needs_mark = True  # re-mark per epoch: discounts the
                 # inter-epoch validation/checkpoint gap from the telemetry window
                 epoch_batches = batches_for(epoch)
+                cursor_source = (
+                    epoch_batches
+                    if getattr(epoch_batches, "supports_cursor", False)
+                    else None
+                )
+                if (
+                    pending_stream_cursor is not None
+                    and epoch == start_epoch
+                    and cursor_source is not None
+                ):
+                    recorded = int(pending_stream_cursor.get("batches", -1))
+                    if recorded == skip_steps:
+                        # seek: the batcher resumes mid-epoch bit-for-bit
+                        # without re-reading the skipped slabs
+                        cursor_source.restore_cursor(pending_stream_cursor)
+                        skipped = skip_steps  # nothing left to consume-and-drop
+                        n_steps = skip_steps
+                    else:
+                        logger.warning(
+                            "stream cursor records %d batches but the "
+                            "checkpoint position is %d; falling back to "
+                            "fast-forward", recorded, skip_steps,
+                        )
+                    pending_stream_cursor = None
                 if scan_chunk:
                     # a factory callable hid its batcher from the fit-start
                     # check: reject what it actually returned, before any
@@ -2277,6 +2398,9 @@ class Trainer:
                     # the last executed step's global grad norm (one scalar
                     # sync per epoch; non-finite serializes as JSON null)
                     epoch_payload["grad_norm"] = float(last_grad_norm)
+                input_record = input_summary()
+                if input_record is not None:  # cumulative feed efficiency
+                    epoch_payload["input"] = input_record
                 if health_cfg is not None and self.last_health is not None:
                     epoch_payload["health"] = self.last_health
                 if tracing:

@@ -19,6 +19,7 @@ from .transforms import (
     InBatchNegativeSamplingTransform,
     NextTokenTransform,
     RenameTransform,
+    SegmentBoundaryMaskTransform,
     TokenMaskTransform,
     Transform,
     UnsqueezeTransform,
@@ -32,6 +33,40 @@ def make_default_sasrec_transforms(tensor_schema: TensorSchema) -> Dict[str, Lis
     train = [
         NextTokenTransform(label_name=item_id, shift=1, apply_to=sequential),
         RenameTransform({f"{item_id}_mask": "padding_mask", "positive_labels_mask": "target_padding_mask"}),
+        UnsqueezeTransform("target_padding_mask", -1),
+        UnsqueezeTransform("positive_labels", -1),
+        GroupTransform({"feature_tensors": list(tensor_schema.names)}),
+    ]
+    eval_pipeline = [
+        RenameTransform({f"{item_id}_mask": "padding_mask"}),
+        GroupTransform({"feature_tensors": list(tensor_schema.names)}),
+    ]
+    return {
+        "train": train,
+        "validate": list(eval_pipeline),
+        "test": list(eval_pipeline),
+        "predict": list(eval_pipeline),
+    }
+
+
+def make_packed_sasrec_transforms(tensor_schema: TensorSchema) -> Dict[str, List[Transform]]:
+    """Next-token pipelines for PACKED batches (PackedSequenceBatcher output).
+
+    Identical to the SASRec template plus the packing fixups: labels that
+    would cross a packed segment boundary are masked out of
+    ``target_padding_mask``, and ``segment_ids`` is trimmed to the input
+    length and left TOP-LEVEL in the batch (outside ``feature_tensors``) so
+    the trainer's signature filtering hands it to the model's attention path
+    (docs/performance.md "Feeding the beast").
+    """
+    item_id = tensor_schema.item_id_feature_name
+    sequential = [f.name for f in tensor_schema.all_features if f.is_seq]
+    train = [
+        NextTokenTransform(label_name=item_id, shift=1, apply_to=sequential),
+        RenameTransform({f"{item_id}_mask": "padding_mask", "positive_labels_mask": "target_padding_mask"}),
+        # order matters: runs on the FULL-length segment ids (NextToken left
+        # them untrimmed), masks boundary labels, then input-aligns them
+        SegmentBoundaryMaskTransform(segment_name="segment_ids", mask_name="target_padding_mask", shift=1),
         UnsqueezeTransform("target_padding_mask", -1),
         UnsqueezeTransform("positive_labels", -1),
         GroupTransform({"feature_tensors": list(tensor_schema.names)}),

@@ -229,6 +229,56 @@ class InBatchNegativeSamplingTransform(Transform):
         return {**batch, self.out_feature_name: labels}
 
 
+class SegmentBoundaryMaskTransform(Transform):
+    """Packed-batch fixup after :class:`NextTokenTransform`: mask labels that
+    cross a segment boundary, and trim ``segment_ids`` to the input length.
+
+    A packed row concatenates several user sequences (segment ids 1..k, 0 on
+    padding). The next-token shift assigns position ``t`` the label at
+    original position ``t + shift`` — at the last positions of a segment that
+    label belongs to the NEXT user's sequence. This transform ANDs the target
+    mask with "label position is in the SAME (non-padding) segment as the
+    input position", so the loss never trains across a packed boundary, then
+    replaces the full-length ``segment_ids`` with its input-aligned
+    ``[:, :-shift]`` view (what the model's attention mask consumes).
+    Run it after the rename that produced ``mask_name`` and before the
+    unsqueeze/group steps.
+    """
+
+    def __init__(
+        self,
+        segment_name: str = "segment_ids",
+        mask_name: str = "target_padding_mask",
+        shift: int = 1,
+    ) -> None:
+        if shift < 1:
+            msg = "shift must be >= 1 (the NextTokenTransform shift)"
+            raise ValueError(msg)
+        self.segment_name = segment_name
+        self.mask_name = mask_name
+        self.shift = shift
+
+    def __call__(self, batch: Batch, rng=None) -> Batch:
+        segments = batch[self.segment_name]
+        shift = self.shift
+        if segments.shape[-1] == batch[self.mask_name].shape[1]:
+            msg = (
+                f"'{self.segment_name}' is already trimmed to the label "
+                f"length; run {type(self).__name__} on the FULL-length "
+                "segment ids (before any trim), after NextTokenTransform "
+                f"excluded '{self.segment_name}' from apply_to."
+            )
+            raise ValueError(msg)
+        inputs = segments[:, :-shift]
+        labels_seg = segments[:, shift:]
+        same_segment = (inputs == labels_seg) & (labels_seg != 0) & (inputs != 0)
+        return {
+            **batch,
+            self.mask_name: batch[self.mask_name] & same_segment,
+            self.segment_name: inputs,
+        }
+
+
 class TokenMaskTransform(Transform):
     """BERT-style keep-mask: True = visible token, False = masked-out token.
 

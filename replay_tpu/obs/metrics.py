@@ -437,6 +437,12 @@ class MetricsLogger(RunLogger):
             self._gauge("replay_train_lr", payload.get("lr"))
             self._gauge("replay_train_samples_per_sec", payload.get("samples_per_sec"))
             self._gauge("replay_train_steps_per_sec", payload.get("steps_per_sec"))
+            # feed efficiency (sequence packing / padding waste): the SLO-able
+            # companions to replay_input_starvation
+            self._gauge(
+                "replay_effective_tokens_per_sec", payload.get("effective_tokens_per_sec")
+            )
+            self._gauge("replay_padding_fraction", payload.get("padding_fraction"))
             step_seconds = _finite(payload.get("step_seconds"))
             if step_seconds is not None:
                 self.registry.observe(
@@ -469,6 +475,15 @@ class MetricsLogger(RunLogger):
             health = payload.get("health")
             if isinstance(health, Mapping):
                 self._bridge_health(health)
+            input_record = payload.get("input")
+            if isinstance(input_record, Mapping):
+                self._gauge(
+                    "replay_effective_tokens_per_sec",
+                    input_record.get("effective_tokens_per_sec"),
+                )
+                self._gauge(
+                    "replay_padding_fraction", input_record.get("padding_fraction")
+                )
         elif name == "on_fit_start":
             self.registry.set("replay_train_up", 1.0)
         elif name == "on_fit_end":
@@ -481,6 +496,15 @@ class MetricsLogger(RunLogger):
                 self._gauge(
                     "replay_train_samples_per_sec_steady",
                     telemetry.get("samples_per_sec"),
+                )
+            input_record = payload.get("input")
+            if isinstance(input_record, Mapping):
+                self._gauge(
+                    "replay_effective_tokens_per_sec",
+                    input_record.get("effective_tokens_per_sec"),
+                )
+                self._gauge(
+                    "replay_padding_fraction", input_record.get("padding_fraction")
                 )
             self.registry.set("replay_train_up", 0.0)
         elif name == "on_serve_start":

@@ -418,6 +418,17 @@ def summarize_events(
                 break
     summary["goodput"] = goodput
 
+    # feed efficiency (docs/performance.md "Feeding the beast"): real vs grid
+    # tokens + effective-tokens/s, attached by fit to epoch/fit-end events
+    summary["input"] = next(
+        (
+            dict(e["input"])
+            for e in reversed(list(events))
+            if isinstance(e.get("input"), Mapping)
+        ),
+        None,
+    )
+
     if bench:
         record = bench[-1]
         summary["bench"] = {
@@ -451,6 +462,10 @@ def summarize_events(
                 "row", "samples_per_sec", "step_ms", "scan_k", "mfu",
                 "mfu_peak_assumed", "tflops_per_sec", "num_items", "d", "B",
                 "L", "loss", "precision", "model_parallel", "backend", "error",
+                # streaming-input rows (stream_{inmem,parquet,packed}): the
+                # padding-waste and feed-efficiency measurements
+                "effective_tokens_per_sec", "padding_fraction",
+                "segments_per_row", "rows_on_disk", "shard",
                 # static program analyses (obs.roofline / parallel.introspect)
                 "roofline_bound", "roofline_ceiling_tflops",
                 "of_roofline_ceiling", "arithmetic_intensity",
@@ -772,6 +787,21 @@ def render(summary: Mapping[str, Any]) -> str:
                 f"{overlapped:.2f}s overlapped on the device feed, "
                 f"{in_loop:.2f}s in the fit loop"
             )
+    input_record = summary.get("input")
+    if input_record:
+        parts = []
+        padding = _finite(input_record.get("padding_fraction"))
+        if padding is not None:
+            parts.append(f"padding {100.0 * padding:.1f}%")
+        effective = _finite(input_record.get("effective_tokens_per_sec"))
+        if effective is not None:
+            parts.append(f"effective tokens/s {effective:,.0f}")
+        tokens_real = _finite(input_record.get("tokens_real"))
+        tokens_grid = _finite(input_record.get("tokens_grid"))
+        if tokens_real is not None and tokens_grid is not None:
+            parts.append(f"tokens {tokens_real:,.0f}/{tokens_grid:,.0f}")
+        if parts:
+            lines.append("  input feed: " + " · ".join(parts))
     trace = summary.get("trace")
     if trace:
         top = sorted(trace.items(), key=lambda kv: -kv[1]["seconds"])[:8]
@@ -920,6 +950,15 @@ def render(summary: Mapping[str, Any]) -> str:
             collective = _finite(row.get("collective_bytes"))
             if collective:
                 parts.append(f"coll {collective / 1e6:.2f} MB")
+            effective = _finite(row.get("effective_tokens_per_sec"))
+            if effective is not None:
+                parts.append(f"eff tokens/s {effective:,.0f}")
+            padding = _finite(row.get("padding_fraction"))
+            if padding is not None:
+                parts.append(f"padding {100.0 * padding:.1f}%")
+            segments = _finite(row.get("segments_per_row"))
+            if segments is not None:
+                parts.append(f"{segments:.2f} seg/row")
             lines.append(f"    {row.get('row')}: " + " · ".join(parts))
     precision_pairs = summary.get("precision_pairs")
     if precision_pairs:
@@ -1177,6 +1216,18 @@ def compare_runs(
             _finite(cand_row.get("samples_per_sec")),
             _finite(base_row.get("samples_per_sec")),
         )
+        if (
+            _finite(cand_row.get("effective_tokens_per_sec")) is not None
+            and _finite(base_row.get("effective_tokens_per_sec")) is not None
+        ):
+            # the streaming-input rows' REAL-token rate: padding-waste
+            # regressions (a packing change that re-inflates the grid) fail
+            # here even when samples/sec holds
+            check(
+                f"bench_row[{name}].effective_tokens_per_sec",
+                _finite(cand_row.get("effective_tokens_per_sec")),
+                _finite(base_row.get("effective_tokens_per_sec")),
+            )
         if name.startswith("prec_"):
             # the precision-ladder rows exist to MOVE bytes: a regression
             # that only grows hbm_peak_bytes (throughput held) must still
@@ -1187,6 +1238,31 @@ def compare_runs(
                 _finite(base_row.get("hbm_peak_bytes")),
                 memory_threshold,
             )
+    # sequence-packing invariant, gated on the CANDIDATE alone: when a run
+    # carries both the packed and unpacked streaming rows, packed must beat
+    # unpacked on effective tokens/s — packing that stops paying for itself
+    # is a regression regardless of what the baseline run measured
+    unpacked_row = cand_rows.get("stream_parquet") or cand_rows.get("stream_inmem")
+    packed_row = cand_rows.get("stream_packed")
+    if (
+        packed_row is not None
+        and unpacked_row is not None
+        and not packed_row.get("error")
+        and not unpacked_row.get("error")
+    ):
+        packed_rate = _finite(packed_row.get("effective_tokens_per_sec"))
+        unpacked_rate = _finite(unpacked_row.get("effective_tokens_per_sec"))
+        if packed_rate is not None and unpacked_rate is not None:
+            lines.append(
+                "  packing: stream_packed effective tokens/s "
+                f"{packed_rate:.0f} vs {unpacked_row.get('row')} {unpacked_rate:.0f}"
+            )
+            if packed_rate < unpacked_rate:
+                regressions.append(
+                    "stream_packed effective_tokens_per_sec "
+                    f"({packed_rate:.0f}) fell below the unpacked "
+                    f"{unpacked_row.get('row')} baseline ({unpacked_rate:.0f})"
+                )
     # anomaly-count gates: a run that skips more steps (or warns more) than
     # its baseline regressed in stability even when throughput held
     for name, label in (

@@ -1,0 +1,174 @@
+"""Sequence packing: first-fit bin packing + the packed batcher's contract."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import FeatureHint, FeatureType
+from replay_tpu.data.nn import (
+    PackedSequenceBatcher,
+    SequenceBatcher,
+    SequentialDataset,
+    TensorFeatureInfo,
+    TensorSchema,
+    first_fit_pack,
+)
+from replay_tpu.data.nn.packing import bucketed_length
+
+
+class TestFirstFitPack:
+    def test_capacity_respected(self):
+        lengths = [3, 4, 5, 2, 6, 1]
+        rows = first_fit_pack(lengths, 8)
+        for members in rows:
+            assert sum(lengths[i] for i in members) <= 8
+        assert sorted(i for members in rows for i in members) == list(range(6))
+
+    def test_first_fit_is_deterministic_and_orders_by_arrival(self):
+        assert first_fit_pack([3, 4, 5, 2, 6, 1], 8) == first_fit_pack(
+            [3, 4, 5, 2, 6, 1], 8
+        )
+        # 3 then 4 share a bin (3+4<=8, free 1); 5 opens the second; 2 rides
+        # with 5 (first bin's free slot is too small)
+        assert first_fit_pack([3, 4, 5, 2], 8) == [[0, 1], [2, 3]]
+
+    def test_bucket_boundaries_round_slots_up(self):
+        assert bucketed_length(3, 8, [4]) == 4
+        assert bucketed_length(5, 8, [4]) == 8
+        assert bucketed_length(9, 8, [4]) == 8  # clamped to capacity
+        assert bucketed_length(3, 8, None) == 3
+        # bucketed: 3 and 4 both cost a 4-slot; two fit per 8-row
+        rows = first_fit_pack([3, 4, 3, 4], 8, bucket_boundaries=[4])
+        assert all(len(members) == 2 for members in rows)
+
+    def test_open_rows_bounds_the_window(self):
+        # every entry fills a row; with open_rows=1 bins close in order
+        rows = first_fit_pack([7, 7, 7, 2], 8, open_rows=1)
+        assert sorted(i for members in rows for i in members) == list(range(4))
+
+    def test_oversized_entries_clamp_to_capacity(self):
+        rows = first_fit_pack([20, 1], 4)
+        assert rows == [[0], [1]]
+
+    def test_bad_capacity(self):
+        with pytest.raises(ValueError, match="capacity"):
+            first_fit_pack([1], 0)
+
+
+@pytest.fixture
+def ragged_dataset():
+    schema = TensorSchema(
+        TensorFeatureInfo(
+            "item_id", FeatureType.CATEGORICAL, is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID, cardinality=50, embedding_dim=16,
+        )
+    )
+    rng = np.random.default_rng(0)
+    frame = pd.DataFrame(
+        {
+            "query_id": np.arange(40),
+            "item_id": [
+                rng.integers(1, 50, rng.integers(1, 6)).astype(np.int64)
+                for _ in range(40)
+            ],
+        }
+    )
+    return SequentialDataset(schema, "query_id", "item_id", frame), frame
+
+
+class TestPackedSequenceBatcher:
+    def test_shapes_segments_masks(self, ragged_dataset):
+        dataset, frame = ragged_dataset
+        packer = PackedSequenceBatcher(
+            dataset, batch_size=4, max_sequence_length=12, shuffle=True, seed=1
+        )
+        batches = list(packer)
+        for batch in batches:
+            assert batch["item_id"].shape == (4, 12)
+            assert batch["segment_ids"].shape == (4, 12)
+            assert batch["segment_ids"].dtype == np.int32
+            np.testing.assert_array_equal(
+                batch["item_id_mask"], batch["segment_ids"] > 0
+            )
+            # segments are 1..k contiguous from the left per row
+            for row in batch["segment_ids"]:
+                nonzero = row[row > 0]
+                assert (np.diff(nonzero) >= 0).all()
+
+    def test_every_token_appears_exactly_once(self, ragged_dataset):
+        dataset, frame = ragged_dataset
+        packer = PackedSequenceBatcher(
+            dataset, batch_size=4, max_sequence_length=12, shuffle=True, seed=1
+        )
+        total_tokens = sum(len(s) for s in frame["item_id"])
+        packed_tokens = sum(
+            int((b["segment_ids"] > 0).sum()) for b in packer
+        )
+        assert packed_tokens == total_tokens
+
+    def test_deterministic_and_epoch_reshuffles(self, ragged_dataset):
+        dataset, _ = ragged_dataset
+
+        def run(epoch):
+            packer = PackedSequenceBatcher(
+                dataset, batch_size=4, max_sequence_length=12, shuffle=True, seed=1
+            )
+            packer.set_epoch(epoch)
+            return list(packer)
+
+        first, again = run(0), run(0)
+        for a, b in zip(first, again):
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+        other = run(1)
+        assert any(
+            not np.array_equal(a["item_id"], b["item_id"])
+            for a, b in zip(first, other)
+        ) or len(first) != len(other)
+
+    def test_cuts_batches_and_padding_vs_unpacked(self, ragged_dataset):
+        dataset, _ = ragged_dataset
+        packer = PackedSequenceBatcher(
+            dataset, batch_size=4, max_sequence_length=12, shuffle=False
+        )
+        unpacked = SequenceBatcher(
+            dataset, batch_size=4, max_sequence_length=12, shuffle=False
+        )
+        assert len(packer) < len(unpacked)
+        summary = packer.packing_summary()
+        assert summary["padding_fraction"] < summary["unpacked_padding_fraction"]
+        assert summary["segments_per_row"] > 1.5
+
+    def test_max_segments_bounds_row_occupancy(self, ragged_dataset):
+        dataset, _ = ragged_dataset
+        packer = PackedSequenceBatcher(
+            dataset, batch_size=4, max_sequence_length=12, max_segments=2
+        )
+        for batch in packer:
+            assert batch["segment_ids"].max() <= 2
+
+    def test_scan_compatible_with_slot_buckets(self, ragged_dataset):
+        dataset, _ = ragged_dataset
+        packer = PackedSequenceBatcher(
+            dataset, batch_size=4, max_sequence_length=12, bucket_boundaries=(4, 8)
+        )
+        assert packer.scan_compatible  # slot rounding, NOT per-batch widths
+        shapes = {b["item_id"].shape for b in packer}
+        assert shapes == {(4, 12)}
+
+    def test_recency_truncation_for_long_sequences(self):
+        schema = TensorSchema(
+            TensorFeatureInfo(
+                "item_id", FeatureType.CATEGORICAL, is_seq=True,
+                feature_hint=FeatureHint.ITEM_ID, cardinality=50,
+            )
+        )
+        frame = pd.DataFrame(
+            {"query_id": [0], "item_id": [np.arange(1, 21)]}  # longer than L
+        )
+        dataset = SequentialDataset(schema, "query_id", "item_id", frame)
+        packer = PackedSequenceBatcher(dataset, batch_size=2, max_sequence_length=6)
+        batch = next(iter(packer))
+        # keeps the LAST 6 events, left-aligned in the row
+        np.testing.assert_array_equal(batch["item_id"][0], np.arange(15, 21))
+        np.testing.assert_array_equal(batch["segment_ids"][0], [1] * 6)

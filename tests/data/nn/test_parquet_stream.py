@@ -1,0 +1,432 @@
+"""Shard-aware streaming parquet: row-group sharding, cursors, epoch
+determinism, the byte-budget sub-slab split and the streaming writer."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from replay_tpu.data import FeatureHint, FeatureType
+from replay_tpu.data.nn import (
+    SequentialDataset,
+    TensorFeatureInfo,
+    TensorSchema,
+    TransformedBatches,
+)
+from replay_tpu.data.nn.parquet import ParquetBatcher, StreamCursor, write_sequence_parquet
+from replay_tpu.data.nn.partitioning import Partitioning, ReplicasInfo
+
+N_ROWS = 57
+GROUP_SIZE = 10  # 6 row groups for 57 rows
+
+
+@pytest.fixture
+def grouped_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "stream.parquet")
+    table = pa.table(
+        {
+            "query_id": np.arange(N_ROWS),
+            "item_id": [
+                rng.integers(0, 50, rng.integers(1, 8)).tolist() for _ in range(N_ROWS)
+            ],
+        }
+    )
+    pq.write_table(table, path, row_group_size=GROUP_SIZE)
+    return path
+
+
+def make_batcher(path, **overrides):
+    kwargs = dict(
+        source=path,
+        batch_size=8,
+        shuffle=True,
+        seed=3,
+        shard="row_groups",
+        metadata={"item_id": {"shape": 5, "padding": 50}},
+    )
+    kwargs.update(overrides)
+    return ParquetBatcher(**kwargs)
+
+
+def queries(batches):
+    return np.concatenate([b["query_id"][b["valid"]] for b in batches])
+
+
+class TestRowGroupSharding:
+    def test_single_replica_coverage(self, grouped_parquet):
+        batcher = make_batcher(grouped_parquet)
+        batcher.set_epoch(1)
+        batches = list(batcher)
+        assert all(b["item_id"].shape == (8, 5) for b in batches)
+        assert sorted(queries(batches).tolist()) == list(range(N_ROWS))
+
+    def test_replicas_disjoint_cover_exactly_once_same_count(self, grouped_parquet):
+        seen = []
+        counts = []
+        for replica in range(3):
+            batcher = make_batcher(
+                grouped_parquet,
+                partitioning=Partitioning(ReplicasInfo(3, replica), shuffle=True, seed=3),
+            )
+            batcher.set_epoch(0)
+            batches = list(batcher)
+            counts.append(len(batches))
+            seen.extend(queries(batches).tolist())
+        # disjoint + exactly-once coverage, equal step counts on every replica
+        assert sorted(seen) == list(range(N_ROWS))
+        assert len(set(counts)) == 1
+
+    def test_epoch_reshuffles_same_epoch_bit_identical(self, grouped_parquet):
+        def epoch_batches(epoch):
+            batcher = make_batcher(grouped_parquet)
+            batcher.set_epoch(epoch)
+            return list(batcher)
+
+        first = epoch_batches(1)
+        again = epoch_batches(1)
+        assert len(first) == len(again)
+        for a, b in zip(first, again):
+            assert sorted(a) == sorted(b)
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+        other = epoch_batches(2)
+        assert not np.array_equal(queries(first), queries(other))
+        assert sorted(queries(other).tolist()) == list(range(N_ROWS))
+
+    def test_group_order_shuffles_across_epochs(self, grouped_parquet):
+        part = Partitioning(shuffle=True, seed=3)
+        order1 = part.shard_items(6, epoch=1)
+        order2 = part.shard_items(6, epoch=2)
+        assert sorted(order1.tolist()) == list(range(6))
+        assert not np.array_equal(order1, order2)
+        # unshuffled: stable identity order
+        plain = Partitioning().shard_items(6, epoch=5)
+        np.testing.assert_array_equal(plain, np.arange(6))
+
+    def test_shard_items_round_robin_disjoint(self):
+        part = Partitioning(ReplicasInfo(4, 0), shuffle=True, seed=9)
+        shares = [part.shard_items(10, epoch=3, replica_id=r) for r in range(4)]
+        merged = np.concatenate(shares)
+        assert sorted(merged.tolist()) == list(range(10))
+
+    def test_too_few_groups_for_replicas_raises(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        path = str(tmp_path / "one_group.parquet")
+        pq.write_table(
+            pa.table({"query_id": np.arange(5), "item_id": [[1]] * 5}), path
+        )
+        batcher = ParquetBatcher(
+            path, batch_size=2, shard="row_groups",
+            metadata={"item_id": {"shape": 2}},
+            partitioning=Partitioning(ReplicasInfo(4, 0)),
+        )
+        with pytest.raises(ValueError, match="row group"):
+            list(batcher)
+
+
+class TestMemoryBudgetAndReadAhead:
+    def test_budget_splits_slabs_stream_unchanged(self, grouped_parquet):
+        reference = make_batcher(grouped_parquet)
+        reference.set_epoch(1)
+        full = list(reference)
+        budget = make_batcher(grouped_parquet, memory_budget_bytes=200)
+        budget.set_epoch(1)
+        slabs, _, _ = budget._plan(1)
+        ref_slabs, _, _ = reference._plan(1)
+        assert len(slabs) > len(ref_slabs)  # the budget forced sub-slabs
+        assert max(s.rows for s in slabs) < max(s.rows for s in ref_slabs)
+        assert sorted(queries(list(budget)).tolist()) == list(range(N_ROWS))
+
+    def test_read_ahead_bit_identical_to_sync(self, grouped_parquet):
+        sync = make_batcher(grouped_parquet, memory_budget_bytes=300)
+        sync.set_epoch(2)
+        ahead = make_batcher(grouped_parquet, memory_budget_bytes=300, read_ahead=3)
+        ahead.set_epoch(2)
+        sync_batches, ahead_batches = list(sync), list(ahead)
+        assert len(sync_batches) == len(ahead_batches)
+        for a, b in zip(sync_batches, ahead_batches):
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+
+
+class TestStreamCursor:
+    def test_resume_bit_identical_at_every_boundary(self, grouped_parquet):
+        batcher = make_batcher(grouped_parquet)
+        batcher.set_epoch(1)
+        full = list(batcher)
+        for k in range(len(full) + 1):
+            producer = make_batcher(grouped_parquet)
+            producer.set_epoch(1)
+            iterator = iter(producer)
+            for _ in range(k):
+                next(iterator)
+            record = producer.cursor_for(k).to_metadata()
+            json.dumps(record)  # checkpoint-sidecar (JSON) serializable
+            resumed = make_batcher(grouped_parquet)
+            resumed.set_epoch(1)
+            resumed.restore_cursor(record)
+            rest = list(resumed)
+            assert len(rest) == len(full) - k
+            for a, b in zip(full[k:], rest):
+                for key in a:
+                    np.testing.assert_array_equal(a[key], b[key])
+
+    def test_resume_skips_consumed_slabs(self, grouped_parquet):
+        """The point of the cursor: slabs before the resume point are never
+        re-read (no rescan-from-start fast-forward)."""
+        producer = make_batcher(grouped_parquet)
+        producer.set_epoch(0)
+        iterator = iter(producer)
+        for _ in range(4):
+            next(iterator)
+        record = producer.cursor_for(4)
+        assert record.slab > 0
+        resumed = make_batcher(grouped_parquet)
+        resumed.set_epoch(0)
+        resumed.restore_cursor(record)
+        reads = []
+        original = type(resumed)._read_slab
+
+        def counting_read(self, path, slab):
+            reads.append((slab.group, slab.start))
+            return original(self, path, slab)
+
+        resumed._read_slab = counting_read.__get__(resumed)
+        list(resumed)
+        total_slabs, _, _ = producer._plan(0)
+        assert 0 < len(reads) <= len(total_slabs) - record.slab + 1
+        assert len(reads) < len(total_slabs)
+
+    def test_epoch_mismatch_raises(self, grouped_parquet):
+        producer = make_batcher(grouped_parquet)
+        producer.set_epoch(1)
+        next(iter(producer))
+        cursor = producer.cursor_for(1)
+        resumed = make_batcher(grouped_parquet)
+        resumed.set_epoch(2)
+        resumed.restore_cursor(cursor)
+        with pytest.raises(ValueError, match="epoch"):
+            next(iter(resumed))
+
+    def test_resume_at_last_real_batch_still_emits_alignment_tail(
+        self, grouped_parquet
+    ):
+        """A short replica checkpointed at its LAST real batch must rebuild
+        the valid=False alignment tail from the cursor's pad_spec alone."""
+        # 4 replicas over 6 row groups: the round-robin shares are uneven, so
+        # at least one replica pads its tail to the global max batch count
+        part, full, real = None, None, None
+        for replica in range(4):
+            candidate = Partitioning(ReplicasInfo(4, replica), shuffle=True, seed=3)
+            producer = make_batcher(grouped_parquet, partitioning=candidate)
+            producer.set_epoch(0)
+            batches = list(producer)
+            measured = sum(1 for b in batches if b["valid"].any())
+            if measured < len(batches):
+                part, full, real = candidate, batches, measured
+                break
+        assert part is not None, "no replica needed alignment pads"
+        cursor = producer.cursor_for(real)
+        assert cursor.pad_spec is not None
+        resumed = make_batcher(grouped_parquet, partitioning=part)
+        resumed.set_epoch(0)
+        resumed.restore_cursor(cursor.to_metadata())
+        tail = list(resumed)
+        assert len(tail) == len(full) - real
+        for a, b in zip(full[real:], tail):
+            assert not b["valid"].any()
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+
+    def test_plan_mismatch_raises(self, grouped_parquet):
+        producer = make_batcher(grouped_parquet)
+        producer.set_epoch(0)
+        next(iter(producer))
+        record = producer.cursor_for(1).to_metadata()
+        assert record["plan"]["num_replicas"] == 1
+        other_layout = make_batcher(
+            grouped_parquet, partitioning=Partitioning(ReplicasInfo(2, 0), shuffle=True, seed=3)
+        )
+        other_layout.set_epoch(0)
+        with pytest.raises(ValueError, match="different epoch plan"):
+            other_layout.restore_cursor(record)
+        other_batch = make_batcher(grouped_parquet, batch_size=4)
+        other_batch.set_epoch(0)
+        with pytest.raises(ValueError, match="different epoch plan"):
+            other_batch.restore_cursor(record)
+
+    def test_rows_mode_has_no_cursor(self, grouped_parquet):
+        batcher = ParquetBatcher(
+            grouped_parquet, batch_size=8, metadata={"item_id": {"shape": 5}}
+        )
+        assert not batcher.supports_cursor
+        with pytest.raises(ValueError, match="row_groups"):
+            batcher.cursor_for(0)
+        with pytest.raises(ValueError, match="row_groups"):
+            batcher.restore_cursor(StreamCursor(0, 0, 0, 0))
+
+    def test_carry_round_trips_through_json(self, grouped_parquet):
+        """Cursors taken at slab boundaries serialize the cross-slab carry
+        rows; the round trip through the JSON sidecar form is exact."""
+        producer = make_batcher(grouped_parquet)
+        producer.set_epoch(1)
+        list(producer)
+        carried = [
+            cursor
+            for cursor in producer._cursor_history.values()
+            if cursor.carry is not None
+        ]
+        assert carried, "no slab-boundary cursor carried rows"
+        for cursor in carried:
+            rebuilt = StreamCursor.from_metadata(
+                json.loads(json.dumps(cursor.to_metadata()))
+            )
+            assert rebuilt == cursor
+
+
+def test_file_uri_source_row_groups(tmp_path):
+    """shard='row_groups' resolves URI sources through the same arrow
+    filesystem registry as the legacy mode (footer reads AND slab reads)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = tmp_path / "uri.parquet"
+    pq.write_table(
+        pa.table({"query_id": np.arange(20), "item_id": [[1, 2]] * 20}),
+        str(path), row_group_size=5,
+    )
+    batcher = ParquetBatcher(
+        source=f"file://{path}", batch_size=4, shard="row_groups",
+        memory_budget_bytes=64,  # forces the sub-slab (iter_batches) read too
+        metadata={"item_id": {"shape": 3}},
+    )
+    batcher.set_epoch(0)
+    batches = list(batcher)
+    assert sorted(queries(batches).tolist()) == list(range(20))
+
+
+class TestLegacyEpochDeterminism:
+    """Satellite: the legacy rows-mode batcher's set_epoch contract, incl.
+    the cross-slab carry path (parquet.py _iter_rows)."""
+
+    def test_same_epoch_bit_identical_across_slab_carry(self, grouped_parquet):
+        def run(epoch):
+            batcher = ParquetBatcher(
+                grouped_parquet, batch_size=8, shuffle=True, seed=3,
+                partition_size=GROUP_SIZE,  # slabs < batches -> carry path
+                metadata={"item_id": {"shape": 5, "padding": 50}},
+            )
+            batcher.set_epoch(epoch)
+            return list(batcher)
+
+        first, again = run(4), run(4)
+        assert len(first) == len(again)
+        for a, b in zip(first, again):
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+        other = run(5)
+        assert not np.array_equal(queries(first), queries(other))
+        assert sorted(queries(other).tolist()) == sorted(queries(first).tolist())
+
+
+class TestStreamingWriter:
+    def make_dataset(self, n=23):
+        schema = TensorSchema(
+            TensorFeatureInfo(
+                "item_id", FeatureType.CATEGORICAL, is_seq=True,
+                feature_hint=FeatureHint.ITEM_ID, cardinality=50,
+            )
+        )
+        frame = pd.DataFrame(
+            {
+                "query_id": np.arange(n),
+                "item_id": [np.arange(i % 7 + 1) for i in range(n)],
+            }
+        )
+        return SequentialDataset(schema, "query_id", "item_id", frame)
+
+    def test_chunked_write_round_trips(self, tmp_path):
+        import pyarrow.parquet as pq
+
+        dataset = self.make_dataset()
+        path = str(tmp_path / "chunked.parquet")
+        write_sequence_parquet(path, dataset, rows_per_chunk=6)
+        meta = pq.ParquetFile(path).metadata
+        assert meta.num_rows == 23
+        assert meta.num_row_groups == 4  # ceil(23 / 6): one group per chunk
+        batches = list(
+            ParquetBatcher(
+                path, batch_size=8, metadata={"item_id": {"shape": 5, "padding": 50}}
+            )
+        )
+        assert sorted(queries(batches).tolist()) == list(range(23))
+
+    def test_chunked_write_matches_monolithic(self, tmp_path):
+        dataset = self.make_dataset()
+        chunked = str(tmp_path / "chunked.parquet")
+        mono = str(tmp_path / "mono.parquet")
+        write_sequence_parquet(chunked, dataset, rows_per_chunk=5)
+        write_sequence_parquet(mono, dataset, rows_per_chunk=10_000)
+        import pyarrow.parquet as pq
+
+        a = pq.read_table(chunked).to_pydict()
+        b = pq.read_table(mono).to_pydict()
+        assert a == b
+
+    def test_extra_columns_validated(self, tmp_path):
+        dataset = self.make_dataset(5)
+        with pytest.raises(ValueError, match="extra column"):
+            write_sequence_parquet(
+                str(tmp_path / "bad.parquet"), dataset, extra_columns={"w": [1, 2]}
+            )
+        path = str(tmp_path / "extra.parquet")
+        write_sequence_parquet(
+            path, dataset, extra_columns={"w": list(range(5))}, rows_per_chunk=2
+        )
+        batch = next(
+            iter(
+                ParquetBatcher(
+                    path, batch_size=5, shard="row_groups",
+                    metadata={"item_id": {"shape": 5, "padding": 50}},
+                )
+            )
+        )
+        assert sorted(batch["w"][batch["valid"]].tolist()) == list(range(5))
+
+    def test_rows_per_chunk_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="rows_per_chunk"):
+            write_sequence_parquet(
+                str(tmp_path / "x.parquet"), self.make_dataset(3), rows_per_chunk=0
+            )
+
+
+class TestTransformedBatches:
+    def test_forwards_stream_protocol(self, grouped_parquet):
+        batcher = make_batcher(grouped_parquet)
+        wrapped = TransformedBatches(batcher, lambda b: {**b, "extra": b["valid"]})
+        assert wrapped.supports_cursor
+        assert wrapped.scan_compatible
+        wrapped.set_epoch(3)
+        assert batcher.epoch == 3
+        batches = list(wrapped)
+        assert all("extra" in b for b in batches)
+        cursor = wrapped.cursor_for(2)
+        assert cursor.batches == 2
+        resumed = TransformedBatches(
+            make_batcher(grouped_parquet), lambda b: {**b, "extra": b["valid"]}
+        )
+        resumed.set_epoch(3)
+        resumed.restore_cursor(cursor.to_metadata())
+        rest = list(resumed)
+        assert len(rest) == len(batches) - 2
+        for a, b in zip(batches[2:], rest):
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
