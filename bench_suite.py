@@ -29,8 +29,17 @@ produces the full measurement batch the round-4 verdict asked for:
   ``padding_fraction``; ``obs.report --compare`` gates packed ≥ unpacked.
 - ``attention_long``   — tiled flash kernel (ops/flash_tiled.py) vs XLA full
   attention at L=4096, fwd+bwd: the single-chip long-context A/B.
+- ``attention_long_sp`` — ring attention (sequence sharded over all chips,
+  ppermute KV rotation) vs single-device full attention at L=4096: the
+  multi-chip half of the long-context A/B, with the exactness check inline.
 - ``sasrec_l1024`` / ``sasrec_l1024_tiled`` — the full MODEL at L=1024
   (fused-CE head): default attention vs use_flash='tiled' end-to-end.
+- ``sasrec_l1024_sp_remat_{off,on}`` — the full MODEL at L=1024 through the
+  DP×TP×SP production fit (ONE rule table: ring attention over ``seq``,
+  CEFusedTP catalog over ``model``, rows over ``data``), A/B'ing
+  ``Trainer(remat_policy="dots")``. The claim: remat-on strictly lowers
+  ``hbm_peak_bytes`` at held math; ``obs.report`` renders the pair and
+  ``--compare`` gates it lower-better.
 - ``prec_{f32,bf16}_{ce,fused,tp}`` — the precision-ladder family
   (docs/performance.md "The precision ladder"): the SAME 27k-catalog shape per
   head, f32 vs the sanctioned ``Trainer(precision="bf16")`` policy (bf16
@@ -370,29 +379,78 @@ def run_twotower(num_items, dim, batch, seq_len, dtype):
                                    "B512 vs the notebook's CPU-host B32)"})
 
 
-def run_sasrec_longseq(length, dim, batch, fused, tiled, label, dtype, quick):
+def _longseq_mesh_layout():
+    """The DP×TP×SP grid the long-sequence sharded rows run on: 2×2×2 on an
+    8-chip slice, degrading gracefully toward 1×1×1 on smaller ones (the row
+    meta records the actual grid so cross-run compares stay like-for-like)."""
+    import jax
+
+    n = jax.device_count()
+    seq = 2 if n % 2 == 0 else 1
+    tp = 2 if n % 4 == 0 else 1
+    dp = n // (seq * tp)
+    return dp, tp, seq
+
+
+def run_sasrec_longseq(length, dim, batch, fused, tiled, label, dtype, quick,
+                       sharded=False, remat=None):
     """SASRec at long L — the regime the reference cannot reach on one device
     (its torch attention materializes [B, H, L, L]). A/B: default attention vs
-    use_flash='tiled', with CEFused keeping the head off the critical path."""
+    use_flash='tiled', with CEFused keeping the head off the critical path.
+
+    ``sharded=True`` runs the FULL DP×TP×SP production fit instead of the
+    single-chip model: the rule table places batch rows over ``data``, the
+    vocab table over ``model`` (CEFusedTP head) and the sequence over ``seq``
+    with ring attention — the ROADMAP-2 long-context path end-to-end.
+    ``remat`` ("on"/"off") A/Bs activation checkpointing over the blocks;
+    ``obs.report --compare`` gates the pair on ``hbm_peak_bytes``
+    lower-better.
+    """
     from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
-    from replay_tpu.nn.loss import CE, CEFused
+    from replay_tpu.nn.loss import CE, CEFused, CEFusedTP
     from replay_tpu.nn.sequential.sasrec import SasRec
 
     num_items = 64 if quick else 3706
+    if sharded:
+        dp, tp, seq = _longseq_mesh_layout()
+        if tp > 1:
+            # the vocab rule shards TABLE ROWS (cardinality + padding row):
+            # keep them divisible by the model axis or the placement warns
+            # and replicates (the satellite-1 loud fallback)
+            num_items -= (num_items + 1) % tp
+        mesh = make_mesh(model_parallel=tp, seq_parallel=seq)
+        use_flash = "ring" if seq > 1 else False
+        loss = CEFusedTP(tile=8 if quick else 256) if tp > 1 else (
+            CEFused(tile=8 if quick else 256) if fused else CE()
+        )
+        loss_label = type(loss).__name__ + (f"(n_tp={tp})" if tp > 1 else "")
+    else:
+        mesh = make_mesh()
+        use_flash = "tiled" if tiled else False
+        loss = CEFused() if fused else CE()
+        loss_label = type(loss).__name__
     model = SasRec(
         schema=item_schema(num_items, dim), embedding_dim=dim, num_blocks=2,
         num_heads=2, max_sequence_length=length, dropout_rate=0.0, dtype=dtype,
-        use_flash="tiled" if tiled else False,
+        use_flash=use_flash,
     )
     trainer = Trainer(
-        model=model, loss=CEFused() if fused else CE(),
-        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3), mesh=make_mesh(),
+        model=model, loss=loss,
+        optimizer=OptimizerFactory(name="adam", learning_rate=1e-3), mesh=mesh,
+        shard_vocab=sharded and tp > 1,
+        remat_policy="dots" if remat == "on" else None,
     )
+    meta = {"num_items": num_items, "d": dim, "B": batch, "L": length,
+            "attention": ("ring" if use_flash == "ring" else
+                          "flash_tiled" if tiled else "xla_full"),
+            "loss": loss_label}
+    if sharded:
+        meta["mesh"] = {"data": dp, "model": tp, "seq": seq}
+    if remat is not None:
+        meta["remat"] = remat
     return measure(
         trainer, sasrec_batch(num_items, batch, length), label, scan_k=4,
-        meta={"num_items": num_items, "d": dim, "B": batch, "L": length,
-              "attention": "flash_tiled" if tiled else "xla_full",
-              "loss": "CEFused" if fused else "CE"},
+        meta=meta,
     )
 
 
@@ -441,6 +499,58 @@ def run_attention_long(length, quick):
             record[f"{name}_ms"] = round((time.perf_counter() - t0) / reps * 1000, 2)
         except Exception as exc:  # XLA full attention MAY OOM at long L: a result
             record[f"{name}_error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
+    return record
+
+
+def run_attention_long_sp(length, quick):
+    """Ring attention (sequence sharded over every device) vs single-device
+    full attention at long L, fwd+bwd — the multi-chip half of the
+    ``attention_long`` A/B: per-chip memory is O((L/n_sp)·L-block) and the only
+    sequence traffic is the ppermute KV rotation (arXiv 2310.01889)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from replay_tpu.parallel import full_attention_reference, ring_attention
+
+    n_sp = jax.device_count()
+    batch, heads, dim = (1, 1, 8) if quick else (4, 4, 64)
+    length = length - (length % n_sp) or n_sp
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(batch, length, heads, dim)).astype(np.float32))
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+
+    record = {"row": "attention_long_sp", "B": batch, "H": heads, "L": length,
+              "D": dim, "sp": n_sp, "backend": jax.default_backend(),
+              "device_kind": jax.devices()[0].device_kind}
+
+    def ring_loss(q):
+        return jnp.sum(ring_attention(q, q, q, mesh, axis_name="sp", causal=True) ** 2)
+
+    def full_loss(q):
+        return jnp.sum(full_attention_reference(q, q, q, causal=True) ** 2)
+
+    for name, fn in (("xla_full", full_loss), ("ring_sp", ring_loss)):
+        try:
+            grad = jax.jit(jax.grad(fn))
+            out = grad(q)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            reps = 2 if quick else 10
+            for _ in range(reps):
+                out = grad(q)
+            jax.block_until_ready(out)
+            record[f"{name}_ms"] = round((time.perf_counter() - t0) / reps * 1000, 2)
+        except Exception as exc:  # full attention MAY OOM at long L: a result
+            record[f"{name}_error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
+    if "xla_full_error" not in record and "ring_sp_error" not in record:
+        err = float(
+            jnp.max(jnp.abs(
+                ring_attention(q, q, q, mesh, axis_name="sp", causal=True)
+                - full_attention_reference(q, q, q, causal=True)
+            ))
+        )
+        record["ring_max_err"] = round(err, 8)
     return record
 
 
@@ -725,8 +835,16 @@ def main():
         "stream_parquet": lambda: run_stream("parquet", 3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
         "stream_packed": lambda: run_stream("packed", 3706 if not q else 50, 64 if not q else 16, B, L, q, dtype),
         "attention_long": lambda: run_attention_long(4096 if not q else 32, q),
+        "attention_long_sp": lambda: run_attention_long_sp(4096 if not q else 32, q),
         "sasrec_l1024": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, False, "sasrec_l1024", dtype, q),
         "sasrec_l1024_tiled": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, not q, True, "sasrec_l1024_tiled", dtype, q),
+        # the DP×TP×SP long-context family (ROADMAP 2): the FULL sharded fit —
+        # ring attention over the seq axis, CEFusedTP over the model axis,
+        # batch rows over data, all from ONE rule table — with a remat on/off
+        # A/B pair; obs.report renders the pair and --compare gates
+        # hbm_peak_bytes lower-better (remat exists to move bytes)
+        "sasrec_l1024_sp_remat_off": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, True, False, "sasrec_l1024_sp_remat_off", dtype, q, sharded=True, remat="off"),
+        "sasrec_l1024_sp_remat_on": lambda: run_sasrec_longseq(1024 if not q else 16, 128 if not q else 8, 32 if not q else 4, True, False, "sasrec_l1024_sp_remat_on", dtype, q, sharded=True, remat="on"),
     }
     # the catalog-scaling family ("Breaking the memory wall"): one row per
     # (catalog size, head) — near-flat step time 27k → 1M is the claim for

@@ -42,6 +42,10 @@ from .train import (
 # (Trainer(health=HealthConfig(...)), the obs.health diagnostics layer)
 from replay_tpu.obs.health import HealthConfig, HealthWatcher
 
+# the ONE sharding-rule table (Trainer(sharding_rules=...)) — re-exported next
+# to make_mesh so the DP×TP×SP construction reads as one import
+from replay_tpu.parallel.sharding import ShardingRules
+
 __all__ = [
     "create_activation",
     "CategoricalEmbedding",
@@ -72,6 +76,7 @@ __all__ = [
     "set_item_embeddings_by_size",
     "set_item_embeddings_by_tensor",
     "SequenceEmbedding",
+    "ShardingRules",
     "SumAggregator",
     "SwiGLU",
     "SwiGLUEncoder",

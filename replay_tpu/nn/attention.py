@@ -23,9 +23,9 @@ def dot_product_attention(
     q: jnp.ndarray,  # [B, H, L, D]
     k: jnp.ndarray,
     v: jnp.ndarray,
-    mask: jnp.ndarray,  # additive [B, 1, L, L]; None on the "tiled" route
-    use_flash=False,  # False | True (single-block kernel) | "tiled" (long L)
-    padding_mask: jnp.ndarray = None,  # [B, L] bool, required for "tiled"
+    mask: jnp.ndarray,  # additive [B, 1, L, L]; None on the "tiled"/"ring" routes
+    use_flash=False,  # False | True (single-block kernel) | "tiled" | "ring"
+    padding_mask: jnp.ndarray = None,  # [B, L] bool, required for "tiled"/"ring"
     causal: bool = True,
     return_weights: bool = False,  # also return the [B, H, L, L] softmax weights
 ) -> jnp.ndarray:
@@ -33,6 +33,54 @@ def dot_product_attention(
         # the flash kernels never materialize the weights — that is the point
         msg = "return_weights=True requires the standard (use_flash=False) route"
         raise ValueError(msg)
+    if use_flash == "ring":
+        # sequence-parallel exact attention: the L axis stays sharded over the
+        # trainer mesh's seq axis, KV blocks rotate with ppermute, and no
+        # [B, 1, L, L] mask nor full-sequence gather ever materializes
+        # (replay_tpu.parallel.ring; Ring Attention, arXiv 2310.01889)
+        from replay_tpu.parallel.ring import ring_attention
+        from replay_tpu.parallel.sharding import active_scope
+
+        if padding_mask is None:
+            msg = "use_flash='ring' needs the [B, L] padding_mask"
+            raise ValueError(msg)
+        if mask is not None:
+            msg = "use_flash='ring' cannot honor an additive mask; pass mask=None"
+            raise ValueError(msg)
+        scope = active_scope()
+        if scope is None:
+            msg = (
+                "use_flash='ring' resolves its mesh and sequence axis from the "
+                "trainer's sharding scope — train/score through "
+                "replay_tpu.nn.Trainer(sharding_rules=...), or wrap the apply "
+                "in replay_tpu.parallel.sharding.sharding_scope(rules, mesh)"
+            )
+            raise RuntimeError(msg)
+        rules, mesh = scope
+        seq_axis = rules.mesh_axis("length")
+        if seq_axis is None or isinstance(seq_axis, tuple):
+            msg = (
+                f"use_flash='ring' needs the 'length' rule to name ONE mesh "
+                f"axis; the active table maps it to {seq_axis!r}"
+            )
+            raise ValueError(msg)
+        batch_axis = rules.mesh_axis("batch")
+        if isinstance(batch_axis, tuple) or (
+            batch_axis is not None
+            and (q.shape[0] % mesh.shape[batch_axis] or rules.axis_size(mesh, "batch") <= 1)
+        ):
+            batch_axis = None  # replicate rows inside the ring shard_map
+        out = ring_attention(
+            q.swapaxes(-3, -2),  # [B, H, L, D] -> [B, L, H, D]
+            k.swapaxes(-3, -2),
+            v.swapaxes(-3, -2),
+            mesh,
+            axis_name=seq_axis,
+            causal=causal,
+            padding_mask=padding_mask,
+            data_axis=batch_axis,
+        )
+        return out.swapaxes(-3, -2).astype(q.dtype)
     if use_flash == "tiled":
         # length-tiled kernel: O(L·block) memory, mask computed in-kernel from
         # (causal, padding) — callers skip building the [B, 1, L, L] tensor

@@ -109,30 +109,34 @@ def attention_mask_for_route(
     """The additive mask a model body should hand its encoder, route-aware.
 
     On the ``use_flash == "tiled"`` route the kernel reconstructs causal +
-    key-padding structure in-kernel, so the ``[B, 1, L, L]`` tensor must NOT be
-    built (that allocation is the thing the route eliminates) — returns None.
-    Every other route gets the standard causal or bidirectional additive mask.
-    One source of truth for the conditional shared by SasRec / Bert4Rec /
-    TwoTower bodies.
+    key-padding structure in-kernel, and on ``use_flash == "ring"`` the
+    sequence-parallel ring builds its per-block bias from ring positions — in
+    both cases the ``[B, 1, L, L]`` tensor must NOT be built (that allocation
+    is the thing those routes eliminate) — returns None. Every other route
+    gets the standard causal or bidirectional additive mask. One source of
+    truth for the conditional shared by SasRec / Bert4Rec / TwoTower bodies.
 
     ``segment_ids`` (packed batches) adds the same-segment constraint via
-    :func:`segment_attention_mask`. The flash kernels rebuild their masks
-    in-kernel from (causal, padding) alone and would silently attend across
-    packed segments — that combination is rejected, not degraded.
+    :func:`segment_attention_mask`. The flash kernels and the ring SP route
+    rebuild their masks in-kernel from (causal, padding) alone and would
+    silently attend across packed segments — that combination is rejected,
+    not degraded (the same refusal policy for every mask-free route).
     """
     if segment_ids is not None:
         if use_flash:
+            route = "the ring SP route" if use_flash == "ring" else "the flash kernels"
             msg = (
                 "packed batches (segment_ids) need the additive segment mask, "
-                "which the flash kernels cannot honor — run packing with "
-                "use_flash=False, or drop the packing for flash routes"
+                f"which {route} cannot honor — run packing with "
+                "use_flash=False, or drop the packing for the "
+                f"use_flash={use_flash!r} route"
             )
             raise ValueError(msg)
         return segment_attention_mask(
             padding_mask, segment_ids, causal=causal,
             deterministic=deterministic, dtype=dtype,
         )
-    if use_flash == "tiled":
+    if use_flash in ("tiled", "ring"):
         return None
     builder = causal_attention_mask if causal else bidirectional_attention_mask
     return builder(padding_mask, deterministic=deterministic, dtype=dtype)

@@ -31,6 +31,7 @@ from replay_tpu.nn.embedding import SequenceEmbedding
 from replay_tpu.nn.head import EmbeddingTyingHead
 from replay_tpu.nn.mask import attention_mask_for_route
 from replay_tpu.obs.health import sow_stage_stats
+from replay_tpu.parallel.sharding import shard_activation
 
 from ..sasrec.transformer import SasRecTransformerLayer
 
@@ -48,7 +49,9 @@ class Bert4RecBody(nn.Module):
     activation: str = "gelu"
     num_passes_over_block: int = 1
     remat: bool = False
-    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
+    remat_policy: Any = None  # jax.checkpoint policy (Trainer(remat_policy=...))
+    scan_blocks: bool = False  # nn.scan over the block stack ([layers, ...] params)
+    use_flash: Any = False  # False | True | "tiled" (long L) | "ring" (seq-parallel)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -76,6 +79,8 @@ class Bert4RecBody(nn.Module):
             dropout_rate=self.dropout_rate,
             activation=self.activation,
             remat=self.remat,
+            remat_policy=self.remat_policy,
+            scan_blocks=self.scan_blocks,
             use_flash=self.use_flash,
             dtype=self.dtype,
             name="encoder",
@@ -109,6 +114,10 @@ class Bert4RecBody(nn.Module):
             total.dtype
         )
         x = self.input_dropout(self.input_norm(x), deterministic=deterministic)
+        # rule-table activation constraint: [B, L, E] pinned to the (batch,
+        # length, embed) rules under the trainer's sharding scope (the SP
+        # layout between ring-attention blocks); a no-op outside any scope
+        x = shard_activation(x, "batch", "length", "embed")
         # model-health stage stats (no-op unless `intermediates` is mutable)
         sow_stage_stats(self, "embed", x)
         # packed rows (segment_ids) get the block-diagonal bidirectional
@@ -124,6 +133,7 @@ class Bert4RecBody(nn.Module):
                 deterministic=deterministic, causal=False,
             )
         out = self.final_norm(x)
+        out = shard_activation(out, "batch", "length", "embed")
         sow_stage_stats(self, "final_norm", out)
         return out
 
@@ -144,7 +154,9 @@ class Bert4Rec(nn.Module):
     activation: str = "gelu"
     num_passes_over_block: int = 1
     remat: bool = False
-    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
+    remat_policy: Any = None  # jax.checkpoint policy (Trainer(remat_policy=...))
+    scan_blocks: bool = False  # nn.scan over the block stack ([layers, ...] params)
+    use_flash: Any = False  # False | True | "tiled" (long L) | "ring" (seq-parallel)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
 
@@ -191,6 +203,8 @@ class Bert4Rec(nn.Module):
             activation=self.activation,
             num_passes_over_block=self.num_passes_over_block,
             remat=self.remat,
+            remat_policy=self.remat_policy,
+            scan_blocks=self.scan_blocks,
             use_flash=self.use_flash,
             excluded_features=self.excluded_features,
             dtype=self.dtype,

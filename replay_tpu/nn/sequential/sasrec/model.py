@@ -28,6 +28,7 @@ from replay_tpu.nn.embedding import SequenceEmbedding
 from replay_tpu.nn.head import EmbeddingTyingHead
 from replay_tpu.nn.mask import attention_mask_for_route
 from replay_tpu.obs.health import sow_stage_stats
+from replay_tpu.parallel.sharding import shard_activation
 
 from .transformer import DiffTransformerLayer, SasRecTransformerLayer
 
@@ -45,7 +46,9 @@ class SasRecBody(nn.Module):
     activation: str = "relu"  # reference SASRec construction pins relu (model.py:246)
     encoder_type: str = "sasrec"
     remat: bool = False
-    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
+    remat_policy: Any = None  # jax.checkpoint policy (Trainer(remat_policy=...))
+    scan_blocks: bool = False  # nn.scan over the block stack ([layers, ...] params)
+    use_flash: Any = False  # False | True | "tiled" (long L) | "ring" (seq-parallel)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
     embedding_init: Any = None  # e.g. embedding.xavier_normal_embed_init()
@@ -71,16 +74,22 @@ class SasRecBody(nn.Module):
         if encoder_cls is None:
             msg = f"Unknown encoder_type: {self.encoder_type}"
             raise ValueError(msg)
-        if self.use_flash == "tiled" and self.encoder_type != "sasrec":
+        if self.use_flash in ("tiled", "ring") and self.encoder_type != "sasrec":
             # silently running full attention here would defeat the exact
-            # long-L regime the tiled route exists for
+            # long-L regime those routes exist for
             msg = (
-                f"use_flash='tiled' supports encoder_type='sasrec' only; "
-                f"'{self.encoder_type}' would fall back to O(L^2) attention"
+                f"use_flash={self.use_flash!r} supports encoder_type='sasrec' "
+                f"only; '{self.encoder_type}' would fall back to O(L^2) attention"
             )
             raise ValueError(msg)
         encoder_kwargs = (
-            {"remat": self.remat, "use_flash": self.use_flash, "activation": self.activation}
+            {
+                "remat": self.remat,
+                "remat_policy": self.remat_policy,
+                "scan_blocks": self.scan_blocks,
+                "use_flash": self.use_flash,
+                "activation": self.activation,
+            }
             if self.encoder_type == "sasrec"
             else {}
         )
@@ -108,6 +117,11 @@ class SasRecBody(nn.Module):
         with jax.named_scope("embed"):
             embeddings = self.embedder(feature_tensors)
             x = self.aggregator(embeddings, deterministic=deterministic)
+            # rule-table activation constraint: [B, L, E] pinned to the
+            # (batch, length, embed) rules — under the trainer's sharding
+            # scope this is what keeps the hidden states sequence-sharded
+            # between ring-attention blocks; a no-op outside any scope
+            x = shard_activation(x, "batch", "length", "embed")
             sow_stage_stats(self, "embed", x)
         with jax.named_scope("encoder"):
             # packed rows (segment_ids from PackedSequenceBatcher) get the
@@ -121,6 +135,7 @@ class SasRecBody(nn.Module):
             x = self.encoder(x, attention_mask, padding_mask, deterministic=deterministic)
         with jax.named_scope("final_norm"):
             out = self.final_norm(x)
+            out = shard_activation(out, "batch", "length", "embed")
             sow_stage_stats(self, "final_norm", out)
             return out
 
@@ -142,7 +157,9 @@ class SasRec(nn.Module):
     activation: str = "relu"  # reference SASRec construction pins relu (model.py:246)
     encoder_type: str = "sasrec"
     remat: bool = False
-    use_flash: Any = False  # False | True | "tiled" (long L, mask-free)
+    remat_policy: Any = None  # jax.checkpoint policy (Trainer(remat_policy=...))
+    scan_blocks: bool = False  # nn.scan over the block stack ([layers, ...] params)
+    use_flash: Any = False  # False | True | "tiled" (long L) | "ring" (seq-parallel)
     excluded_features: tuple = ()
     dtype: Any = jnp.float32
     embedding_init: Any = None  # e.g. embedding.xavier_normal_embed_init()
@@ -190,6 +207,8 @@ class SasRec(nn.Module):
             activation=self.activation,
             encoder_type=self.encoder_type,
             remat=self.remat,
+            remat_policy=self.remat_policy,
+            scan_blocks=self.scan_blocks,
             use_flash=self.use_flash,
             excluded_features=self.excluded_features,
             dtype=self.dtype,

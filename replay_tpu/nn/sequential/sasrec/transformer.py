@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from replay_tpu.nn.attention import MultiHeadAttention, MultiHeadDifferentialAttention, RMSNorm
 from replay_tpu.nn.ffn import PointWiseFeedForward, SwiGLU
 from replay_tpu.obs.health import sow_stage_stats
+from replay_tpu.parallel.sharding import shard_activation
 
 
 class _SasRecBlock(nn.Module):
@@ -51,14 +52,60 @@ class _SasRecBlock(nn.Module):
             dtype=self.dtype,
             name="ffn",
         )(h, deterministic=deterministic)
-        return x * keep  # zero out padded positions between blocks
+        # rule-table constraint on the residual stream: keeps [B, L, E] pinned
+        # to (batch, length, embed) between blocks so XLA's sharding
+        # propagation cannot scatter the embed dim over the model axis and
+        # regather it at every projection (a no-op outside a trainer scope)
+        return shard_activation(x * keep, "batch", "length", "embed")
+
+
+class _BlockScanCell(nn.Module):
+    """One encoder block in ``lax.scan`` carry form: ``(x, *broadcast) ->
+    (x, None)`` — the cell :class:`SasRecTransformerLayer` scans over when
+    ``scan_blocks=True`` (params gain a leading ``layers`` axis)."""
+
+    num_heads: int
+    hidden_dim: int
+    dropout_rate: float = 0.0
+    activation: str = "gelu"
+    remat: bool = False
+    remat_policy: Any = None
+    use_flash: Any = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, keep, deterministic, padding_mask, causal):
+        block_cls = (
+            nn.remat(_SasRecBlock, static_argnums=(4, 6), policy=self.remat_policy)
+            if self.remat
+            else _SasRecBlock
+        )
+        x = block_cls(
+            num_heads=self.num_heads,
+            hidden_dim=self.hidden_dim,
+            dropout_rate=self.dropout_rate,
+            activation=self.activation,
+            use_flash=self.use_flash,
+            dtype=self.dtype,
+            name="block",
+        )(x, attention_mask, keep, deterministic, padding_mask, causal)
+        return x, None
 
 
 class SasRecTransformerLayer(nn.Module):
     """N pre-LN blocks: LayerNorm → MHA → residual → LayerNorm → point-wise FFN.
 
     ``remat=True`` rematerializes each block's activations on the backward pass
-    (jax.checkpoint) — the HBM-for-FLOPs trade for long sequences / big batches.
+    (jax.checkpoint) — the HBM-for-FLOPs trade for long sequences / big batches;
+    ``remat_policy`` (a ``jax.checkpoint_policies`` callable, or None = save
+    nothing) tunes what survives — ``Trainer(remat_policy=...)`` plumbs it
+    here. ``scan_blocks=True`` additionally folds the N blocks into ONE
+    ``nn.scan`` program over a stacked ``[layers, ...]`` param tree — one
+    compiled block body regardless of depth, and with remat the classic
+    scan-over-blocks checkpointing layout for deep encoders
+    (docs/performance.md "Remat: trading FLOPs for HBM"). The scanned layout
+    changes the param tree (stacked leaves under ``blocks``), so it is opt-in
+    and checkpoint formats do not mix across the flag.
     """
 
     num_blocks: int
@@ -67,22 +114,51 @@ class SasRecTransformerLayer(nn.Module):
     dropout_rate: float = 0.0
     activation: str = "gelu"
     remat: bool = False
-    use_flash: Any = False  # False | True | "tiled"
+    remat_policy: Any = None  # jax.checkpoint policy; None = recompute all
+    scan_blocks: bool = False  # one scanned block body, [layers, ...] params
+    use_flash: Any = False  # False | True | "tiled" | "ring"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(
         self,
         x: jnp.ndarray,
-        attention_mask: jnp.ndarray,  # None on the "tiled" route
+        attention_mask: jnp.ndarray,  # None on the "tiled"/"ring" routes
         padding_mask: jnp.ndarray,
         deterministic: bool = True,
         causal: bool = True,
     ) -> jnp.ndarray:
         keep = padding_mask[..., None].astype(x.dtype)
+        if self.scan_blocks:
+            # scan-over-blocks: ONE traced block body, params stacked on a
+            # leading 'layers' axis (annotated by parallel.sharding), masks
+            # and flags broadcast into every step. Health stage stats stay
+            # per-loop-block only — a scanned stack sows nothing (stacking K
+            # per-block pytrees is the payload blowup the scan path avoids).
+            scanned = nn.scan(
+                _BlockScanCell,
+                variable_axes={"params": 0, "intermediates": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,) * 5,
+                length=self.num_blocks,
+            )(
+                num_heads=self.num_heads,
+                hidden_dim=self.hidden_dim,
+                dropout_rate=self.dropout_rate,
+                activation=self.activation,
+                remat=self.remat,
+                remat_policy=self.remat_policy,
+                use_flash=self.use_flash,
+                dtype=self.dtype,
+                name="blocks",
+            )
+            x, _ = scanned(x, attention_mask, keep, deterministic, padding_mask, causal)
+            return x
         block_cls = (
             # deterministic and causal are python-level flags
-            nn.remat(_SasRecBlock, static_argnums=(4, 6)) if self.remat else _SasRecBlock
+            nn.remat(_SasRecBlock, static_argnums=(4, 6), policy=self.remat_policy)
+            if self.remat
+            else _SasRecBlock
         )
         for i in range(self.num_blocks):
             # padding_mask rides along on every route: the tiled kernel builds

@@ -7,11 +7,13 @@ and the fit/validate/predict flow of notebook 09 (SURVEY.md §3.2-3.3).
 
 TPU design — one SPMD program instead of DDP:
 
-* A :class:`jax.sharding.Mesh` over all devices with axes ``("data", "model")``.
-  Batches are sharded on ``data`` (the DDP replacement: gradients are all-reduced
-  by XLA automatically because parameters are replicated); the item-embedding table
-  can optionally be sharded on ``model`` (vocab tensor-parallelism for huge
-  catalogs, SURVEY.md §2.9 TP row) — XLA inserts the all-gathers/psums over ICI.
+* A :class:`jax.sharding.Mesh` over all devices with axes
+  ``("data", "model", "seq")``. Every placement decision — batch rows on
+  ``data``, vocab tables on ``model`` (tensor parallelism for huge catalogs,
+  SURVEY.md §2.9 TP row), sequence positions on ``seq`` (Ring Attention
+  sequence parallelism for long contexts) — derives from ONE logical-axis rule
+  table (:class:`replay_tpu.parallel.sharding.ShardingRules`); XLA inserts the
+  all-reduces/permutes over ICI.
 * ``train_step`` / ``eval_step`` are jitted once and reused; batches are
   ``device_put`` with a ``NamedSharding`` so computation follows data.
 * Static shapes everywhere: final short batches must be padded by the loader
@@ -273,38 +275,84 @@ class PreemptionHandler:
 # Mesh helpers
 # --------------------------------------------------------------------------- #
 def make_mesh(
-    devices: Optional[Sequence[jax.Device]] = None, model_parallel: int = 1
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_parallel: int = 1,
+    seq_parallel: int = 1,
+    data_parallel: Optional[int] = None,
 ) -> Mesh:
-    """All (or given) devices arranged as a ``("data", "model")`` mesh.
+    """All (or given) devices arranged as a ``("data", "model", "seq")`` mesh.
 
-    ``model_parallel`` chips shard the vocab/model axis; the rest are data
-    parallel. On a v5e-8 slice ``model_parallel=1`` gives pure DP over ICI.
+    ``model_parallel`` chips shard the vocab/model axis (the CEFusedTP table
+    layout), ``seq_parallel`` chips form the Ring Attention sequence axis, and
+    the rest are data parallel (``data_parallel`` pins the DP extent
+    explicitly; by default it absorbs every remaining chip). On a v5e-8 slice
+    the defaults give pure DP over ICI; the trivial size-1 axes cost nothing —
+    every ``PartitionSpec`` that does not name them behaves exactly as on the
+    old 2-axis mesh.
     """
     devices = list(devices) if devices is not None else jax.devices()
-    if len(devices) % model_parallel:
-        msg = f"{len(devices)} devices not divisible by model_parallel={model_parallel}"
+    if model_parallel < 1 or seq_parallel < 1:
+        msg = (
+            f"model_parallel={model_parallel} and seq_parallel={seq_parallel} "
+            "must be >= 1"
+        )
         raise ValueError(msg)
-    grid = np.array(devices).reshape(len(devices) // model_parallel, model_parallel)
-    return Mesh(grid, ("data", "model"))
+    if len(devices) % (model_parallel * seq_parallel):
+        msg = (
+            f"{len(devices)} devices not divisible by model_parallel="
+            f"{model_parallel} x seq_parallel={seq_parallel}"
+        )
+        raise ValueError(msg)
+    inferred = len(devices) // (model_parallel * seq_parallel)
+    if data_parallel is None:
+        data_parallel = inferred
+    elif data_parallel != inferred:
+        msg = (
+            f"data_parallel={data_parallel} inconsistent with {len(devices)} "
+            f"devices / (model_parallel={model_parallel} x "
+            f"seq_parallel={seq_parallel}) = {inferred}"
+        )
+        raise ValueError(msg)
+    grid = np.array(devices).reshape(data_parallel, model_parallel, seq_parallel)
+    return Mesh(grid, ("data", "model", "seq"))
 
 
-def _batch_sharding(mesh: Mesh, batch_dim_field: str = "padding_mask") -> Callable[[Any], Any]:
-    """Place a batch pytree with the leading axis sharded over ``data``.
+def _batch_sharding(
+    mesh: Mesh, rules: Any = None, batch_dim_field: str = "padding_mask"
+) -> Callable[[Any], Any]:
+    """Place a batch pytree from the rule table: rows over the ``batch`` rule's
+    mesh axis, sequence positions over the ``length`` rule's.
 
     Which leaves are data-parallel is decided by the batch dimension itself: a
     leaf whose leading axis equals ``batch[batch_dim_field]``'s is a per-row
-    tensor and shards over ``data``; anything else (e.g. a shared ``[N]``
-    negative-id pool) is replicated. Multi-host, sharded leaves are assembled
-    with ``jax.make_array_from_process_local_data`` — each process contributes
+    tensor and shards over the batch axis; anything else (e.g. a shared ``[N]``
+    negative-id pool) is replicated. A per-row leaf whose SECOND axis equals the
+    reference's sequence length additionally shards it over the ``length`` axis
+    (the SP input layout — ``[B, L]`` features arrive ``[B/dp, L/sp]`` per
+    chip). Multi-host, sharded leaves are assembled with
+    ``jax.make_array_from_process_local_data`` — each process contributes
     ITS disjoint slice (the Partitioning seam's contract) and the global batch
     is local × process_count; replicated leaves must be identical on every host.
     """
+    from replay_tpu.parallel.sharding import ShardingRules
+
+    if rules is None:
+        rules = ShardingRules.default()
     multiprocess = jax.process_count() > 1
     scale = jax.process_count() if multiprocess else 1
+    batch_axis = rules.mesh_axis("batch")
+    length_axis = rules.mesh_axis("length")
+    batch_size_div = rules.axis_size(mesh, "batch")
+    length_div = rules.axis_size(mesh, "length")
 
     def put(batch):
         reference = batch.get(batch_dim_field)
         local_batch = np.asarray(reference).shape[0] if reference is not None else None
+        seq_len = (
+            np.asarray(reference).shape[1]
+            if reference is not None and np.asarray(reference).ndim >= 2
+            else None
+        )
 
         def place(x):
             x = np.asarray(x)
@@ -312,10 +360,20 @@ def _batch_sharding(mesh: Mesh, batch_dim_field: str = "padding_mask") -> Callab
                 x.ndim >= 1
                 and local_batch is not None
                 and x.shape[0] == local_batch
-                and (local_batch * scale) % mesh.shape["data"] == 0
+                and (local_batch * scale) % max(batch_size_div, 1) == 0
             )
             if is_batch_leaf:
-                sharding = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+                axes = [batch_axis] + [None] * (x.ndim - 1)
+                if (
+                    length_axis is not None
+                    and length_div > 1
+                    and x.ndim >= 2
+                    and seq_len is not None
+                    and x.shape[1] == seq_len
+                    and seq_len % length_div == 0
+                ):
+                    axes[1] = length_axis
+                sharding = NamedSharding(mesh, P(*axes))
             else:
                 sharding = NamedSharding(mesh, P())
             if multiprocess:
@@ -397,20 +455,32 @@ def _globalize_scalars(mesh: Mesh, tree: Any) -> Any:
     return jax.tree.map(globalize, tree)
 
 
-def _params_shardings(mesh: Mesh, params: Any, shard_vocab: bool) -> Any:
-    """Replicated everywhere, except (optionally) embedding tables row-sharded
-    over the ``model`` axis — the vocab-TP story for huge catalogs."""
+# param placement is rule-table-driven: replay_tpu.parallel.sharding owns the
+# logical-axis annotations and the logical-name -> mesh-axis table (the old
+# "embedding_" path heuristic lived here; params_shardings replaced it)
 
-    def spec(path, leaf) -> NamedSharding:
-        if shard_vocab and leaf.ndim == 2:
-            path_str = jax.tree_util.keystr(path)
-            # per-feature vocab tables live under SequenceEmbedding's
-            # "embedding_<feature>" scope — positional/mask tables do not
-            if "embedding_" in path_str and leaf.shape[0] % mesh.shape["model"] == 0:
-                return NamedSharding(mesh, P("model", None))
-        return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map_with_path(spec, params)
+def _resolve_remat_policy(policy: Any):
+    """``Trainer(remat_policy=...)`` spellings → a jax.checkpoint policy
+    callable (or None = save nothing, i.e. full rematerialization)."""
+    if policy is True or policy == "full":
+        return None  # jax.checkpoint default: recompute everything
+    if isinstance(policy, str):
+        names = {
+            "dots": "checkpoint_dots",
+            "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+        }
+        if policy not in names:
+            msg = (
+                f"unknown remat_policy {policy!r}; use 'full', 'dots', "
+                "'dots_no_batch', or a jax.checkpoint_policies callable"
+            )
+            raise ValueError(msg)
+        return getattr(jax.checkpoint_policies, names[policy])
+    if callable(policy):
+        return policy
+    msg = f"remat_policy must be a string, True, or callable; got {policy!r}"
+    raise ValueError(msg)
 
 
 def _chunk_schedule(
@@ -467,7 +537,23 @@ class Trainer:
         per step to the model's ``get_logits``.
     :param optimizer: optimizer factory (default Adam 1e-3).
     :param mesh: device mesh; default = all devices, pure data parallel.
-    :param shard_vocab: shard embedding tables over the ``model`` mesh axis.
+    :param shard_vocab: shard embedding tables over the ``model`` mesh axis
+        (shorthand for the default rule table's ``vocab -> "model"`` row).
+    :param sharding_rules: a :class:`~replay_tpu.parallel.sharding.ShardingRules`
+        table mapping logical axis names (``"batch"``, ``"length"``,
+        ``"vocab"``, ...) to mesh axes. Defaults to
+        ``ShardingRules.default(shard_vocab=...)`` — batch rows over ``data``,
+        sequence positions over ``seq``, vocab tables over ``model`` when
+        ``shard_vocab``. EVERY placement (params, optimizer state, batches,
+        activation constraints, the CEFusedTP table layout) derives from this
+        one table (docs/distributed_and_serving.md "One rule table").
+    :param remat_policy: activation checkpointing for the encoder stack:
+        ``None`` (off) / ``"full"`` (save nothing across blocks) / ``"dots"``
+        (save MXU outputs only) / ``"dots_no_batch"`` / a
+        ``jax.checkpoint_policies`` callable. The model is cloned with
+        ``remat=True`` and the policy plumbed into its ``nn.remat``-wrapped
+        blocks — the HBM-for-FLOPs trade the L=1024 bench rows A/B
+        (docs/performance.md "Remat: trading FLOPs for HBM").
     :param precision: mixed-precision rung (``"bf16"`` / ``"f32"`` /
         :class:`~replay_tpu.nn.Precision`): bf16 activations+compute with f32
         master params, optimizer state and loss accumulation — loss-scale-free
@@ -482,6 +568,12 @@ class Trainer:
     optimizer: OptimizerFactory = field(default_factory=OptimizerFactory)
     mesh: Optional[Mesh] = None
     shard_vocab: bool = False
+    # the ONE logical-axis rule table (parallel.sharding); None = the default
+    # DP×TP×SP table derived from shard_vocab
+    sharding_rules: Optional[Any] = None
+    # activation checkpointing over the transformer blocks: None | "full" |
+    # "dots" | "dots_no_batch" | a jax.checkpoint_policies callable
+    remat_policy: Optional[Any] = None
     seed: int = 0
     feature_field: str = "feature_tensors"
     padding_mask_field: str = "padding_mask"
@@ -538,10 +630,54 @@ class Trainer:
             # field while params (and therefore optimizer state, gradients and
             # the sentinel arithmetic) stay f32 — loss-scale-free on TPU
             self.model = self.precision.apply_to_model(self.model)
+        if self.remat_policy is not None:
+            # activation-checkpointed blocks: clone the model with remat on
+            # and the policy plumbed to its nn.remat-wrapped encoder stack
+            if not hasattr(self.model, "remat"):
+                msg = (
+                    f"remat_policy={self.remat_policy!r} needs a model with a "
+                    f"remat field (SasRec/Bert4Rec); {type(self.model).__name__} "
+                    "has none"
+                )
+                raise ValueError(msg)
+            policy = _resolve_remat_policy(self.remat_policy)
+            self.model = self.model.clone(remat=True, remat_policy=policy)
         if self.mesh is None:
             self.mesh = make_mesh()
+        from replay_tpu.parallel.sharding import ShardingRules
+
+        if self.sharding_rules is None:
+            rules = ShardingRules.default(shard_vocab=self.shard_vocab)
+            # hand-built legacy meshes may lack an axis the default table
+            # names (e.g. a bare ("data", "model") mesh has no "seq"): the
+            # DEFAULT table degrades those rules to replicated; an EXPLICIT
+            # table still validates strictly
+            mesh_axes = set(dict(self.mesh.shape))
+            for logical, target in list(rules.rules.items()):
+                targets = target if isinstance(target, tuple) else (target,)
+                if any(axis is not None and axis not in mesh_axes for axis in targets):
+                    rules = rules.with_rule(logical, None)
+            self.sharding_rules = rules
+        self.sharding_rules.validate(self.mesh)
+        if (
+            self.sharding_rules.axis_size(self.mesh, "length") > 1
+            and getattr(self.model, "use_flash", None) != "ring"
+        ):
+            # sequence parallelism without the ring route would make XLA
+            # all-gather the full sequence for every [B, 1, L, L] attention —
+            # exactly the collective the SP path exists to avoid
+            msg = (
+                "sharding rule 'length' maps to a "
+                f"{self.sharding_rules.axis_size(self.mesh, 'length')}-way mesh "
+                "axis, but the model does not route attention through ring "
+                "attention. Construct it with use_flash='ring' "
+                "(SasRec/Bert4Rec), or drop seq_parallel from the mesh."
+            )
+            raise ValueError(msg)
         self._tx = self.optimizer.create()
-        self._put_batch = _batch_sharding(self.mesh, self.padding_mask_field)
+        self._put_batch = _batch_sharding(
+            self.mesh, self.sharding_rules, self.padding_mask_field
+        )
         self._train_step = None
         self._train_scan = None
         # {name: (jitted_fn, abstract arg templates)} — ShapeDtypeStruct
@@ -603,10 +739,15 @@ class Trainer:
             return hidden
 
         if params is None:
-            params = self.model.init(
-                {"params": init_rng, "dropout": init_rng}, method=init_fn
-            )["params"]
-        shardings = _params_shardings(self.mesh, params, self.shard_vocab)
+            from replay_tpu.parallel.sharding import sharding_scope
+
+            with sharding_scope(self.sharding_rules, self.mesh):
+                params = self.model.init(
+                    {"params": init_rng, "dropout": init_rng}, method=init_fn
+                )["params"]
+        from replay_tpu.parallel.sharding import params_shardings
+
+        shardings = params_shardings(self.mesh, params, self.sharding_rules)
         params = _place_tree(jax.tree.map(np.asarray, params), shardings)
         opt_state = self._tx.init(params)
         if jax.process_count() > 1:
@@ -632,6 +773,22 @@ class Trainer:
         wrapper's introspection trick, replay/nn/lightning/module.py:59)."""
         pool = {**batch, **overrides}
         return {name: pool[name] for name in self._forward_params if name in pool}
+
+    def _scoped(self, fn):
+        """``fn`` traced under the rule-table sharding scope: model bodies'
+        ``shard_activation`` constraints resolve against THIS trainer's
+        (rules, mesh), and the ring-attention route reads its mesh + seq axis
+        from the same scope. The context is entered at trace time (inside
+        jit), so the python-level scope costs nothing at run time."""
+        from replay_tpu.parallel.sharding import sharding_scope
+
+        rules, mesh = self.sharding_rules, self.mesh
+
+        def scoped(*args, **kwargs):
+            with sharding_scope(rules, mesh):
+                return fn(*args, **kwargs)
+
+        return scoped
 
     # -- program introspection (obs.profile / obs.roofline) ----------------- #
     def _record_template(self, name: str, jitted_fn, *args) -> None:
@@ -744,7 +901,24 @@ class Trainer:
             raise ValueError(msg)
         if getattr(loss, "needs_mesh", False):
             # vocab-sharded losses (CEFusedTP) shard_map over the trainer mesh
+            # with their axes taken from the ONE rule table: the catalog over
+            # the "vocab" rule, the flattened [B·L, E] rows over the batch
+            # (× length, under SP) axes — the loss carries no layout of its own
             loss.mesh = self.mesh
+            rules = self.sharding_rules
+            if hasattr(loss, "axis_name"):
+                vocab_axis = rules.mesh_axis("vocab")
+                if vocab_axis is not None:
+                    loss.axis_name = vocab_axis
+            if hasattr(loss, "data_axis"):
+                row_axes = tuple(
+                    axis
+                    for logical in ("batch", "length")
+                    for axis in [rules.mesh_axis(logical)]
+                    if axis is not None and rules.axis_size(self.mesh, logical) > 1
+                )
+                if row_axes:
+                    loss.data_axis = row_axes if len(row_axes) > 1 else row_axes[0]
         label_f, tmask_f, neg_f = self.label_field, self.target_mask_field, self.negative_field
         pad_f = self.padding_mask_field
 
@@ -925,7 +1099,7 @@ class Trainer:
             )
             return new_state, metrics
 
-        return train_step
+        return self._scoped(train_step)
 
     def _h2d_span(self):
         """A ``h2d`` span when an enabled tracer is attached, else a no-op."""
@@ -1046,11 +1220,20 @@ class Trainer:
 
     def _put_stacked(self, stacked: Batch) -> Batch:
         """Device placement for a [K, ...] stack of batches: the per-row leaves
-        shard over ``data`` on their SECOND axis (axis 0 is the scan axis)."""
+        shard on their SECOND axis over the ``batch`` rule's mesh axis (axis 0
+        is the scan axis) and — under SP — their THIRD (sequence) axis over the
+        ``length`` rule's."""
         multiprocess = jax.process_count() > 1
         scale = jax.process_count() if multiprocess else 1
+        rules = self.sharding_rules
+        batch_axis = rules.mesh_axis("batch")
+        length_axis = rules.mesh_axis("length")
+        batch_div = max(rules.axis_size(self.mesh, "batch"), 1)
+        length_div = rules.axis_size(self.mesh, "length")
         reference = stacked.get(self.padding_mask_field)
-        local_batch = np.asarray(reference).shape[1] if reference is not None else None
+        reference = np.asarray(reference) if reference is not None else None
+        local_batch = reference.shape[1] if reference is not None else None
+        seq_len = reference.shape[2] if reference is not None and reference.ndim >= 3 else None
 
         def place(x):
             x = np.asarray(x)
@@ -1058,10 +1241,20 @@ class Trainer:
                 x.ndim >= 2
                 and local_batch is not None
                 and x.shape[1] == local_batch
-                and (local_batch * scale) % self.mesh.shape["data"] == 0
+                and (local_batch * scale) % batch_div == 0
             )
             if is_batch_leaf:
-                sharding = NamedSharding(self.mesh, P(None, "data", *([None] * (x.ndim - 2))))
+                axes = [None, batch_axis] + [None] * (x.ndim - 2)
+                if (
+                    length_axis is not None
+                    and length_div > 1
+                    and x.ndim >= 3
+                    and seq_len is not None
+                    and x.shape[2] == seq_len
+                    and seq_len % length_div == 0
+                ):
+                    axes[2] = length_axis
+                sharding = NamedSharding(self.mesh, P(*axes))
             else:
                 sharding = NamedSharding(self.mesh, P())
             if multiprocess:
@@ -1758,6 +1951,7 @@ class Trainer:
             optimizer=self.optimizer.name,
             learning_rate=self.optimizer.learning_rate,
             mesh={axis: int(n) for axis, n in self.mesh.shape.items()},
+            sharding_rules=self.sharding_rules.describe(),
             resumed=bool(resume and pending_restore_step is not None),
             **(self.precision.describe() if self.precision is not None else {}),
         )
@@ -2524,7 +2718,7 @@ class Trainer:
                 method=type(model).forward_inference,
             )
 
-        return jax.jit(self.compile_tracker.wrap(eval_logits, "eval_logits"))
+        return jax.jit(self.compile_tracker.wrap(self._scoped(eval_logits), "eval_logits"))
 
     def predict_logits(
         self, state: TrainState, batch: Batch, candidates: Optional[jnp.ndarray] = None
@@ -2545,10 +2739,12 @@ class Trainer:
         if self._catalog_fn is None:
             self._catalog_fn = jax.jit(
                 self.compile_tracker.wrap(
-                    lambda params, features: model.apply(
-                        {"params": params},
-                        item_feature_tensors=features,
-                        method=type(model).encode_items,
+                    self._scoped(
+                        lambda params, features: model.apply(
+                            {"params": params},
+                            item_feature_tensors=features,
+                            method=type(model).encode_items,
+                        )
                     ),
                     "encode_items",
                 )
@@ -2568,7 +2764,7 @@ class Trainer:
                 )
 
             self._query_embeddings_fn = jax.jit(
-                self.compile_tracker.wrap(embed, "query_embeddings")
+                self.compile_tracker.wrap(self._scoped(embed), "query_embeddings")
             )
         return self._query_embeddings_fn
 
@@ -2702,11 +2898,13 @@ class Trainer:
         state for the new shapes (step/rng carry over)."""
         from replay_tpu.nn.vocabulary import resize_item_embeddings
 
+        from replay_tpu.parallel.sharding import params_shardings
+
         params = resize_item_embeddings(
             jax.tree.map(np.asarray, state.params), self.model.schema, new_cardinality,
             init_tensor,
         )
-        shardings = _params_shardings(self.mesh, params, self.shard_vocab)
+        shardings = params_shardings(self.mesh, params, self.sharding_rules)
         params = _place_tree(params, shardings)
         self._train_step = None  # shapes changed: retrace
         self._train_scan = None

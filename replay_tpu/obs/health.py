@@ -301,7 +301,8 @@ def health_metrics(
         }
     if config.embedding_coverage:
         # feature VOCAB tables only — the "embedding_<feature>" naming
-        # convention _params_shardings shards by. Positional/mask tables are
+        # convention the sharding rule table annotates as ("vocab", "embed")
+        # (parallel.sharding.logical_axes). Positional/mask tables are
         # touched every batch and would inflate the fraction-of-catalog-rows
         # signal this exists to provide (meaningful under sampled losses).
         def is_vocab_table(path_str: str, leaf) -> bool:

@@ -466,6 +466,9 @@ def summarize_events(
                 # padding-waste and feed-efficiency measurements
                 "effective_tokens_per_sec", "padding_fraction",
                 "segments_per_row", "rows_on_disk", "shard",
+                # the DP×TP×SP long-context rows: attention route, mesh grid
+                # and the remat A/B flag the pair gate keys on
+                "attention", "mesh", "remat", "ring_max_err",
                 # static program analyses (obs.roofline / parallel.introspect)
                 "roofline_bound", "roofline_ceiling_tflops",
                 "of_roofline_ceiling", "arithmetic_intensity",
@@ -507,6 +510,32 @@ def summarize_events(
         pairs[head] = pair
     summary["precision_pairs"] = pairs or None
 
+    # the remat pair view (<base>_remat_{off,on} bench rows): activation
+    # checkpointing exists to MOVE bytes — the pair is the evidence, and
+    # --compare gates remat-on's hbm_peak_bytes below remat-off's (the static
+    # memory_analysis holds on CPU too, unlike the bf16 byte claim)
+    remat_pairs: Dict[str, Any] = {}
+    for name, row in rows_by_name.items():
+        if not name.endswith("_remat_on") or row.get("error"):
+            continue
+        base_name = name[: -len("_remat_on")]
+        base = rows_by_name.get(f"{base_name}_remat_off")
+        if not base or base.get("error"):
+            continue
+        pair = {
+            "off_hbm_peak_bytes": _finite(base.get("hbm_peak_bytes")),
+            "on_hbm_peak_bytes": _finite(row.get("hbm_peak_bytes")),
+            "off_step_ms": _finite(base.get("step_ms")),
+            "on_step_ms": _finite(row.get("step_ms")),
+            "backend": row.get("backend"),
+        }
+        if pair["off_hbm_peak_bytes"] and pair["on_hbm_peak_bytes"] is not None:
+            pair["hbm_saved_fraction"] = (
+                1.0 - pair["on_hbm_peak_bytes"] / pair["off_hbm_peak_bytes"]
+            )
+        remat_pairs[base_name] = pair
+    summary["remat_pairs"] = remat_pairs or None
+
     # peak device memory: fit telemetry first, then the bench record, then the
     # largest non-error suite row — the --compare lower-better gate's input
     peak_memory = _finite(fit_end.get("peak_memory_bytes"))
@@ -529,7 +558,7 @@ def summarize_events(
             key: record.get(key)
             for key in (
                 "mesh", "losses", "psum", "sp_ring_err", "spans", "backend",
-                "collectives", "sharding", "processes",
+                "collectives", "sharding", "processes", "mesh3",
             )
             if key in record
         }
@@ -979,6 +1008,22 @@ def render(summary: Mapping[str, Any]) -> str:
                 # the byte win is a TPU claim: CPU materializes f32 converts
                 parts.append("[cpu smoke: byte win not expected]")
             lines.append(f"  precision ladder [{head}]: " + " · ".join(parts))
+    remat_pairs = summary.get("remat_pairs")
+    if remat_pairs:
+        for base_name, pair in sorted(remat_pairs.items()):
+            if not isinstance(pair, Mapping):
+                continue
+            parts = []
+            off_hbm, on_hbm = pair.get("off_hbm_peak_bytes"), pair.get("on_hbm_peak_bytes")
+            if off_hbm is not None and on_hbm is not None:
+                parts.append(f"HBM {off_hbm / 1e6:.1f}→{on_hbm / 1e6:.1f} MB")
+                saved = pair.get("hbm_saved_fraction")
+                if saved is not None:
+                    parts.append(f"({saved:+.1%} saved)")
+            off_ms, on_ms = pair.get("off_step_ms"), pair.get("on_step_ms")
+            if off_ms is not None and on_ms is not None:
+                parts.append(f"step {off_ms:.3f}→{on_ms:.3f} ms")
+            lines.append(f"  remat [{base_name}]: " + " · ".join(parts))
     serve = summary.get("serve")
     if serve:
         parts = []
@@ -1100,9 +1145,12 @@ def compare_runs(
     rows compare per row name; rows carrying an ``error`` field on either side
     are skipped (the by-design 1M plain-CE OOM row must not trip the gate),
     but a row that errors ONLY in the candidate is a regression. ``prec_*``
-    rows (the precision-ladder family) additionally gate their per-row
-    ``hbm_peak_bytes`` lower-better on ``memory_threshold`` — a precision
-    regression that only moves bytes still fails. Serving ``quant`` blocks
+    and ``*_remat_*`` rows (the precision-ladder and remat families)
+    additionally gate their per-row ``hbm_peak_bytes`` lower-better on
+    ``memory_threshold`` — a regression that only moves bytes still fails —
+    and a candidate carrying a ``<base>_remat_{off,on}`` pair must show
+    remat-on strictly below remat-off on ``hbm_peak_bytes`` (the
+    candidate-alone invariant, like the packing gate). Serving ``quant`` blocks
     gate ``recall_at_candidates`` / ``topk_match_rate`` higher-better with an
     absolute 0.005 floor.
     """
@@ -1228,10 +1276,10 @@ def compare_runs(
                 _finite(cand_row.get("effective_tokens_per_sec")),
                 _finite(base_row.get("effective_tokens_per_sec")),
             )
-        if name.startswith("prec_"):
-            # the precision-ladder rows exist to MOVE bytes: a regression
-            # that only grows hbm_peak_bytes (throughput held) must still
-            # fail — per-row lower-better on the --memory-threshold knob
+        if name.startswith("prec_") or "_remat_" in name:
+            # the precision-ladder and remat rows exist to MOVE bytes: a
+            # regression that only grows hbm_peak_bytes (throughput held)
+            # must still fail — per-row lower-better on --memory-threshold
             check_lower_better(
                 f"bench_row[{name}].hbm_peak_bytes",
                 _finite(cand_row.get("hbm_peak_bytes")),
@@ -1263,6 +1311,27 @@ def compare_runs(
                     f"({packed_rate:.0f}) fell below the unpacked "
                     f"{unpacked_row.get('row')} baseline ({unpacked_rate:.0f})"
                 )
+    # remat-pair invariant, gated on the CANDIDATE alone: when a run carries
+    # a <base>_remat_{off,on} pair, remat-on must carry LOWER hbm_peak_bytes
+    # — activation checkpointing that stops moving bytes is a regression
+    # regardless of the baseline run (the static memory_analysis claim holds
+    # on CPU too, unlike the bf16 byte win)
+    for pair_name, pair in (candidate.get("remat_pairs") or {}).items():
+        if not isinstance(pair, Mapping):
+            continue
+        off_hbm = _finite(pair.get("off_hbm_peak_bytes"))
+        on_hbm = _finite(pair.get("on_hbm_peak_bytes"))
+        if off_hbm is None or on_hbm is None:
+            continue
+        lines.append(
+            f"  remat[{pair_name}]: hbm_peak_bytes on={on_hbm:.0f} "
+            f"vs off={off_hbm:.0f}"
+        )
+        if on_hbm >= off_hbm:
+            regressions.append(
+                f"remat[{pair_name}] hbm_peak_bytes did not drop "
+                f"(on={on_hbm:.0f} >= off={off_hbm:.0f})"
+            )
     # anomaly-count gates: a run that skips more steps (or warns more) than
     # its baseline regressed in stability even when throughput held
     for name, label in (

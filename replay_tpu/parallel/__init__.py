@@ -7,15 +7,33 @@ from .introspect import (
 )
 from .ring import full_attention_reference, ring_attention
 from .sharded_ce import sharded_fused_lse
+from .sharding import (
+    LOGICAL_AXES,
+    ShardingRules,
+    ShardingRuleWarning,
+    logical_axes,
+    logical_axes_tree,
+    params_shardings,
+    shard_activation,
+    sharding_scope,
+)
 
 __all__ = [
+    "LOGICAL_AXES",
+    "ShardingRuleWarning",
+    "ShardingRules",
     "collective_bytes",
     "collective_inventory",
     "full_attention_reference",
     "initialize_distributed",
+    "logical_axes",
+    "logical_axes_tree",
+    "params_shardings",
     "replicas_info",
     "ring_attention",
+    "shard_activation",
     "sharding_report",
     "sharded_fused_lse",
+    "sharding_scope",
     "summarize_collectives",
 ]

@@ -197,17 +197,26 @@ def sharding_report(
     params: Any,
     mesh: Any = None,
     expect_sharded: Sequence[str] = ("embedding_",),
+    rules: Any = None,
 ) -> Dict[str, Any]:
     """Render every param leaf's PartitionSpec; flag accidental replication.
 
     Returns ``{"params": [{"path", "shape", "spec", "bytes", "replicated"}],
     "replicated_bytes", "sharded_bytes", "flags": [...]}``. A leaf is
     *replicated* when its spec names no mesh axis. ``flags`` lists the
-    failure modes a DP×TP run must not ship silently:
+    failure modes a DP×TP(×SP) run must not ship silently:
 
-    * a ≥2-D leaf whose path matches ``expect_sharded`` but lowered fully
-      replicated on a multi-device ``model`` axis (the vocab-TP table
-      degenerating into n_tp full copies);
+    * with a :class:`~replay_tpu.parallel.sharding.ShardingRules` table in
+      ``rules`` (the preferred mode): any leaf whose logical-axis annotation
+      maps to a multi-device mesh axis under the table but lowered fully
+      replicated — the rule said shard, the program did not. This is the
+      "zero accidental full replication under the rules" check the dryrun and
+      CI hard-assert. Leaves the rule table legitimately replicates (rule →
+      None, or a non-divisible dim the placement already warned about) are
+      never flagged.
+    * without ``rules`` (legacy mode): a ≥2-D leaf whose path matches
+      ``expect_sharded`` but lowered fully replicated on a multi-device
+      ``model`` axis (the vocab-TP table degenerating into n_tp full copies);
     * any leaf with no readable sharding at all (host arrays that never got
       placed).
     """
@@ -219,6 +228,25 @@ def sharding_report(
             model_axis_size = int(dict(mesh.shape).get("model", 1))
         except (TypeError, ValueError):
             model_axis_size = None
+
+    expected_axes = None
+    if rules is not None:
+        if mesh is None:
+            msg = "sharding_report(rules=...) needs the mesh to size the rules"
+            raise ValueError(msg)
+        from replay_tpu.parallel.sharding import logical_axes
+
+        def rule_expectation(path, leaf):
+            """Mesh axes the table wants for this leaf (divisible dims only —
+            the same resolved_axis decision param placement made)."""
+            names = logical_axes(path, leaf)
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            return tuple(
+                rules.resolved_axis(mesh, name, dim)
+                for name, dim in zip(names, shape)
+            )
+
+        expected_axes = rule_expectation
 
     table: List[Dict[str, Any]] = []
     flags: List[str] = []
@@ -247,6 +275,13 @@ def sharding_report(
             sharded_bytes += nbytes
         if sharding is None:
             flags.append(f"{path_str}: no sharding readable (never placed?)")
+        elif expected_axes is not None:
+            wanted = expected_axes(path, leaf)
+            if replicated and any(axis is not None for axis in wanted):
+                flags.append(
+                    f"{path_str}: fully replicated {list(shape)} but the rule "
+                    f"table wants {wanted} (accidental replication)"
+                )
         elif (
             replicated
             and len(shape) >= 2

@@ -56,16 +56,26 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = False,
     padding_mask: Optional[jnp.ndarray] = None,
+    data_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Exact multi-head attention with the sequence axis sharded over ``axis_name``.
 
     :param q, k, v: [B, L, H, D] GLOBAL arrays (sharded or to-be-sharded on L).
     :param padding_mask: optional [B, L] bool, True at real tokens.
+    :param data_axis: mesh axis the BATCH dim stays sharded over (the DP×SP
+        production layout — omitting it on a mesh whose batch is data-sharded
+        would silently all-gather the batch into every ring shard).
     :return: [B, L, H, D] attention output, sharded like ``q``.
     """
     n_shards = mesh.shape[axis_name]
     if q.shape[1] % n_shards:
         msg = f"Sequence length {q.shape[1]} not divisible by {n_shards} ring shards"
+        raise ValueError(msg)
+    if data_axis is not None and q.shape[0] % mesh.shape[data_axis]:
+        msg = (
+            f"Batch {q.shape[0]} not divisible by the {mesh.shape[data_axis]}-way "
+            f"{data_axis!r} axis"
+        )
         raise ValueError(msg)
     local_len = q.shape[1] // n_shards
 
@@ -102,11 +112,11 @@ def ring_attention(
         return o / jnp.maximum(l, 1e-30)[..., None]
 
     pad = padding_mask if padding_mask is not None else jnp.ones(q.shape[:2], bool)
-    spec = P(None, axis_name, None, None)
+    spec = P(data_axis, axis_name, None, None)
     return shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec, P(None, axis_name)),
+        in_specs=(spec, spec, spec, P(data_axis, axis_name)),
         out_specs=spec,
         check_rep=False,
     )(q, k, v, pad)

@@ -64,7 +64,10 @@ def sharded_fused_lse(
     :param table: ``[num_items, E]`` item embeddings (logically global; under
         ``shard_vocab`` the rows are already placed ``P(axis_name, None)`` and
         shard_map keeps them in place).
-    :param data_axis: mesh axis the rows are data-parallel over; ``None``
+    :param data_axis: mesh axis the rows are data-parallel over — a single
+        name, or a TUPLE of names for rows flattened from several sharded
+        dims (the DP×SP fit's ``[B·L, E]`` hidden states stay sharded over
+        ``("data", "seq")``; the trainer's rule table picks this). ``None``
         replicates the rows on every shard group (single-axis TP meshes).
     :return: ``[N]`` float32 log-sum-exp values, numerically equal to the
         replicated :func:`~replay_tpu.ops.fused_ce.fused_lse` up to the
@@ -76,14 +79,18 @@ def sharded_fused_lse(
     n_tp = mesh.shape[axis_name]
     num_items, _ = table.shape
     if data_axis is not None:
-        n_data = mesh.shape.get(data_axis)
-        if n_data is None:
-            msg = f"mesh {dict(mesh.shape)} has no {data_axis!r} axis for the rows"
-            raise ValueError(msg)
+        row_axes = data_axis if isinstance(data_axis, tuple) else (data_axis,)
+        n_data = 1
+        for axis in row_axes:
+            size = mesh.shape.get(axis)
+            if size is None:
+                msg = f"mesh {dict(mesh.shape)} has no {axis!r} axis for the rows"
+                raise ValueError(msg)
+            n_data *= size
         if hidden.shape[0] % n_data:
             msg = (
                 f"sharded_fused_lse: {hidden.shape[0]} rows do not divide over "
-                f"the {n_data}-way {data_axis!r} axis"
+                f"the {n_data}-way {data_axis!r} axes"
             )
             raise ValueError(msg)
     pad = -num_items % n_tp

@@ -308,9 +308,16 @@ def test_health_disabled_step_lowers_to_golden_hlo():
         )
         return new_state, {"loss": loss_value, "good": good, "grad_norm": grad_norm}
 
-    golden = _strip_module_name(
-        jax.jit(golden_step, donate_argnums=0).lower(state, placed).as_text()
-    )
+    # the golden traces under the SAME rule-table sharding scope the trainer
+    # installs around its programs (parallel.sharding): the model bodies'
+    # shard_activation constraints are part of the production step by design
+    # — what this golden pins is that the HEALTH machinery adds nothing
+    from replay_tpu.parallel.sharding import sharding_scope
+
+    with sharding_scope(trainer.sharding_rules, trainer.mesh):
+        golden = _strip_module_name(
+            jax.jit(golden_step, donate_argnums=0).lower(state, placed).as_text()
+        )
     disabled = _strip_module_name(
         jax.jit(trainer._build_train_step(None), donate_argnums=0)
         .lower(state, placed)
