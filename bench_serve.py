@@ -34,6 +34,13 @@ construction, so the timed phases never trace):
   retrieved candidates). The ``quant`` block records recall@C of the int8
   sweep, end-to-end top-k agreement, per-batch rank latency and the 4× table-
   bytes ratio; ``obs.report --compare`` gates recall/topk-match higher-better;
+* **swap under load** (``REPLAY_TPU_SERVE_SWAPS=N``) — N hot weight swaps
+  (``serve.promote``: publish a perturbed same-shape candidate → promote,
+  zero recompilation) while closed-loop clients keep scoring. The ``swap``
+  block records p50/p99 across the phase, the zero-request-errors claim, the
+  generation tags observed and the publish→promote apply time;
+  ``obs.report --compare`` gates ``swap_p99_ms`` lower-better when both runs
+  ran the phase;
 * **chaos** (``--chaos`` / ``REPLAY_TPU_SERVE_CHAOS=1``) — deterministic
   fault injection via ``replay_tpu.utils.faults``: consecutive engine errors
   trip the circuit breaker (degraded traffic rides the cache_only/fallback
@@ -116,6 +123,13 @@ BREAKER_RESET_MS = float(os.environ.get("REPLAY_TPU_SERVE_BREAKER_RESET_MS", "30
 CHAOS = (
     bool(int(os.environ.get("REPLAY_TPU_SERVE_CHAOS", "0"))) or "--chaos" in sys.argv
 )
+# swap-under-load phase (serve.promote): N hot weight swaps while closed-loop
+# clients keep scoring — proves p99 stays bounded and ZERO requests error
+# across the swaps, every response tagged with one consistent generation.
+# 0 = phase off (the default; obs.report only gates swap_p99_ms when both
+# compared runs ran it, the PR-9 phase-matching rule)
+SWAPS = int(os.environ.get("REPLAY_TPU_SERVE_SWAPS", "0"))
+SWAP_GAP_MS = float(os.environ.get("REPLAY_TPU_SERVE_SWAP_GAP_MS", "200"))
 # the live metrics plane rides every bench run: 0 = ephemeral port (the
 # default — collision-proof); -1 disables the metrics plane entirely (no
 # registry either, so the record omits its `metrics` reconciliation block —
@@ -357,6 +371,104 @@ def _run_quant_phase(model, params, item_weights, reranker_weights, rng):
         "int8_table_bytes": bytes_record["payload_bytes"],
         "f32_table_bytes": bytes_record["f32_bytes"],
         "bytes_ratio": round(bytes_record["bytes_ratio"], 4),
+    }
+
+
+def _run_swap_phase(service, one_request, model, params, users, clients):
+    """N hot weight swaps under closed-loop load (serve.promote).
+
+    Client threads score back to back while the main thread publishes and
+    promotes perturbed same-shape candidates (zero recompile — the pointer-
+    move swap; in retrieval mode each candidate ships its own rebuilt MIPS
+    pipeline, since the index embeds the generation's item table). Measures
+    request latency ACROSS the whole phase (each swap window included), and
+    records the generation tags observed — the consistency/zero-error
+    assertions the canary_smoke CI job gates on.
+    """
+    import jax
+
+    def candidate_pipeline(candidate):
+        if service.mode != "retrieval":
+            return None
+        from replay_tpu.models import MIPSIndex
+        from replay_tpu.serve import CandidatePipeline
+
+        item_weights = np.asarray(
+            model.apply({"params": candidate}, method=type(model).get_item_weights)
+        )
+        template = service.retrieval
+        return CandidatePipeline(
+            MIPSIndex(item_weights),
+            num_candidates=template.num_candidates,
+            top_k=template.top_k,
+            reranker_weights=template.reranker_weights,
+        )
+
+    latencies = []
+    errors = []
+    generations = set()
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(idx: int) -> None:
+        thread_rng = np.random.default_rng(5000 + idx)
+        while not stop.is_set():
+            user = int(thread_rng.integers(0, users))
+            started = time.perf_counter()
+            try:
+                response = one_request(thread_rng, user).result(timeout=120)
+            except Exception as exc:  # noqa: BLE001 — recorded, asserted zero
+                errors.append(repr(exc))
+                continue
+            with lock:
+                latencies.append(time.perf_counter() - started)
+                generations.add(int(response.generation))
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True) for i in range(clients)
+    ]
+    phase_start = time.perf_counter()
+    for thread in threads:
+        thread.start()
+    gap = max(SWAP_GAP_MS / 1000.0, 0.02)
+    recompiled = 0
+    swap_seconds = []
+    for swap in range(SWAPS):
+        time.sleep(gap)
+        scale = 1.0 + 1e-3 * (swap + 1)
+        candidate = jax.tree.map(
+            lambda x, s=scale: (np.asarray(x) * s).astype(np.asarray(x).dtype), params
+        )
+        swap_start = time.perf_counter()
+        generation = service.publish_candidate(
+            candidate, label=f"swap-{swap}", pipeline=candidate_pipeline(candidate)
+        )
+        if service.store.generation(generation).recompiled:
+            recompiled += 1
+        service.promote(generation)
+        swap_seconds.append(time.perf_counter() - swap_start)
+    time.sleep(gap)
+    stop.set()
+    for thread in threads:
+        thread.join(timeout=130)
+    elapsed = time.perf_counter() - phase_start
+    answered = len(latencies)
+    return {
+        "swaps": SWAPS,
+        "recompiled_swaps": recompiled,
+        "requests": answered + len(errors),
+        "answered": answered,
+        "errors": len(errors),
+        "first_error": errors[0] if errors else None,
+        "p50_ms": round(_percentile(latencies, 50) * 1000.0, 3),
+        "p99_ms": round(_percentile(latencies, 99) * 1000.0, 3),
+        "qps": round(answered / elapsed, 1) if elapsed > 0 else 0.0,
+        # publish+promote wall time: the swap itself is a pointer move, so
+        # this stays in the low milliseconds unless a recompile was needed
+        "swap_apply_ms_max": round(max(swap_seconds) * 1000.0, 3) if swap_seconds else 0.0,
+        "generations_seen": len(generations),
+        "final_generation": service.store.stable_generation,
+        "generation_misses": service.stats()["generation_misses"],
     }
 
 
@@ -691,6 +803,15 @@ def main() -> None:
         open_elapsed = time.perf_counter() - open_start
         open_qps = submitted / open_elapsed
 
+        # ---- swap-under-load: N hot weight swaps, zero errors ------------- #
+        # before overload/chaos so their induced sheds/faults cannot pollute
+        # the zero-request-errors claim the swap phase exists to prove
+        swap = None
+        if SWAPS > 0:
+            swap = _run_swap_phase(
+                service, one_request, model, params, USERS, CLIENTS
+            )
+
         # ---- overload: arrivals ≫ capacity, bounded lanes must shed ------- #
         # capacity estimate: the better of the two measured loops (a closed
         # loop with few clients is latency-bound and undersells throughput)
@@ -783,6 +904,8 @@ def main() -> None:
         record["metrics"] = metrics_record
     if quant is not None:
         record["quant"] = quant
+    if swap is not None:
+        record["swap"] = swap
     if overload is not None:
         record["overload"] = overload
     if chaos is not None:

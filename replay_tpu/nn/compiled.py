@@ -62,14 +62,44 @@ def _unflatten_params(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return nested
 
 
+def params_mismatch(template, params) -> Optional[str]:
+    """First incompatibility between ``params`` and the pytree an executable
+    was lowered with — ``None`` when a hot swap is legal (AOT programs demand
+    the exact structure, shapes and dtypes; anything else needs a recompile).
+    The returned string names the offending leaf path."""
+    tmpl_flat = _flatten_params(template)
+    new_flat = _flatten_params(params)
+    missing = sorted(set(tmpl_flat) - set(new_flat))
+    if missing:
+        return f"missing leaf {missing[0]!r}"
+    extra = sorted(set(new_flat) - set(tmpl_flat))
+    if extra:
+        return f"unexpected leaf {extra[0]!r}"
+    for path in sorted(tmpl_flat):
+        old, new = tmpl_flat[path], new_flat[path]
+        if tuple(old.shape) != tuple(new.shape):
+            return (
+                f"leaf {path!r} has shape {tuple(new.shape)}; the compiled "
+                f"program expects {tuple(old.shape)}"
+            )
+        if np.dtype(old.dtype) != np.dtype(new.dtype):
+            return (
+                f"leaf {path!r} has dtype {np.dtype(new.dtype).name}; the "
+                f"compiled program expects {np.dtype(old.dtype).name}"
+            )
+    return None
+
+
 class CompiledInference:
     """An AOT-compiled ``forward_inference`` for fixed serving shapes.
 
-    ``_compiled`` maps batch-bucket size → a callable ``(item_ids,
-    padding_mask, candidates_or_None) -> outputs`` with the params already
-    bound (live-compiled executables close over them; deserialized ones carry
-    them baked into the StableHLO as constants). Values may be ``None`` for
-    routing-only instances (bucket-selection tests).
+    ``_compiled`` maps batch-bucket size → a callable ``(params, item_ids,
+    padding_mask, candidates_or_None) -> outputs``. Params travel as program
+    ARGUMENTS (never folded constants), which is what makes
+    :meth:`swap_params` a zero-recompile hot swap: any pytree matching the
+    lowered structure/shapes/dtypes runs through the same executable
+    bit-identically. Values may be ``None`` for routing-only instances
+    (bucket-selection tests).
     """
 
     def __init__(
@@ -209,10 +239,11 @@ class CompiledInference:
                 .lower(params, ids_spec, mask_spec, cand_spec)
                 .compile()
             )
-            # bind params so every stored callable shares one convention
-            # (AOT executables demand the exact lowering pytree, None included)
+            # every stored callable shares one convention: params first, as a
+            # real program argument (AOT executables demand the exact lowering
+            # pytree, None included) — the hot-swap seam
             compiled[size] = (
-                lambda ids, mask, cands, _ex=executable: _ex(params, ids, mask, cands)
+                lambda p, ids, mask, cands, _ex=executable: _ex(p, ids, mask, cands)
             )
             executables[size] = executable
         out = cls(
@@ -320,11 +351,11 @@ class CompiledInference:
             exported = jax_export.deserialize(blob)
             if candidates_count:
                 compiled[size] = (
-                    lambda ids, mask, cands, _ex=exported: _ex.call(params, ids, mask, cands)
+                    lambda p, ids, mask, cands, _ex=exported: _ex.call(p, ids, mask, cands)
                 )
             else:
                 compiled[size] = (
-                    lambda ids, mask, cands, _ex=exported: _ex.call(params, ids, mask)
+                    lambda p, ids, mask, cands, _ex=exported: _ex.call(p, ids, mask)
                 )
         out = cls(
             compiled,
@@ -337,6 +368,33 @@ class CompiledInference:
         out._export_params = params
         return out
 
+    # -- hot swap ----------------------------------------------------------- #
+    def validate_params(self, params) -> Optional[str]:
+        """Why ``params`` can NOT hot-swap into these executables (structure /
+        shape / dtype vs the lowering pytree), or ``None`` when they can."""
+        if self._export_params is None:
+            return "instance holds no bound params (routing-only?)"
+        return params_mismatch(self._export_params, params)
+
+    def swap_params(self, params) -> None:
+        """Install ``params`` as the bound parameter set — zero recompile.
+
+        The executables were lowered with params as program arguments, so any
+        pytree matching the original structure/shapes/dtypes swaps in
+        atomically (subsequent ``__call__``\\ s use it; in-flight calls finish
+        on the params they were invoked with). A mismatch — e.g. a grown item
+        table — raises naming the offending leaf: that shape needs freshly
+        compiled executables, not a swap."""
+        mismatch = self.validate_params(params)
+        if mismatch is not None:
+            msg = (
+                f"params cannot hot-swap into the compiled executables: "
+                f"{mismatch}. A changed catalog shape needs a recompile "
+                "(CompiledInference.compile with the new params)."
+            )
+            raise ValueError(msg)
+        self._export_params = params
+
     # -- execution ---------------------------------------------------------- #
     def _bucket_for(self, batch: int) -> int:
         for size in sorted(self._compiled):
@@ -345,11 +403,14 @@ class CompiledInference:
         msg = f"Batch {batch} exceeds the largest compiled bucket {max(self._compiled)}"
         raise ValueError(msg)
 
-    def __call__(self, item_ids, padding_mask, candidates=None):
+    def __call__(self, item_ids, padding_mask, candidates=None, params=None):
         """Score [B, L] sequences; pads the batch up to the compiled bucket.
 
         Returns logits, hidden, or ``(logits, hidden)`` per the ``outputs``
-        mode, always cut back to the request's row count."""
+        mode, always cut back to the request's row count. ``params`` overrides
+        the bound parameter set for THIS call (same structure/shapes required
+        — the per-dispatch generation resolution the serving hot-swap path
+        uses); ``None`` uses the bound params."""
         item_ids = np.asarray(item_ids, np.int32)
         padding_mask = np.asarray(padding_mask, bool)
         batch = item_ids.shape[0]
@@ -381,7 +442,12 @@ class CompiledInference:
                     f"({self._candidates_count},)"
                 )
                 raise ValueError(msg)
-        out = self._compiled[bucket](item_ids, padding_mask, candidates)
+        out = self._compiled[bucket](
+            self._export_params if params is None else params,
+            item_ids,
+            padding_mask,
+            candidates,
+        )
         if self.outputs == "both":
             logits, hidden = out
             return logits[:batch], hidden[:batch]

@@ -1539,6 +1539,17 @@ class Trainer:
                 raise ValueError(msg)
             reject_bucketed(train_batches)
 
+        if state is not None:
+            # continual-training guard (docs/robustness.md): params grown by
+            # vocab surgery without their optimizer moments (or vice versa)
+            # must fail HERE, naming the table path — not crash deep in
+            # optax's first update or silently train on reset moments
+            from replay_tpu.nn.vocabulary import validate_optimizer_state
+
+            schema = getattr(self.model, "schema", None)
+            if schema is not None:
+                validate_optimizer_state(state.params, state.opt_state, schema)
+
         start_epoch, skip_steps, pending_restore_step = 0, 0, None
         resumed_best_step = None
         pending_stream_cursor = None  # out-of-core resume: seek, don't rescan
@@ -2892,18 +2903,50 @@ class Trainer:
         return query_ids, embeddings
 
     def resize_vocabulary(
-        self, state: TrainState, new_cardinality: int, init_tensor=None
+        self,
+        state: TrainState,
+        new_cardinality: int,
+        init_tensor=None,
+        carry_opt_state: bool = True,
+        init: str = "mean",
+        rng: Optional[jax.Array] = None,
     ) -> TrainState:
-        """Catalog growth between retrains: item-table surgery + fresh optimizer
-        state for the new shapes (step/rng carry over)."""
-        from replay_tpu.nn.vocabulary import resize_item_embeddings
+        """Catalog growth between — or DURING — retrains: item-table surgery
+        with the optimizer moments resized in lockstep.
 
+        ``carry_opt_state=True`` (default, the continual-training path) keeps
+        every trained row's Adam moments and zero-initializes the cold rows'
+        (``vocabulary.resize_optimizer_state``) so a mid-run grow neither
+        crashes deep in optax nor silently resets the optimizer; ``False``
+        restores the old between-retrains behavior (fresh ``tx.init`` state).
+        ``init`` picks the cold-row warm start when no ``init_tensor`` is
+        given: ``"mean"`` (the reference default) or ``"xavier"`` (the
+        reference's expansion recipe, ``set_item_embeddings_by_size``).
+        Step/rng carry over either way."""
+        from replay_tpu.nn.vocabulary import (
+            resize_item_embeddings,
+            set_item_embeddings_by_size,
+        )
         from replay_tpu.parallel.sharding import params_shardings
 
-        params = resize_item_embeddings(
-            jax.tree.map(np.asarray, state.params), self.model.schema, new_cardinality,
-            init_tensor,
+        host_params = jax.tree.map(np.asarray, state.params)
+        host_opt = (
+            jax.tree.map(np.asarray, state.opt_state) if carry_opt_state else None
         )
+        if init == "xavier" and init_tensor is None:
+            result = set_item_embeddings_by_size(
+                host_params, self.model.schema, new_cardinality, rng=rng,
+                opt_state=host_opt,
+            )
+        elif init == "mean" or init_tensor is not None:
+            result = resize_item_embeddings(
+                host_params, self.model.schema, new_cardinality, init_tensor,
+                opt_state=host_opt,
+            )
+        else:
+            msg = f"unknown init {init!r}: use 'mean' or 'xavier'"
+            raise ValueError(msg)
+        params, resized_opt = result if carry_opt_state else (result, None)
         shardings = params_shardings(self.mesh, params, self.sharding_rules)
         params = _place_tree(params, shardings)
         self._train_step = None  # shapes changed: retrace
@@ -2912,6 +2955,20 @@ class Trainer:
         self._query_embeddings_fn = None
         self._catalog_fn = None
         opt_state = self._tx.init(params)
+        if carry_opt_state:
+            # the fresh init is the SHAPE/placement template only: carried
+            # host moments land leaf-by-leaf on its shardings (moments keep
+            # their vocab sharding like a checkpoint restore would). Only
+            # MESH shardings pin — uncommitted state scalars (Adam's count)
+            # must stay free or the jitted step hits a device conflict
+            def place(template, value):
+                value = np.asarray(value)
+                sharding = getattr(template, "sharding", None)
+                if isinstance(sharding, NamedSharding):
+                    return jax.device_put(value, sharding)
+                return jnp.asarray(value)
+
+            opt_state = jax.tree.map(place, opt_state, resized_opt)
         if jax.process_count() > 1:
             opt_state = _globalize_scalars(self.mesh, opt_state)
         return TrainState(
@@ -2921,6 +2978,36 @@ class Trainer:
             rng=state.rng,
             bad_steps=state.bad_steps,
         )
+
+    def finetune(
+        self,
+        state: TrainState,
+        train_batches,
+        new_cardinality: Optional[int] = None,
+        init: str = "xavier",
+        epochs: int = 1,
+        **fit_kwargs,
+    ) -> TrainState:
+        """The continual-training entry (docs/robustness.md "Zero-downtime
+        swaps and canary promotion"): optionally grow the catalog —
+        optimizer-state-safe, xavier warm start for the cold rows — then fit
+        from the given trained state on the fresh interaction tail. A thin,
+        named seam so the promotion driver and the replay harness share one
+        code path with plain ``fit``."""
+        schema = self.model.schema
+        feature_name = schema.item_id_feature_name
+        if new_cardinality is not None and feature_name is not None:
+            if new_cardinality < schema[feature_name].cardinality:
+                msg = (
+                    f"finetune cannot shrink the catalog "
+                    f"({schema[feature_name].cardinality} -> {new_cardinality})"
+                )
+                raise ValueError(msg)
+            if new_cardinality > schema[feature_name].cardinality:
+                state = self.resize_vocabulary(
+                    state, new_cardinality, carry_opt_state=True, init=init
+                )
+        return self.fit(train_batches, epochs=epochs, state=state, **fit_kwargs)
 
     def _set_lr_scale(self, scale: float) -> None:
         """Rebuild the optimizer with the base learning rate scaled by

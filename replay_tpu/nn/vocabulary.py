@@ -1,4 +1,4 @@
-"""Item-vocabulary surgery on trained parameters.
+"""Item-vocabulary surgery on trained parameters (and optimizer state).
 
 Capability parity with the reference's continual-catalog operations
 (replay/models/nn/sequential/sasrec/lightning.py:493-568:
@@ -12,6 +12,18 @@ growth moves the padding row to the new end and initializes fresh rows from
 the mean of the existing embeddings (the reference's default) or a caller
 tensor. The schema object is updated in place (cardinality/padding move
 together).
+
+Mid-RUN growth (the continual-training loop, docs/robustness.md) additionally
+needs the OPTIMIZER state resized in lockstep: Adam's ``mu``/``nu`` mirror the
+params tree, so a grown table with stale moment rows either crashes deep in
+optax or — worse — silently reinitializes the moments and loses the trained
+second-moment scale. :func:`resize_optimizer_state` applies the same row
+surgery to every moment leaf at the table's path (existing rows keep their
+moments, cold rows start at zero — a fresh Adam row, exactly what a
+newly-initialized embedding row would get — and the padding row's moments move
+to the new end with it), and :func:`validate_optimizer_state` rejects a
+params/opt-state pair whose table shapes drifted apart, naming the offending
+path.
 """
 
 from __future__ import annotations
@@ -50,18 +62,111 @@ def _replace_leaf(params, target_path, new_leaf):
     return jax.tree_util.tree_map_with_path(swap, params)
 
 
+def _find_moment_leaves(opt_state, feature_name: str):
+    """Locate every optimizer-state leaf that mirrors the item table (Adam
+    ``mu``/``nu`` rows and friends): same path marker, same trailing key."""
+    marker = f"['embedding_{feature_name}']"
+    matches = []
+
+    def visit(path, leaf):
+        path_str = jax.tree_util.keystr(path)
+        if marker in path_str and path_str.endswith("['embedding']"):
+            matches.append((path, leaf))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, opt_state)
+    return matches
+
+
+def resize_optimizer_state(
+    opt_state,
+    feature_name: str,
+    old_cardinality: int,
+    new_cardinality: int,
+):
+    """Resize every item-table moment leaf in ``opt_state`` to match a table
+    grown/shrunk to ``new_cardinality`` (+1 padding row).
+
+    Existing rows keep their trained moments, cold rows get ZEROS (a fresh
+    Adam row — bias correction then treats them exactly like a
+    newly-initialized parameter), and the padding row's moments move to the
+    new last position alongside the padding row itself. Leaves whose row
+    count does not match ``old_cardinality + 1`` raise, naming the path —
+    the params/opt-state-out-of-sync guard.
+
+    Returns ``(opt_state, resized_leaf_count)``; a momentum-free optimizer
+    (plain SGD) has no table-shaped state and returns the input unchanged
+    with count 0.
+    """
+    resized = 0
+    for path, leaf in _find_moment_leaves(opt_state, feature_name):
+        table = np.asarray(leaf)
+        rows, _ = table.shape
+        if rows != old_cardinality + 1:
+            msg = (
+                f"Optimizer-state leaf at {jax.tree_util.keystr(path)} has "
+                f"{rows} rows; the params table says {old_cardinality}+1 — "
+                "params and optimizer state are out of sync (was the table "
+                "resized without its moments?)."
+            )
+            raise ValueError(msg)
+        items, padding_row = table[:old_cardinality], table[old_cardinality:]
+        if new_cardinality <= old_cardinality:
+            new_items = items[:new_cardinality]
+        else:
+            cold = np.zeros((new_cardinality - old_cardinality, table.shape[1]), table.dtype)
+            new_items = np.concatenate([items, cold])
+        new_leaf = np.concatenate([new_items, padding_row]).astype(table.dtype)
+        opt_state = _replace_leaf(opt_state, path, new_leaf)
+        resized += 1
+    return opt_state, resized
+
+
+def validate_optimizer_state(params, opt_state, schema: TensorSchema) -> None:
+    """Reject a ``(params, opt_state)`` pair whose item-table shapes drifted
+    apart — the guard a resumed/continued fit runs BEFORE training, so a
+    mid-run catalog grow with stale optimizer state fails loudly (naming the
+    table path) instead of crashing deep in optax or silently reinitializing
+    the moments. Schemas without an ITEM_ID feature validate trivially."""
+    feature_name = schema.item_id_feature_name
+    if feature_name is None:
+        return
+    try:
+        table_shape = np.shape(_find_table_path(params, feature_name)[0][1])
+    except ValueError:
+        return  # no item table in this model's params (nothing to check)
+    for path, leaf in _find_moment_leaves(opt_state, feature_name):
+        if tuple(np.shape(leaf)) != tuple(table_shape):
+            msg = (
+                f"Optimizer-state leaf at {jax.tree_util.keystr(path)} has shape "
+                f"{tuple(np.shape(leaf))} but the item table "
+                f"'embedding_{feature_name}' is {tuple(table_shape)} — the "
+                "catalog was resized without its optimizer moments. Resize "
+                "both together (Trainer.resize_vocabulary(carry_opt_state=True) "
+                "or vocabulary.resize_optimizer_state) before fitting."
+            )
+            raise ValueError(msg)
+
+
 def resize_item_embeddings(
     params,
     schema: TensorSchema,
     new_cardinality: int,
     init_tensor: Optional[np.ndarray] = None,
-) -> dict:
+    opt_state=None,
+):
     """Grow (or shrink) the item table to ``new_cardinality`` (+1 padding row).
 
     Existing item rows are preserved; new rows come from ``init_tensor`` when
     given (``[new_items, E]`` for the appended rows or ``[new_cardinality, E]``
     for a full replacement) else from the mean of the existing rows. The
     schema's ITEM_ID cardinality (and its default padding value) is updated.
+
+    With ``opt_state`` supplied the matching optimizer moments are resized in
+    LOCKSTEP (:func:`resize_optimizer_state`: trained rows keep their moments,
+    cold rows start at zero) and ``(params, opt_state)`` is returned — the
+    mid-run growth path; without it, just the resized ``params`` (the
+    between-retrains path, where fresh optimizer state is built anyway).
     """
     feature_name = schema.item_id_feature_name
     if feature_name is None:
@@ -109,6 +214,11 @@ def resize_item_embeddings(
     schema[feature_name]._set_cardinality(new_cardinality)
     # let the padding default re-resolve to the new cardinality (last-row invariant)
     schema[feature_name]._padding_value = None
+    if opt_state is not None:
+        opt_state, _ = resize_optimizer_state(
+            opt_state, feature_name, old_cardinality, new_cardinality
+        )
+        return params, opt_state
     return params
 
 
@@ -136,7 +246,8 @@ def set_item_embeddings_by_size(
     schema: TensorSchema,
     new_cardinality: int,
     rng: Optional[jax.Array] = None,
-) -> dict:
+    opt_state=None,
+):
     """Grow to ``new_cardinality`` with xavier-normal rows for the NEW items —
     the reference's expansion recipe (lightning.py:507-523: keep fitted rows,
     ``xavier_normal_`` the rest). ``resize_item_embeddings`` with no tensor
@@ -161,7 +272,9 @@ def set_item_embeddings_by_size(
     fresh = np.asarray(
         jax.random.normal(key, (new_cardinality - old_cardinality, dim), np.float32)
     ) * std
-    return resize_item_embeddings(params, schema, new_cardinality, fresh)
+    return resize_item_embeddings(
+        params, schema, new_cardinality, fresh, opt_state=opt_state
+    )
 
 
 def get_item_embeddings(params, schema: TensorSchema) -> np.ndarray:

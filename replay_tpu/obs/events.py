@@ -423,6 +423,29 @@ class ConsoleLogger(RunLogger):
                 event.payload.get("to"),
                 event.payload.get("reason"),
             )
+        elif event.event == "on_swap":
+            logger.info(
+                "weight swap (%s): generation %s -> %s%s",
+                event.payload.get("reason"),
+                event.payload.get("from_generation"),
+                event.payload.get("to_generation"),
+                " [recompiled]" if event.payload.get("recompiled") else "",
+            )
+        elif event.event == "on_promotion":
+            logger.info(
+                "canary PROMOTED: generation %s (from %s) after %s clean "
+                "evaluation(s)",
+                event.payload.get("generation"),
+                event.payload.get("from_generation"),
+                event.payload.get("clean_evals"),
+            )
+        elif event.event == "on_rollback":
+            logger.warning(
+                "canary ROLLED BACK: generation %s -> %s (rules: %s)",
+                event.payload.get("generation"),
+                event.payload.get("restored_generation"),
+                ", ".join(event.payload.get("rules") or []) or "<manual>",
+            )
         elif event.event == "on_epoch_end":
             logger.info("epoch %s: %s", event.epoch, event.payload.get("record"))
         elif event.event == "on_serve_end":

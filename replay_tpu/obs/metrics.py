@@ -572,5 +572,35 @@ class MetricsLogger(RunLogger):
                 "replay_slo_recoveries_total",
                 labels={"rule": str(payload.get("rule"))},
             )
+        # the promotion family (serve.promote): hot swaps, canary evaluation
+        # gauges and the promote/rollback verdicts — replayable from
+        # events.jsonl into the same replay_canary_* series the live
+        # controller maintains
+        elif name == "on_publish":
+            self.registry.inc("replay_publish_total")
+            if payload.get("recompiled"):
+                self.registry.inc("replay_publish_recompiled_total")
+        elif name == "on_swap":
+            self.registry.inc("replay_swap_total")
+            self._gauge("replay_param_generation", payload.get("to_generation"))
+        elif name == "on_canary_start":
+            self.registry.set("replay_canary_stage", 2.0)
+            self._gauge("replay_canary_generation", payload.get("generation"))
+        elif name == "on_canary_eval":
+            self._gauge("replay_canary_generation", payload.get("generation"))
+            self._gauge("replay_canary_error_rate", payload.get("error_rate"))
+            self._gauge("replay_canary_clean_evals", payload.get("clean_evals"))
+            window = payload.get("window")
+            if isinstance(window, Mapping):
+                self._gauge("replay_canary_requests", window.get("requests"))
+        elif name == "on_promotion":
+            self.registry.inc("replay_promotions_total")
+            self.registry.set("replay_canary_stage", 3.0)
+        elif name == "on_rollback":
+            self.registry.inc("replay_rollbacks_total")
+            self.registry.set("replay_canary_stage", -1.0)
+            self._gauge(
+                "replay_param_generation", payload.get("restored_generation")
+            )
         if evaluate and self.watchdog is not None:
             self.watchdog.evaluate(step=event.step)

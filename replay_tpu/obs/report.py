@@ -279,6 +279,13 @@ def summarize_events(
         "slo_recoveries": sum(
             1 for e in events if e.get("event") == "on_slo_recovery"
         ),
+        # the promotion loop (serve.promote): swaps are routine, rollbacks are
+        # the lower-better --compare gate (a healthy continual run rolls
+        # nothing back, so ANY candidate rollback against a clean baseline
+        # fires — same zero-baseline rule as slo_violations)
+        "swaps": sum(1 for e in events if e.get("event") == "on_swap"),
+        "promotions": sum(1 for e in events if e.get("event") == "on_promotion"),
+        "rollbacks": sum(1 for e in events if e.get("event") == "on_rollback"),
     }
     summary["slo_rules_fired"] = sorted(
         {
@@ -290,6 +297,57 @@ def summarize_events(
     summary["backend"] = next(
         (e["backend"] for e in events if isinstance(e.get("backend"), str)), None
     )
+
+    # the promotion record (canary lifecycle): publishes, the canary verdict
+    # trail and the last generation each pointer landed on
+    promotion_events = [
+        e for e in events
+        if e.get("event") in (
+            "on_publish", "on_swap", "on_canary_start", "on_canary_eval",
+            "on_promotion", "on_rollback",
+        )
+    ]
+    if promotion_events:
+        swaps = [e for e in promotion_events if e.get("event") == "on_swap"]
+        evals = [e for e in promotion_events if e.get("event") == "on_canary_eval"]
+        promotion: Dict[str, Any] = {
+            "publishes": sum(
+                1 for e in promotion_events if e.get("event") == "on_publish"
+            ),
+            "recompiled_publishes": sum(
+                1 for e in promotion_events
+                if e.get("event") == "on_publish" and e.get("recompiled")
+            ),
+            "canaries": sum(
+                1 for e in promotion_events if e.get("event") == "on_canary_start"
+            ),
+            "canary_evals": len(evals),
+            "swaps": summary["swaps"],
+            "promotions": summary["promotions"],
+            "rollbacks": summary["rollbacks"],
+        }
+        if swaps:
+            promotion["last_generation"] = swaps[-1].get("to_generation")
+        if evals:
+            last_eval = evals[-1]
+            promotion["last_canary_error_rate"] = _finite(
+                last_eval.get("error_rate")
+            )
+            promotion["last_clean_evals"] = last_eval.get("clean_evals")
+        rollbacks = [
+            e for e in promotion_events if e.get("event") == "on_rollback"
+        ]
+        if rollbacks:
+            promotion["rollback_rules"] = sorted(
+                {
+                    str(rule)
+                    for e in rollbacks
+                    for rule in (e.get("rules") or [])
+                }
+            )
+        summary["promotion"] = promotion
+    else:
+        summary["promotion"] = None
 
     fit_end = fit_ends[-1] if fit_ends else {}
     telemetry = fit_end.get("telemetry") or {}
@@ -663,6 +721,16 @@ def summarize_events(
                 )
                 if key in chaos
             }
+        swap = record.get("swap")
+        if isinstance(swap, Mapping):
+            # the swap-under-load phase (serve.promote): the swap flag gates
+            # swap_p99_ms comparability exactly like overload gates shed rate
+            serve["swap"] = True
+            serve["swap_count"] = swap.get("swaps")
+            serve["swap_p99_ms"] = _finite(swap.get("p99_ms"))
+            serve["swap_errors"] = swap.get("errors")
+            serve["swap_generations"] = swap.get("generations_seen")
+            serve["swap_recompiled"] = swap.get("recompiled_swaps")
     summary["serve"] = serve or None
     return summary
 
@@ -725,6 +793,27 @@ def render(summary: Mapping[str, Any]) -> str:
             f"{summary.get('slo_recoveries', 0)} recovered"
             + (f" — rules: {', '.join(fired)}" if fired else "")
         )
+    promotion = summary.get("promotion")
+    if promotion:
+        parts = [
+            f"{promotion.get('publishes', 0)} publish(es)"
+            + (
+                f" ({promotion['recompiled_publishes']} recompiled)"
+                if promotion.get("recompiled_publishes")
+                else ""
+            ),
+            f"{promotion.get('canaries', 0)} canary(ies)",
+            f"{promotion.get('canary_evals', 0)} eval(s)",
+            f"{promotion.get('promotions', 0)} promoted",
+            f"{promotion.get('rollbacks', 0)} rolled back",
+        ]
+        if promotion.get("last_generation") is not None:
+            parts.append(f"serving generation {promotion['last_generation']}")
+        lines.append("  promotion: " + " · ".join(parts))
+        if promotion.get("rollback_rules"):
+            lines.append(
+                "    rollback rule(s): " + ", ".join(promotion["rollback_rules"])
+            )
     processes = summary.get("processes")
     if processes:
         per_host = processes.get("step_seconds") or {}
@@ -1121,6 +1210,16 @@ def render(summary: Mapping[str, Any]) -> str:
                 f"storm missed {chaos.get('storm_deadline_missed', 0)} · "
                 f"hung {chaos.get('hung_requests', 0)}"
             )
+        if serve.get("swap"):
+            parts = [f"{serve.get('swap_count', 0)} hot swap(s) under load"]
+            if serve.get("swap_recompiled"):
+                parts.append(f"{serve['swap_recompiled']} recompiled")
+            if serve.get("swap_p99_ms") is not None:
+                parts.append(f"p99 {serve['swap_p99_ms']:.2f} ms")
+            parts.append(f"errors {serve.get('swap_errors', 0)}")
+            if serve.get("swap_generations") is not None:
+                parts.append(f"{serve['swap_generations']} generation(s) observed")
+            lines.append("  serving swap: " + " · ".join(parts))
     return "\n".join(lines)
 
 
@@ -1354,6 +1453,23 @@ def compare_runs(
                 regressions.append(
                     f"{label} increased {base_count} -> {cand_count} (model-health regression)"
                 )
+    # promotion rollbacks: lower-better with a zero baseline by design — a
+    # healthy continual run rolls nothing back, so ANY candidate rollback
+    # against a clean baseline gates (the serve.promote analog of
+    # slo_violations)
+    cand_rollbacks, base_rollbacks = candidate.get("rollbacks"), baseline.get("rollbacks")
+    if (
+        isinstance(cand_rollbacks, int)
+        and isinstance(base_rollbacks, int)
+        and not isinstance(cand_rollbacks, bool)
+        and not isinstance(base_rollbacks, bool)
+    ):
+        lines.append(f"  rollbacks: {cand_rollbacks} vs {base_rollbacks}")
+        if cand_rollbacks > base_rollbacks:
+            regressions.append(
+                f"rollbacks increased {base_rollbacks} -> {cand_rollbacks} "
+                "(a candidate generation was auto-rolled back)"
+            )
     # serving gates: QPS is higher-better (reuses check); tail latency is
     # LOWER-better — a p99 that grew beyond threshold is a regression even
     # when throughput held (the micro-batcher trading latency for fill is
@@ -1435,6 +1551,19 @@ def compare_runs(
             surface_rate(
                 "serve_shed_rate", cand_shed, base_shed,
                 "both sides must run overload mode",
+            )
+        # swap-under-load tail latency: a hot swap that stalls the worker is
+        # exactly what this gate catches — gated lower-better only when BOTH
+        # runs ran the swap phase (the PR-9 phase-matching rule), surfaced
+        # unGated otherwise
+        cand_swap = _finite(cand_serve.get("swap_p99_ms"))
+        base_swap = _finite(base_serve.get("swap_p99_ms"))
+        if cand_serve.get("swap") and base_serve.get("swap"):
+            check_lower_better("swap_p99_ms", cand_swap, base_swap, threshold, unit="ms")
+        else:
+            surface_rate(
+                "swap_p99_ms", cand_swap, base_swap,
+                "swap phase ran on one side only",
             )
         for name in ("batch_fill_ratio", "cache_hit_rate"):
             cand_value, base_value = _finite(cand_serve.get(name)), _finite(base_serve.get(name))

@@ -18,6 +18,11 @@ ANN-index stack (SURVEY §2.8), built from this repo's own pieces:
 * :class:`ScoringService` — the end-to-end service (``service``), with
   admission control (:class:`RequestShed`), per-request deadlines
   (:class:`DeadlineExceeded`) and graceful degradation (``served_by`` tags).
+* :class:`ParamStore` / :class:`PromotionController` — zero-downtime weight
+  swaps and SLO-guarded canary promotion (``promote``): versioned parameter
+  generations hot-swap into the running executables without recompiling,
+  behind a shadow→canary→promoted|rolled_back state machine (docs/robustness
+  "Zero-downtime swaps and canary promotion").
 
 ``bench_serve.py`` (repo root) drives it with closed/open-loop load — plus
 open-loop OVERLOAD and ``--chaos`` fault-injection modes — and emits the
@@ -38,18 +43,29 @@ from .errors import (
     ServiceClosed,
 )
 from .pipeline import CandidatePipeline
+from .promote import (
+    PROMOTION_STAGES,
+    ParamGeneration,
+    ParamStore,
+    PromotionController,
+    in_canary_slice,
+)
 from .quant import QuantizedTable, quantization_error, quantize_embeddings
 from .request import ScoreRequest, ScoreResponse, make_window
 from .service import ScoringService
 
 __all__ = [
     "DEGRADATION_LADDER",
+    "PROMOTION_STAGES",
     "CandidatePipeline",
     "CircuitBreaker",
     "CircuitOpen",
     "DeadlineExceeded",
     "FallbackScorer",
     "MicroBatcher",
+    "ParamGeneration",
+    "ParamStore",
+    "PromotionController",
     "RequestShed",
     "ScoreRequest",
     "ScoreResponse",
@@ -60,6 +76,7 @@ __all__ = [
     "UserState",
     "QuantizedTable",
     "UserStateCache",
+    "in_canary_slice",
     "make_window",
     "quantization_error",
     "quantize_embeddings",

@@ -44,6 +44,11 @@ class UserState:
     length: int  # valid events in the window (<= L_max)
     embedding: Optional[np.ndarray] = None  # [E] last-position hidden state
     generation: int = 0  # bumped on every advance/refresh (stale-write guard)
+    # the PARAM generation whose encoder produced ``embedding`` (serve.promote
+    # hot swaps): an embedding encoded by generation G must only ever be
+    # scored by generation G's scorer — the service treats a mismatch as an
+    # embedding miss and re-encodes, never mixing generations in one response
+    param_generation: int = 0
 
 
 class UserStateCache:
@@ -135,18 +140,25 @@ class UserStateCache:
             return advanced
 
     def refresh_embedding(
-        self, user_id: Hashable, state: UserState, embedding: np.ndarray
+        self,
+        user_id: Hashable,
+        state: UserState,
+        embedding: np.ndarray,
+        param_generation: int = 0,
     ) -> None:
         """Attach the just-encoded hidden state — unless the user advanced
         again while the batch was in flight (generation moved on), in which
         case the stale embedding must not overwrite the newer window's slot.
         Check and store happen under ONE lock acquisition, so an advance
-        landing between them cannot be clobbered."""
+        landing between them cannot be clobbered. ``param_generation`` stamps
+        WHICH parameter generation encoded the state (the hot-swap staleness
+        guard)."""
         with self._lock:
             current = self._states.get(user_id)
             if current is not None and current.generation > state.generation:
                 return
             state.embedding = np.asarray(embedding)
+            state.param_generation = int(param_generation)
             self._states[user_id] = state
             self._states.move_to_end(user_id)
             while len(self._states) > self.capacity:

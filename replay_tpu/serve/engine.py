@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from replay_tpu.nn.compiled import CompiledInference
+from replay_tpu.nn.compiled import CompiledInference, params_mismatch
 
 
 def _smallest_covering(sorted_sizes: Sequence[int], n: int) -> int:
@@ -56,6 +56,7 @@ class ScoringEngine:
         if outputs not in ("both", "hidden"):
             msg = "ScoringEngine outputs must be 'both' or 'hidden'"
             raise ValueError(msg)
+        self.params = params
         self.max_sequence_length = int(
             max_sequence_length
             if max_sequence_length is not None
@@ -122,8 +123,10 @@ class ScoringEngine:
                     .lower(params, hidden_spec, cand_spec)
                     .compile()
                 )
+                # params first, as a real program argument — the hot-swap seam
+                # (same convention as the CompiledInference encode programs)
                 self._hidden_scorers[size] = (
-                    lambda hidden, cands, _ex=executable: _ex(params, hidden, cands)
+                    lambda p, hidden, cands, _ex=executable: _ex(p, hidden, cands)
                 )
 
         # accounting
@@ -144,17 +147,51 @@ class ScoringEngine:
     def batch_bucket(self, rows: int) -> int:
         return _smallest_covering(self.batch_buckets, rows)
 
+    # -- hot swap ----------------------------------------------------------- #
+    def validate_params(self, params) -> Optional[str]:
+        """Why ``params`` can NOT hot-swap into this engine's executables
+        (structure/shape/dtype mismatch vs the lowering pytree — e.g. a grown
+        item table), or ``None`` when a zero-recompile swap is legal."""
+        return params_mismatch(self.params, params)
+
+    def swap_params(self, params) -> None:
+        """Install a new same-shape parameter set into EVERY executable —
+        encoders and hidden scorers — without recompiling (params are program
+        arguments). Raises ``ValueError`` naming the offending leaf when the
+        shapes changed; build a fresh engine for that."""
+        mismatch = self.validate_params(params)
+        if mismatch is not None:
+            msg = (
+                f"params cannot hot-swap into the serving executables: "
+                f"{mismatch}. A changed catalog shape needs freshly compiled "
+                "executables (a new ScoringEngine), not a swap."
+            )
+            raise ValueError(msg)
+        self.params = params
+        for compiled in self._encoders.values():
+            compiled.swap_params(params)
+
     # -- execution (serve-worker thread) ------------------------------------ #
-    def encode(self, length_bucket: int, item_ids: np.ndarray, padding_mask: np.ndarray):
+    def encode(
+        self,
+        length_bucket: int,
+        item_ids: np.ndarray,
+        padding_mask: np.ndarray,
+        params=None,
+    ):
         """Run the length bucket's executable on ``[n, L_bucket]`` windows.
 
         Returns ``(logits, hidden)`` in ``"both"`` mode (logits over the
         catalog or the compiled slate) or ``(None, hidden)`` in retrieval
-        mode; both cut to the real row count, device-resident."""
+        mode; both cut to the real row count, device-resident. ``params``
+        overrides the bound parameter set for this call (the per-dispatch
+        generation resolution of the hot-swap path)."""
         compiled = self._encoders[length_bucket]
         rows = item_ids.shape[0]
         try:
-            out = compiled(item_ids, padding_mask, candidates=self.candidates)
+            out = compiled(
+                item_ids, padding_mask, candidates=self.candidates, params=params
+            )
             # async dispatch surfaces device-side failures at materialization,
             # which would otherwise happen at the caller's np.asarray — block
             # here (the worker materializes immediately anyway) so the failure
@@ -173,9 +210,10 @@ class ScoringEngine:
             return out
         return None, out
 
-    def score_hidden(self, hidden: np.ndarray):
+    def score_hidden(self, hidden: np.ndarray, params=None):
         """Score cached ``[n, E]`` hidden states (the pure-hit lane), padded
-        up to the nearest batch bucket; device-resident result cut to ``n``."""
+        up to the nearest batch bucket; device-resident result cut to ``n``.
+        ``params`` overrides the bound parameter set for this call."""
         if not self._hidden_scorers:
             msg = "retrieval-mode engine has no hidden scorer (use the pipeline)"
             raise ValueError(msg)
@@ -188,7 +226,11 @@ class ScoringEngine:
             )
         try:
             logits = jax.block_until_ready(
-                self._hidden_scorers[bucket](hidden, self.candidates)
+                self._hidden_scorers[bucket](
+                    self.params if params is None else params,
+                    hidden,
+                    self.candidates,
+                )
             )
         except Exception:
             self.hit_failures += 1

@@ -74,6 +74,13 @@ class ScoreResponse:
     # possibly-stale cached state through the hit lane; "fallback" is the
     # host-side popularity floor. Degraded traffic is always visible here.
     served_by: str = "primary"
+    # the parameter generation that produced every score in this response
+    # (serve.promote): encoder, scorer and retrieval pipeline all resolved
+    # from ONE generation per dispatched batch — a hot swap or rollback can
+    # never tear a response across generations. ``role`` is the traffic slice
+    # that routed it ("stable", or "candidate" during a canary).
+    generation: int = 0
+    role: str = "stable"
 
 
 @dataclass
@@ -108,6 +115,16 @@ class PendingRequest:
     # event is emitted only AFTER its enqueue succeeds — a rerouted request
     # must produce one degrade event, for the rung that actually took it
     degrade_reason: Optional[str] = None
+    # hot-swap bookkeeping (serve.promote): the traffic-slice role this
+    # request routed to, and — for hit-lane pendings — the param generation
+    # that encoded the cached embedding (the dispatch-time staleness guard
+    # re-encodes on mismatch rather than score old states with new weights).
+    # canary_epoch stamps WHICH begin_canary window admitted a candidate
+    # request: a previous candidate's late-landing outcome must not count in
+    # the current canary's evaluation window
+    role: str = "stable"
+    embedding_generation: int = 0
+    canary_epoch: int = 0
 
 
 def make_window(

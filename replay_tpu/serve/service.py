@@ -71,6 +71,7 @@ from .degrade import FallbackScorer
 from .engine import ScoringEngine
 from .errors import CircuitOpen, DeadlineExceeded, RequestShed
 from .pipeline import CandidatePipeline
+from .promote import ROLES, ParamStore, in_canary_slice
 from .request import PendingRequest, ScoreRequest, ScoreResponse, make_window
 
 
@@ -122,6 +123,7 @@ class ScoringService:
         fallback: Optional[FallbackScorer] = None,
         metrics_port: Optional[int] = None,
         slo_rules: Optional[Sequence[Any]] = None,
+        param_store: Optional[ParamStore] = None,
     ) -> None:
         if retrieval is not None and candidates is not None:
             msg = "retrieval mode and a fixed candidate slate are mutually exclusive"
@@ -133,6 +135,8 @@ class ScoringService:
         )
         self.retrieval = retrieval
         self.pad_id = int(pad_id)
+        self._model = model
+        self._feature_name = feature_name
         self.tracer = tracer if tracer is not None else Tracer(enabled=False)
         self.logger = logger
         self.trace_path = trace_path
@@ -147,6 +151,20 @@ class ScoringService:
             outputs="hidden" if retrieval is not None else "both",
         )
         self.cache = UserStateCache(cache_capacity)
+        # versioned parameter generations (serve.promote): generation 0 is the
+        # construction params; candidates publish through publish_candidate
+        # and swap in atomically via promote()/rollback()
+        self.store = (
+            param_store
+            if param_store is not None
+            else ParamStore(self.engine.params, pipeline=retrieval)
+        )
+        # active canary routing: (candidate generation, traffic fraction);
+        # None = all traffic on the stable generation. The epoch counts
+        # begin_canary calls so accounting can tell THIS canary's traffic
+        # from a previous candidate's late-landing in-flight requests
+        self._canary: Optional[Tuple[int, float]] = None
+        self._canary_epoch = 0
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         # chain, don't clobber: a caller-supplied on_transition (alerting
         # hooks etc.) keeps firing after the service's event forwarding
@@ -173,6 +191,16 @@ class ScoringService:
             "hit": 0, "advance": 0, "cold": 0, "fallback": 0
         }
         self._served_by: Dict[str, int] = {"primary": 0, "cache_only": 0, "fallback": 0}
+        # per-traffic-role accounting (stable vs canary candidate): the raw
+        # material PromotionController folds into replay_canary_* gauges
+        self._role_stats: Dict[str, Dict[str, float]] = {
+            role: self._fresh_role_stats() for role in ROLES
+        }
+        # hot-swap staleness accounting: submit-time embedding misses (cached
+        # state encoded by an older generation) and dispatch-time re-routes
+        # (the generation moved between submit and batch build)
+        self._generation_misses = 0
+        self._generation_reroutes = 0
         # key -> (last_emit_time, pending_count, event, payload); pending
         # counts are flushed by the key's next post-window emit or at close()
         self._throttle: Dict[str, Tuple[float, int, str, Dict[str, Any]]] = {}
@@ -272,6 +300,7 @@ class ScoringService:
         k: Optional[int] = None,
         candidates: Optional[Sequence[int]] = None,
         deadline_ms: Optional[float] = None,
+        _role: Optional[str] = None,
     ) -> "Future[ScoreResponse]":
         """Enqueue one scoring request; resolves to a :class:`ScoreResponse`.
 
@@ -280,6 +309,10 @@ class ScoringService:
         immediately with :class:`RequestShed` / :class:`CircuitOpen`, and a
         ``deadline_ms`` budget (default: the service's ``default_deadline_ms``)
         drops the request at batch-build time once expired.
+
+        ``_role`` forces the traffic-slice routing ("stable"/"candidate") —
+        the shadow-stage probe seam; normal traffic routes by the canary's
+        deterministic hash slice.
         """
         future: "Future[ScoreResponse]" = Future()
         if deadline_ms is None:
@@ -292,37 +325,22 @@ class ScoringService:
             candidates=candidates,
             deadline_ms=deadline_ms,
         )
+        role = _role if _role is not None else self._role_for(user_id)
         with self._count_lock:
             self._requests += 1
+            self._role_stats[role]["requests"] += 1
         expires_at = (
             time.perf_counter() + deadline_ms / 1000.0
             if deadline_ms is not None  # 0.0 = already expired, NOT no-deadline
             else None
         )
         try:
-            resolved = self._resolve(request, future)
+            resolved = self._resolve(request, future, role)
             if resolved is None:  # answered inline by the fallback floor
                 return future
             lane, pending = resolved
             pending.expires_at = expires_at
-            try:
-                self.batcher.submit(lane, pending)
-                self._emit_degraded(pending)
-            except RequestShed as shed:
-                if not self._absorb_overload(lane, pending, shed):
-                    with self._count_lock:
-                        self._shed += 1
-                    self._emit_throttled(
-                        f"shed:{self._lane_name(lane)}",
-                        "on_shed",
-                        {
-                            "lane": self._lane_name(lane),
-                            "depth": shed.depth,
-                            "max_depth": shed.max_depth,
-                            "retry_after_s": shed.retry_after_s,
-                        },
-                    )
-                    self._safe_fail(future, shed)
+            self._submit_pending(lane, pending)
         except CircuitOpen as exc:
             with self._count_lock:
                 self._circuit_refusals += 1
@@ -330,8 +348,34 @@ class ScoringService:
         except Exception as exc:  # noqa: BLE001 — surface through the future
             with self._count_lock:
                 self._errors += 1
+                self._role_stats[role]["errors"] += 1
             self._safe_fail(future, exc)
         return future
+
+    def _submit_pending(self, lane, pending: PendingRequest) -> None:
+        """Enqueue a resolved pending on its lane, walking the overload
+        absorption ladder on a shed (shared by submit and the dispatch-time
+        generation re-route)."""
+        pending.canary_epoch = self._canary_epoch
+        try:
+            self.batcher.submit(lane, pending)
+            self._emit_degraded(pending)
+        except RequestShed as shed:
+            if not self._absorb_overload(lane, pending, shed):
+                with self._count_lock:
+                    self._shed += 1
+                    self._role_stats[pending.role]["shed"] += 1
+                self._emit_throttled(
+                    f"shed:{self._lane_name(lane)}",
+                    "on_shed",
+                    {
+                        "lane": self._lane_name(lane),
+                        "depth": shed.depth,
+                        "max_depth": shed.max_depth,
+                        "retry_after_s": shed.retry_after_s,
+                    },
+                )
+                self._safe_fail(pending.future, shed)
 
     def score(self, user_id, timeout: Optional[float] = 60.0, **kwargs) -> ScoreResponse:
         """Synchronous :meth:`submit`.
@@ -350,9 +394,171 @@ class ScoringService:
             future.cancel()
             raise
 
+    # -- promotion / hot-swap API (serve.promote) ---------------------------- #
+    @staticmethod
+    def _fresh_role_stats() -> Dict[str, float]:
+        return {
+            "requests": 0.0,
+            "answered": 0.0,
+            "errors": 0.0,
+            "shed": 0.0,
+            "queue_wait_ms_sum": 0.0,
+            "queue_wait_ms_max": 0.0,
+        }
+
+    def _role_for(self, user_id: Hashable) -> str:
+        canary = self._canary
+        if canary is None:
+            return "stable"
+        _, fraction = canary
+        return "candidate" if in_canary_slice(user_id, fraction) else "stable"
+
+    def _generation_for(self, role: str):
+        """The generation serving ``role`` RIGHT NOW. Candidate traffic under
+        an active canary resolves the canary's PINNED generation — a
+        publish_candidate racing the canary must not silently redirect the
+        slice to an unvetted generation; outside a canary, the candidate role
+        is the shadow-probe seam and resolves the store's candidate (falling
+        back to stable)."""
+        if role == "candidate":
+            canary = self._canary
+            if canary is not None:
+                try:
+                    return self.store.generation(canary[0])
+                except KeyError:  # canary generation evicted: serve stable
+                    return self.store.resolve("stable")
+        return self.store.resolve(role)
+
+    def publish_candidate(
+        self, params, label: str = "", pipeline: Optional[CandidatePipeline] = None
+    ) -> int:
+        """Register a candidate parameter generation (not yet serving).
+
+        Same-shape params (the common continual-finetune case) share the
+        running executables — ZERO recompilation, the swap is a pointer move.
+        A changed catalog shape (vocab surgery grew the item table) compiles a
+        dedicated engine HERE, on the caller's thread, while the serve worker
+        keeps answering from the current generation. Retrieval-mode services
+        must pass the candidate's own :class:`CandidatePipeline` (its MIPS
+        index embeds the item table, so it is per-generation by construction).
+        """
+        if self.mode == "retrieval" and pipeline is None:
+            msg = (
+                "retrieval-mode candidates need their own CandidatePipeline "
+                "(the MIPS index embeds the generation's item table)"
+            )
+            raise ValueError(msg)
+        import jax
+        import jax.numpy as jnp
+
+        # land the candidate on device ONCE at publish (uncommitted, dtypes
+        # preserved) — every dispatch then passes resident arrays instead of
+        # paying a host->device copy per batch
+        params = jax.tree.map(jnp.asarray, params)
+        mismatch = self.engine.validate_params(params)
+        if mismatch is None:
+            generation = self.store.publish(
+                params, label=label, pipeline=pipeline, recompiled=False
+            )
+            reason = None
+        else:
+            # shape change: fresh executables, compiled off the serve worker
+            engine = ScoringEngine(
+                self._model,
+                params,
+                length_buckets=self.engine.length_buckets,
+                batch_buckets=self.engine.batch_buckets,
+                candidates=self.engine.candidates,
+                feature_name=self._feature_name,
+                outputs=self.engine.outputs,
+            )
+            generation = self.store.publish(
+                params, label=label, pipeline=pipeline, engine=engine,
+                recompiled=True,
+            )
+            reason = mismatch
+        self._emit(
+            "on_publish",
+            {
+                "generation": generation,
+                "label": label,
+                "recompiled": reason is not None,
+                "recompile_reason": reason,
+            },
+        )
+        return generation
+
+    def begin_canary(self, generation: int, fraction: float) -> None:
+        """Route the deterministic ``fraction`` slice of users to
+        ``generation`` (which must be resident in the store)."""
+        self.store.generation(generation)  # raises when not resident
+        with self._count_lock:
+            # a canary window starts with clean candidate counters AND a new
+            # epoch, so its evaluations never read a previous candidate's
+            # traffic — including in-flight requests stamped before the reset
+            self._role_stats["candidate"] = self._fresh_role_stats()
+            self._canary = (int(generation), float(fraction))
+            self._canary_epoch += 1
+        self._emit(
+            "on_canary_start", {"generation": generation, "fraction": fraction}
+        )
+
+    def end_canary(self) -> None:
+        with self._count_lock:
+            self._canary = None
+
+    def promote(self, generation: Optional[int] = None) -> Dict[str, Any]:
+        """Atomically swap ``generation`` (default: the candidate) in as the
+        stable serving params; the outgoing generation stays pinned as the
+        rollback target. In-flight batches finish on the generation they
+        resolved — no torn reads."""
+        info = self.store.promote(generation)
+        self.end_canary()
+        self._swap_event("promote", info)
+        return info
+
+    def rollback(self) -> Dict[str, Any]:
+        """Atomically restore the pinned previous generation (bad swap /
+        breached canary)."""
+        info = self.store.rollback()
+        self.end_canary()
+        self._swap_event("rollback", info)
+        return info
+
+    def _swap_event(self, reason: str, info: Dict[str, Any]) -> None:
+        try:
+            recompiled = self.store.generation(info["to_generation"]).recompiled
+        except KeyError:
+            recompiled = None
+        self._emit(
+            "on_swap",
+            {
+                "reason": reason,
+                "from_generation": info["from_generation"],
+                "to_generation": info["to_generation"],
+                "recompiled": recompiled,
+            },
+        )
+
+    def canary_stats(self) -> Dict[str, Dict[str, float]]:
+        """Cumulative per-role counters (stable vs candidate) with derived
+        mean queue wait — the PromotionController's evaluation input."""
+        with self._count_lock:
+            out = {role: dict(stats) for role, stats in self._role_stats.items()}
+        for stats in out.values():
+            answered = stats["answered"]
+            stats["queue_wait_ms_mean"] = (
+                stats["queue_wait_ms_sum"] / answered if answered else 0.0
+            )
+        return out
+
+    def generation_history(self) -> List[Dict[str, Any]]:
+        """The store's publish/promote/rollback log (pure JSON artifact)."""
+        return self.store.history()
+
     # -- request resolution (client thread) --------------------------------- #
     def _resolve(
-        self, request: ScoreRequest, future: "Future[ScoreResponse]"
+        self, request: ScoreRequest, future: "Future[ScoreResponse]", role: str = "stable"
     ) -> Optional[Tuple[Hashable, PendingRequest]]:
         """Route a request to a (lane, pending) — or answer it inline
         (fallback floor, returning None)."""
@@ -388,7 +594,7 @@ class ScoringService:
                 generation=previous.generation + 1 if previous else 0,
             )
             self.cache.store(request.user_id, state)
-            return self._encode_or_degrade(request, future, state, "cold", previous)
+            return self._encode_or_degrade(request, future, state, "cold", previous, role)
 
         if request.new_items:
             # atomic lookup+advance+store: concurrent appends for one user
@@ -407,7 +613,7 @@ class ScoringService:
                     "provide history= for the cold path"
                 )
                 raise KeyError(msg)
-            return self._encode_or_degrade(request, future, advanced, "advance", previous)
+            return self._encode_or_degrade(request, future, advanced, "advance", previous, role)
         state = self.cache.lookup(request.user_id)
         if state is None:
             msg = (
@@ -416,18 +622,31 @@ class ScoringService:
             )
             raise KeyError(msg)
         if state.embedding is not None:
-            pending = PendingRequest(
-                request=request,
-                future=future,
-                served_from="hit",
-                embedding=state.embedding,
-                length=state.length,
-                enqueued_at=self.tracer.now(),
-            )
-            return "hit", pending
+            # hot-swap staleness guard (serve.promote): an embedding encoded
+            # by an older parameter generation must never be scored through
+            # the current generation's scorer — a generation mismatch is a
+            # MISS and the cached window re-encodes instead
+            current_generation = self._generation_for(role).number
+            if state.param_generation != current_generation:
+                with self._count_lock:
+                    self._generation_misses += 1
+            else:
+                pending = PendingRequest(
+                    request=request,
+                    future=future,
+                    served_from="hit",
+                    embedding=state.embedding,
+                    length=state.length,
+                    enqueued_at=self.tracer.now(),
+                    extra=(state,),
+                    role=role,
+                    embedding_generation=state.param_generation,
+                )
+                return ("hit", role), pending
         # cached window whose embedding is still in flight (or was raced
-        # away): re-encode the cached window — still no history re-send
-        return self._encode_or_degrade(request, future, state, "advance", state)
+        # away, or certifies an older param generation): re-encode the cached
+        # window — still no history re-send
+        return self._encode_or_degrade(request, future, state, "advance", state, role)
 
     def _encode_or_degrade(
         self,
@@ -436,18 +655,22 @@ class ScoringService:
         state: UserState,
         served_from: str,
         previous: Optional[UserState],
+        role: str = "stable",
     ) -> Optional[Tuple[Hashable, PendingRequest]]:
         """The primary encode route, gated by the breaker; refused traffic
         walks the degradation ladder instead."""
         stale_embedding = previous.embedding if previous is not None else None
         stale_length = previous.length if previous is not None else 0
+        stale_generation = previous.param_generation if previous is not None else 0
         if self.breaker.allow():
-            lane, pending = self._encode_pending(request, future, state, served_from)
+            lane, pending = self._encode_pending(request, future, state, served_from, role)
             pending.stale_embedding = stale_embedding
             pending.stale_length = stale_length
+            pending.embedding_generation = stale_generation
             return lane, pending
         return self._degrade(
-            request, future, stale_embedding, stale_length, reason="breaker_open"
+            request, future, stale_embedding, stale_length, stale_generation,
+            role, reason="breaker_open",
         )
 
     def _cache_only_pending(
@@ -458,6 +681,8 @@ class ScoringService:
         length: int,
         reason: str,
         expires_at: Optional[float] = None,
+        role: str = "stable",
+        embedding_generation: int = 0,
     ) -> PendingRequest:
         """The cache_only rung's pending: the stale cached state routed to the
         hit lane. The on_degrade emit happens at enqueue success, not here."""
@@ -471,6 +696,8 @@ class ScoringService:
             expires_at=expires_at,
             served_by="cache_only",
             degrade_reason=reason,
+            role=role,
+            embedding_generation=embedding_generation,
         )
 
     def _emit_degraded(self, pending: PendingRequest) -> None:
@@ -490,17 +717,20 @@ class ScoringService:
         future: "Future[ScoreResponse]",
         stale_embedding: Optional[np.ndarray],
         stale_length: int,
+        stale_generation: int,
+        role: str,
         reason: str,
     ) -> Optional[Tuple[Hashable, PendingRequest]]:
         """Walk the ladder below primary: cache_only (hit lane on the stale
         cached state), then the fallback floor, then an explicit refusal."""
         if stale_embedding is not None:
             pending = self._cache_only_pending(
-                request, future, stale_embedding, stale_length, reason
+                request, future, stale_embedding, stale_length, reason,
+                role=role, embedding_generation=stale_generation,
             )
-            return "hit", pending
+            return ("hit", role), pending
         if self.fallback is not None:
-            self._finish_fallback(request, future, reason=reason)
+            self._finish_fallback(request, future, reason=reason, role=role)
             return None
         raise CircuitOpen(self.breaker.retry_after_s())
 
@@ -511,7 +741,8 @@ class ScoringService:
         lane on its stale cached state, else the fallback floor. Returns
         whether the request was absorbed."""
         request = pending.request
-        if lane != "hit" and pending.stale_embedding is not None:
+        role = pending.role
+        if lane[0] != "hit" and pending.stale_embedding is not None:
             degraded = self._cache_only_pending(
                 request,
                 pending.future,
@@ -519,23 +750,31 @@ class ScoringService:
                 pending.stale_length,
                 reason="overload",
                 expires_at=pending.expires_at,
+                role=role,
+                embedding_generation=pending.embedding_generation,
             )
+            degraded.canary_epoch = pending.canary_epoch
             try:
-                self.batcher.submit("hit", degraded)
+                self.batcher.submit(("hit", role), degraded)
             except RequestShed:
                 pass  # hit lane saturated too — next rung
             else:
                 self._emit_degraded(degraded)
                 return True
         if self.fallback is not None:
-            self._finish_fallback(request, pending.future, reason="overload")
+            self._finish_fallback(request, pending.future, reason="overload", role=role)
             return True
         return False
 
     def _finish_fallback(
-        self, request: ScoreRequest, future: "Future[ScoreResponse]", reason: str
+        self,
+        request: ScoreRequest,
+        future: "Future[ScoreResponse]",
+        reason: str,
+        role: str = "stable",
     ) -> None:
         response = self._fallback_response(request)
+        response.role = role
         if self._safe_set_result(future, response):
             with self._count_lock:
                 # under _count_lock: += on the scorer attribute is a
@@ -543,6 +782,7 @@ class ScoringService:
                 self.fallback.served += 1
                 self._served_by["fallback"] += 1
                 self._served_from["fallback"] += 1
+                self._role_stats[role]["answered"] += 1
             self._emit_throttled(
                 f"degrade:fallback:{reason}",
                 "on_degrade",
@@ -576,6 +816,7 @@ class ScoringService:
             queue_wait_s=0.0,
             batch_bucket=0,
             served_by="fallback",
+            generation=-1,  # host-side floor: no device generation scored this
         )
 
     def _encode_pending(
@@ -584,6 +825,7 @@ class ScoringService:
         future: "Future[ScoreResponse]",
         state: UserState,
         served_from: str,
+        role: str = "stable",
     ) -> Tuple[Hashable, PendingRequest]:
         length_bucket = self.engine.route_length(state.length)
         pending = PendingRequest(
@@ -595,20 +837,41 @@ class ScoringService:
             length=state.length,
             enqueued_at=self.tracer.now(),
             extra=(state,),
+            role=role,
         )
-        return ("encode", length_bucket), pending
+        return ("encode", length_bucket, role), pending
 
     # -- dispatch (serve-worker thread) ------------------------------------- #
     def _on_dispatch_error(self, lane, items: List[PendingRequest], exc: BaseException) -> None:
-        failed = 0
+        role = self._lane_role(lane)
+        failed = counted = 0
         for item in items:
             if self._safe_fail(item.future, exc):
                 failed += 1
+                if self._counts_for_role(role, item):
+                    counted += 1
         with self._count_lock:
             self._errors += failed
+            self._role_stats[role]["errors"] += counted
+
+    def _counts_for_role(self, role: str, item: PendingRequest) -> bool:
+        """Whether this outcome belongs in the role's canary accounting: a
+        previous candidate's late-landing in-flight request (older canary
+        epoch) must not pollute the CURRENT canary's evaluation window."""
+        return role != "candidate" or item.canary_epoch == self._canary_epoch
+
+    @staticmethod
+    def _lane_role(lane) -> str:
+        # both lane kinds carry the routing role last: ("hit", role) and
+        # ("encode", L, role)
+        return lane[-1]
 
     def _lane_name(self, lane) -> str:
-        return "hit" if lane == "hit" else f"encode:L={lane[1]}"
+        base = "hit" if lane[0] == "hit" else f"encode:L={lane[1]}"
+        role = self._lane_role(lane)
+        # stable lanes keep the PR-6 names; canary traffic is visibly its own
+        # lane family (own queues, own shed keys, single-generation batches)
+        return base if role == "stable" else f"{base}#canary"
 
     def _admit(
         self, items: List[PendingRequest]
@@ -641,82 +904,215 @@ class ScoringService:
         return live, expired, abandoned
 
     def _dispatch(self, lane, items: List[PendingRequest]) -> None:
-        items, expired, abandoned = self._admit(items)
-        if not items:
+        role = self._lane_role(lane)
+        # ONE generation resolution per dispatched batch: encoder, scorer and
+        # retrieval pipeline below all use this immutable object — a
+        # concurrent promote/rollback changes the NEXT batch, never tears
+        # this one between its stages (canary batches resolve the canary's
+        # PINNED generation, never a just-published unvetted candidate)
+        gen = self._generation_for(role)
+        if lane[0] == "hit":
+            self._dispatch_hit(lane, role, gen, items)
+        else:
+            self._dispatch_encode(lane, role, gen, items)
+
+    def _dispatch_hit(self, lane, role: str, gen, items: List[PendingRequest]) -> None:
+        """The hit lane under hot swaps: an embedding is only ever scored by
+        the generation that ENCODED it. Current-generation items ride the
+        bulk path; items whose generation moved on mid-flight finish on the
+        generation they started (still resident — the store pins it); items
+        whose generation left the store re-encode (primary) or fall to the
+        floor (cache_only has no encode to return to)."""
+        current: List[PendingRequest] = []
+        stale: Dict[int, List[PendingRequest]] = {}
+        for item in items:
+            if int(item.embedding_generation) == gen.number:
+                current.append(item)
+            else:
+                stale.setdefault(int(item.embedding_generation), []).append(item)
+        expired = abandoned = 0
+        for number, group in sorted(stale.items()):
+            try:
+                stale_gen = self.store.generation(number)
+            except KeyError:
+                stale_gen = None
+            if stale_gen is None:
+                with self._count_lock:
+                    self._generation_reroutes += len(group)
+                for item in group:
+                    if item.served_by == "primary":
+                        self._requeue_encode(item, role)
+                    elif self.fallback is not None:
+                        self._finish_fallback(
+                            item.request, item.future,
+                            reason="generation_evicted", role=role,
+                        )
+                    else:
+                        # same accounting as a submit-time CircuitOpen: this
+                        # refusal must not vanish from stats()
+                        with self._count_lock:
+                            self._circuit_refusals += 1
+                        self._safe_fail(
+                            item.future, CircuitOpen(self.breaker.retry_after_s())
+                        )
+                continue
+            group, group_expired, group_abandoned = self._admit(group)
+            expired += group_expired
+            abandoned += group_abandoned
+            if group:
+                self._score_hit_batch(lane, role, stale_gen, group, 0, 0)
+        current, current_expired, current_abandoned = self._admit(current)
+        expired += current_expired
+        abandoned += current_abandoned
+        if not current:
             if expired or abandoned:
                 # a fully-dropped batch (deadline storm, mass abandonment) is
                 # exactly the batch the drop accounting must not go dark on
-                self._emit(
-                    "on_serve_batch",
-                    {
-                        "lane": self._lane_name(lane),
-                        "rows": 0,
-                        "bucket": 0,
-                        "fill": 0.0,
-                        "queue_wait_ms_max": 0.0,
-                        "dropped_expired": expired,
-                        "dropped_cancelled": abandoned,
-                    },
-                )
+                self._emit_batch(lane, 0, 0, [], expired, abandoned)
+            return
+        self._score_hit_batch(lane, role, gen, current, expired, abandoned)
+
+    def _score_hit_batch(
+        self,
+        lane,
+        role: str,
+        gen,
+        items: List[PendingRequest],
+        expired: int,
+        abandoned: int,
+    ) -> None:
+        waits = [
+            lifecycle_span(self.tracer, "queue_wait", item.enqueued_at, lane=self._lane_name(lane))
+            for item in items
+        ]
+        rows = len(items)
+        engine = gen.engine if gen.engine is not None else self.engine
+        bucket = engine.batch_bucket(rows)
+        with self.tracer.span("batch_build", rows=rows):
+            hidden = np.stack([item.embedding for item in items]).astype(np.float32)
+        if self.mode == "retrieval":
+            engine.record_ranked_batch(rows, bucket)
+            pipeline = gen.pipeline if gen.pipeline is not None else self.retrieval
+            scores, ids = self._rank(pipeline, hidden, rows, bucket)
+            logits = None
+        else:
+            with self.tracer.span("score", rows=rows, lane="hit"):
+                logits = np.asarray(engine.score_hidden(hidden, params=gen.params))
+            scores = ids = None
+        self._resolve_batch_futures(
+            items, waits, lane, bucket, gen.number, role, logits, scores, ids
+        )
+        self._emit_batch(lane, rows, bucket, waits, expired, abandoned)
+
+    def _dispatch_encode(self, lane, role: str, gen, items: List[PendingRequest]) -> None:
+        items, expired, abandoned = self._admit(items)
+        if not items:
+            if expired or abandoned:
+                self._emit_batch(lane, 0, 0, [], expired, abandoned)
             return
         waits = [
             lifecycle_span(self.tracer, "queue_wait", item.enqueued_at, lane=self._lane_name(lane))
             for item in items
         ]
         rows = len(items)
-        bucket = self.engine.batch_bucket(rows)
-        if lane == "hit":
-            with self.tracer.span("batch_build", rows=rows):
-                hidden = np.stack([item.embedding for item in items]).astype(np.float32)
-            if self.retrieval is not None:
-                self.engine.record_ranked_batch(rows, bucket)
-                scores, ids = self._rank(hidden, rows, bucket)
-                logits = None
-            else:
-                with self.tracer.span("score", rows=rows, lane="hit"):
-                    logits = np.asarray(self.engine.score_hidden(hidden))
-                scores = ids = None
+        _, length_bucket, _ = lane
+        engine = gen.engine if gen.engine is not None else self.engine
+        bucket = engine.batch_bucket(rows)
+        with self.tracer.span("batch_build", rows=rows):
+            ids_batch = np.stack([item.window[-length_bucket:] for item in items])
+            mask_batch = np.stack([item.mask[-length_bucket:] for item in items])
+        with self.tracer.span("score", rows=rows, lane=self._lane_name(lane)):
+            # the breaker's raw material: one engine call = one outcome
+            # (a batch-wide exception counts once, not once per rider)
+            try:
+                logits_dev, hidden_dev = engine.encode(
+                    length_bucket, ids_batch, mask_batch, params=gen.params
+                )
+                hidden_np = np.asarray(hidden_dev)
+                logits = np.asarray(logits_dev) if logits_dev is not None else None
+            except Exception:
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+        for item, embedding in zip(items, hidden_np):
+            state = item.extra[0]
+            self.cache.refresh_embedding(
+                item.request.user_id, state, embedding, param_generation=gen.number
+            )
+        if self.mode == "retrieval":
+            pipeline = gen.pipeline if gen.pipeline is not None else self.retrieval
+            scores, ids = self._rank(pipeline, hidden_np, rows, bucket)
         else:
-            _, length_bucket = lane
-            with self.tracer.span("batch_build", rows=rows):
-                ids_batch = np.stack([item.window[-length_bucket:] for item in items])
-                mask_batch = np.stack([item.mask[-length_bucket:] for item in items])
-            with self.tracer.span("score", rows=rows, lane=self._lane_name(lane)):
-                # the breaker's raw material: one engine call = one outcome
-                # (a batch-wide exception counts once, not once per rider)
-                try:
-                    logits_dev, hidden_dev = self.engine.encode(
-                        length_bucket, ids_batch, mask_batch
-                    )
-                    hidden_np = np.asarray(hidden_dev)
-                    logits = np.asarray(logits_dev) if logits_dev is not None else None
-                except Exception:
-                    self.breaker.record_failure()
-                    raise
-                self.breaker.record_success()
-            for item, embedding in zip(items, hidden_np):
-                state = item.extra[0]
-                self.cache.refresh_embedding(item.request.user_id, state, embedding)
-            if self.retrieval is not None:
-                scores, ids = self._rank(hidden_np, rows, bucket)
-            else:
-                scores = ids = None
+            scores = ids = None
+        self._resolve_batch_futures(
+            items, waits, lane, bucket, gen.number, role, logits, scores, ids
+        )
+        self._emit_batch(lane, rows, bucket, waits, expired, abandoned)
 
+    def _requeue_encode(self, item: PendingRequest, role: str) -> None:
+        """Dispatch-time generation re-route: the embedding's generation left
+        the store between submit and batch build — re-encode the cached
+        window rather than score old hidden states through new weights."""
+        state = item.extra[0] if item.extra else None
+        if state is None:
+            self._safe_fail(
+                item.future,
+                RuntimeError("hit-lane pending carries no cached state to re-encode"),
+            )
+            return
+        try:
+            resolved = self._encode_or_degrade(
+                item.request, item.future, state, "advance", state, role
+            )
+        except CircuitOpen as exc:
+            with self._count_lock:
+                self._circuit_refusals += 1
+            self._safe_fail(item.future, exc)
+            return
+        except Exception as exc:  # noqa: BLE001 — surface through the future
+            with self._count_lock:
+                self._errors += 1
+                self._role_stats[role]["errors"] += 1
+            self._safe_fail(item.future, exc)
+            return
+        if resolved is None:  # answered inline by the fallback floor
+            return
+        new_lane, pending = resolved
+        pending.expires_at = item.expires_at
+        self._submit_pending(new_lane, pending)
+
+    def _resolve_batch_futures(
+        self,
+        items: List[PendingRequest],
+        waits: List[float],
+        lane,
+        bucket: int,
+        generation: int,
+        role: str,
+        logits: Optional[np.ndarray],
+        scores: Optional[np.ndarray],
+        ids: Optional[np.ndarray],
+    ) -> None:
+        lane_name = self._lane_name(lane)
         for row, (item, wait) in enumerate(zip(items, waits)):
             try:
                 response = self._build_response(
                     item,
-                    lane_name=self._lane_name(lane),
+                    lane_name=lane_name,
                     batch_bucket=bucket,
                     queue_wait=wait,
                     logits_row=logits[row] if logits is not None else None,
                     ranked_scores=scores[row] if scores is not None else None,
                     ranked_ids=ids[row] if ids is not None else None,
+                    generation=generation,
+                    role=role,
                 )
             except Exception as exc:  # noqa: BLE001
                 if self._safe_fail(item.future, exc):
                     with self._count_lock:
                         self._errors += 1
+                        if self._counts_for_role(role, item):
+                            self._role_stats[role]["errors"] += 1
                 continue
             if not self._safe_set_result(item.future, response):
                 with self._count_lock:
@@ -727,7 +1123,17 @@ class ScoringService:
                 self._served_by[item.served_by] += 1
                 self._queue_wait_sum += wait
                 self._queue_wait_max = max(self._queue_wait_max, wait)
+                if self._counts_for_role(role, item):
+                    stats = self._role_stats[role]
+                    stats["answered"] += 1
+                    stats["queue_wait_ms_sum"] += wait * 1000.0
+                    stats["queue_wait_ms_max"] = max(
+                        stats["queue_wait_ms_max"], wait * 1000.0
+                    )
 
+    def _emit_batch(
+        self, lane, rows: int, bucket: int, waits: List[float], expired: int, abandoned: int
+    ) -> None:
         self._emit(
             "on_serve_batch",
             {
@@ -741,13 +1147,13 @@ class ScoringService:
             },
         )
 
-    def _rank(self, hidden: np.ndarray, rows: int, bucket: int):
+    def _rank(self, pipeline: CandidatePipeline, hidden: np.ndarray, rows: int, bucket: int):
         """Run the fused retrieve→rerank path at the padded batch bucket —
         the pipeline's jitted programs then only ever see the bucket ladder's
         shapes (no per-fill retrace)."""
         if rows < bucket:
             hidden = np.concatenate([hidden, np.repeat(hidden[:1], bucket - rows, 0)])
-        scores, ids = self.retrieval.rank(hidden, tracer=self.tracer)
+        scores, ids = pipeline.rank(hidden, tracer=self.tracer)
         return scores[:rows], ids[:rows]
 
     def _build_response(
@@ -759,6 +1165,8 @@ class ScoringService:
         logits_row: Optional[np.ndarray],
         ranked_scores: Optional[np.ndarray],
         ranked_ids: Optional[np.ndarray],
+        generation: int = 0,
+        role: str = "stable",
     ) -> ScoreResponse:
         request = item.request
         if self.retrieval is not None:
@@ -787,6 +1195,8 @@ class ScoringService:
             queue_wait_s=queue_wait,
             batch_bucket=batch_bucket,
             served_by=item.served_by,
+            generation=generation,
+            role=role,
         )
 
     # -- future resolution helpers ------------------------------------------ #
@@ -894,6 +1304,10 @@ class ScoringService:
             circuit_refusals = self._circuit_refusals
             wait_sum = self._queue_wait_sum
             wait_max = self._queue_wait_max
+            roles = {role: dict(stats) for role, stats in self._role_stats.items()}
+            generation_misses = self._generation_misses
+            generation_reroutes = self._generation_reroutes
+            canary = self._canary
         answered = sum(served.values())
         reused = served["hit"] + served["advance"]
         return {
@@ -923,4 +1337,14 @@ class ScoringService:
             "engine": engine,
             "cache": cache,
             "batcher": batcher,
+            # hot-swap / canary visibility (serve.promote)
+            "generations": self.store.stats(),
+            "roles": roles,
+            "generation_misses": generation_misses,
+            "generation_reroutes": generation_reroutes,
+            "canary": (
+                {"generation": canary[0], "fraction": canary[1]}
+                if canary is not None
+                else None
+            ),
         }

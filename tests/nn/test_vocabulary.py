@@ -164,3 +164,129 @@ def test_bert4rec_surgery_and_warm_start_state():
         state, loss_value = trainer.train_step(state, batch)
         losses.append(float(loss_value))
     assert losses[-1] < losses[0]
+
+
+# --------------------------------------------------------------------------- #
+# optimizer-state-safe surgery (continual training, docs/robustness.md)
+# --------------------------------------------------------------------------- #
+def _item_moments(opt_state):
+    """Every optimizer-state leaf mirroring the item table, as numpy."""
+    from replay_tpu.nn.vocabulary import _find_moment_leaves
+
+    return [
+        np.asarray(leaf)
+        for _, leaf in _find_moment_leaves(
+            jax.tree.map(np.asarray, opt_state), "item_id"
+        )
+    ]
+
+
+def _trained_state(trainer, rng, steps=3, num_items=NUM_ITEMS):
+    state = trainer.init_state(make_batch(num_items, rng))
+    for _ in range(steps):
+        state, _ = trainer.train_step(state, make_batch(num_items, rng))
+    return state
+
+
+def test_resize_vocabulary_carries_adam_moments_in_lockstep():
+    """Mid-run growth: trained rows keep their mu/nu, cold rows start at
+    zero, the padding row's moments move to the new end with it."""
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+    state = _trained_state(trainer, np.random.default_rng(0))
+    before = _item_moments(state.opt_state)
+    assert len(before) >= 2  # adam: mu and nu at least
+    assert any(np.abs(m).max() > 0 for m in before)  # the moments are TRAINED
+
+    grown = trainer.resize_vocabulary(state, NUM_ITEMS + 4)  # carry_opt_state default
+    after = _item_moments(grown.opt_state)
+    assert len(after) == len(before)
+    for old, new in zip(before, after):
+        assert new.shape == (NUM_ITEMS + 5, 8)
+        np.testing.assert_array_equal(new[:NUM_ITEMS], old[:NUM_ITEMS])
+        np.testing.assert_array_equal(new[NUM_ITEMS:-1], 0.0)  # cold rows: fresh
+        np.testing.assert_array_equal(new[-1], old[-1])  # padding moments moved last
+    # step/rng carry over and the state still trains on the new ids
+    rng = np.random.default_rng(7)
+    grown, loss_value = trainer.train_step(grown, make_batch(NUM_ITEMS + 4, rng))
+    assert np.isfinite(float(loss_value))
+
+
+def test_resize_item_embeddings_opt_state_roundtrip_and_out_of_sync_guard():
+    from replay_tpu.nn.vocabulary import resize_optimizer_state
+
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+    state = _trained_state(trainer, np.random.default_rng(1))
+    params = jax.tree.map(np.asarray, state.params)
+    opt_host = jax.tree.map(np.asarray, state.opt_state)
+
+    params2, opt2 = resize_item_embeddings(
+        params, schema, NUM_ITEMS + 2, opt_state=opt_host
+    )
+    table = params2["body"]["embedder"]["embedding_item_id"]["table"]["embedding"]
+    assert table.shape == (NUM_ITEMS + 3, 8)
+    for moment in _item_moments(opt2):
+        assert moment.shape == (NUM_ITEMS + 3, 8)
+
+    # resizing AGAIN with the schema already moved but the OLD opt state is
+    # the out-of-sync case: the error names the path, not an optax traceback
+    with pytest.raises(ValueError, match="out of sync"):
+        resize_optimizer_state(opt_host, "item_id", NUM_ITEMS + 2, NUM_ITEMS + 4)
+
+
+def test_fit_rejects_resumed_state_with_stale_opt_state():
+    """The satellite guard: params grown without their moments must fail at
+    fit start with an error NAMING the table path."""
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+    rng = np.random.default_rng(2)
+    state = _trained_state(trainer, rng)
+    grown_params = resize_item_embeddings(
+        jax.tree.map(np.asarray, state.params), schema, NUM_ITEMS + 4
+    )
+    stale = state.replace(params=grown_params)  # opt_state NOT resized
+    with pytest.raises(ValueError, match="embedding_item_id"):
+        trainer.fit([make_batch(NUM_ITEMS + 4, rng)], epochs=1, state=stale)
+
+
+def test_validate_optimizer_state_passes_on_consistent_pair():
+    from replay_tpu.nn.vocabulary import validate_optimizer_state
+
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+    state = _trained_state(trainer, np.random.default_rng(3), steps=1)
+    validate_optimizer_state(state.params, state.opt_state, schema)  # no raise
+    grown = trainer.resize_vocabulary(state, NUM_ITEMS + 4)
+    validate_optimizer_state(grown.params, grown.opt_state, schema)  # still in sync
+
+
+def test_finetune_entry_grows_then_fits_from_trained_state():
+    """Trainer.finetune: the continual-training seam — optional xavier-grown
+    catalog, optimizer moments carried, then a plain fit on the fresh tail."""
+    schema = make_schema()
+    model = SasRec(schema=schema, embedding_dim=8, num_blocks=1, max_sequence_length=SEQ_LEN)
+    trainer = Trainer(model=model, loss=CE(), optimizer=OptimizerFactory(learning_rate=1e-2))
+    rng = np.random.default_rng(4)
+    state = _trained_state(trainer, rng)
+    old_table = np.asarray(
+        jax.tree.map(np.asarray, state.params)
+        ["body"]["embedder"]["embedding_item_id"]["table"]["embedding"]
+    ).copy()
+
+    tail = [make_batch(NUM_ITEMS + 4, rng) for _ in range(2)]
+    tuned = trainer.finetune(state, tail, new_cardinality=NUM_ITEMS + 4)
+    table = np.asarray(
+        jax.tree.map(np.asarray, tuned.params)
+        ["body"]["embedder"]["embedding_item_id"]["table"]["embedding"]
+    )
+    assert table.shape == (NUM_ITEMS + 5, 8)
+    assert schema["item_id"].cardinality == NUM_ITEMS + 4
+    # the fit actually trained (params moved) and shrink is refused
+    assert np.abs(table[:NUM_ITEMS] - old_table[:NUM_ITEMS]).max() > 0
+    with pytest.raises(ValueError, match="shrink"):
+        trainer.finetune(tuned, tail, new_cardinality=NUM_ITEMS)

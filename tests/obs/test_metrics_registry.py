@@ -314,3 +314,45 @@ def test_bridge_empty_batch_skips_fill_and_wait():
 
 def test_fill_buckets_cover_the_unit_interval():
     assert FILL_BUCKETS[-1] == 1.0
+
+
+def test_bridge_promotion_events():
+    """The serve.promote event family replays from events into the same
+    replay_canary_* / swap / rollback series the live controller maintains."""
+    logger = MetricsLogger()
+    registry = logger.registry
+    logger.log_event(TrainerEvent(event="on_publish", payload={
+        "generation": 1, "label": "v1", "recompiled": True,
+        "recompile_reason": "leaf 'x' has shape (5, 2)",
+    }))
+    assert registry.value("replay_publish_total") == 1.0
+    assert registry.value("replay_publish_recompiled_total") == 1.0
+    logger.log_event(TrainerEvent(event="on_canary_start", payload={
+        "generation": 1, "fraction": 0.25,
+    }))
+    assert registry.value("replay_canary_stage") == 2.0
+    assert registry.value("replay_canary_generation") == 1.0
+    logger.log_event(TrainerEvent(event="on_canary_eval", payload={
+        "generation": 1, "error_rate": 0.125, "clean_evals": 2,
+        "window": {"requests": 16.0},
+    }))
+    assert registry.value("replay_canary_error_rate") == 0.125
+    assert registry.value("replay_canary_clean_evals") == 2.0
+    assert registry.value("replay_canary_requests") == 16.0
+    logger.log_event(TrainerEvent(event="on_swap", payload={
+        "reason": "promote", "from_generation": 0, "to_generation": 1,
+        "recompiled": True,
+    }))
+    assert registry.value("replay_swap_total") == 1.0
+    assert registry.value("replay_param_generation") == 1.0
+    logger.log_event(TrainerEvent(event="on_promotion", payload={
+        "generation": 1, "from_generation": 0, "clean_evals": 3, "evals": 3,
+    }))
+    assert registry.value("replay_promotions_total") == 1.0
+    assert registry.value("replay_canary_stage") == 3.0
+    logger.log_event(TrainerEvent(event="on_rollback", payload={
+        "generation": 2, "restored_generation": 1, "rules": ["canary_errors"],
+    }))
+    assert registry.value("replay_rollbacks_total") == 1.0
+    assert registry.value("replay_canary_stage") == -1.0
+    assert registry.value("replay_param_generation") == 1.0  # restored gen

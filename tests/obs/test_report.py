@@ -857,3 +857,112 @@ def test_serve_quant_topk_match_gates(tmp_path, capsys):
     candidate = _write_quant_serve_run(str(tmp_path / "cand"), topk_match=0.9)
     assert main([candidate, "--compare", baseline]) != 0
     assert "serve_quant_topk_match_rate" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------- #
+# promotion: canary lifecycle summary, rollback + swap_p99_ms compare gates
+# --------------------------------------------------------------------------- #
+def _write_promotion_run(path, rollbacks=0, promotions=1, swap_p99_ms=None,
+                         qps=250.0):
+    os.makedirs(path, exist_ok=True)
+    events = [
+        {"event": "on_serve_start", "time": 1.0, "mode": "full",
+         "length_buckets": [8], "batch_buckets": [1, 4], "max_wait_ms": 2.0,
+         "cache_capacity": 100},
+        {"event": "on_publish", "time": 1.1, "generation": 1,
+         "label": "candidate-a", "recompiled": False, "recompile_reason": None},
+        {"event": "on_canary_start", "time": 1.2, "generation": 1, "fraction": 0.1},
+        {"event": "on_canary_eval", "time": 1.3, "stage": "canary",
+         "generation": 1, "action": None, "error_rate": 0.0,
+         "window": {"requests": 8.0, "answered": 8.0, "errors": 0.0, "shed": 0.0},
+         "clean_evals": 1, "evals": 1, "breached_rules": []},
+    ]
+    for _ in range(promotions):
+        events += [
+            {"event": "on_promotion", "time": 1.4, "generation": 1,
+             "from_generation": 0, "clean_evals": 2, "evals": 2},
+            {"event": "on_swap", "time": 1.4, "reason": "promote",
+             "from_generation": 0, "to_generation": 1, "recompiled": False},
+        ]
+    for _ in range(rollbacks):
+        events += [
+            {"event": "on_rollback", "time": 1.5, "generation": 2,
+             "restored_generation": 1, "rules": ["canary_error_rate"], "evals": 3},
+            {"event": "on_swap", "time": 1.5, "reason": "rollback",
+             "from_generation": 2, "to_generation": 1, "recompiled": False},
+        ]
+    events.append(
+        {"event": "on_serve_end", "time": 3.0, "mode": "full", "requests": 20,
+         "answered": 20, "errors": 0, "cache_hit_rate": 0.5,
+         "batch_fill_ratio": 0.8, "queue_wait_ms_mean": 1.0,
+         "queue_wait_ms_max": 2.0,
+         "served_from": {"hit": 10, "advance": 5, "cold": 5}},
+    )
+    record = {"metric": "serve_qps", "value": qps, "unit": "req/s", "qps": qps,
+              "p50_ms": 1.2, "p95_ms": 3.1, "p99_ms": 4.0,
+              "batch_fill_ratio": 0.8, "cache_hit_rate": 0.5, "mode": "full",
+              "backend": "cpu"}
+    if swap_p99_ms is not None:
+        record["swap"] = {"swaps": 3, "p99_ms": swap_p99_ms, "errors": 0,
+                          "generations_seen": 4, "recompiled_swaps": 1}
+    events.append(record)
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+    return path
+
+
+def test_promotion_summary_and_render(tmp_path, capsys):
+    run = _write_promotion_run(str(tmp_path / "promo"), rollbacks=1,
+                               swap_p99_ms=6.5)
+    summary = summarize_run(run)
+    assert summary["rollbacks"] == 1
+    assert summary["promotions"] == 1
+    assert summary["swaps"] == 2
+    promotion = summary["promotion"]
+    assert promotion["publishes"] == 1
+    assert promotion["canaries"] == 1
+    assert promotion["canary_evals"] == 1
+    assert promotion["rollback_rules"] == ["canary_error_rate"]
+    assert summary["serve"]["swap"] is True
+    assert summary["serve"]["swap_p99_ms"] == 6.5
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "promotion:" in out
+    assert "1 rolled back" in out
+    assert "serving swap:" in out
+    assert "rollback rule(s): canary_error_rate" in out
+
+
+def test_compare_gates_on_rollback_increase(tmp_path, capsys):
+    baseline = _write_promotion_run(str(tmp_path / "base"), rollbacks=0)
+    candidate = _write_promotion_run(str(tmp_path / "cand"), rollbacks=1)
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "rollbacks increased" in capsys.readouterr().err
+
+
+def test_compare_rollbacks_equal_passes(tmp_path):
+    baseline = _write_promotion_run(str(tmp_path / "base"), rollbacks=1)
+    candidate = _write_promotion_run(str(tmp_path / "cand"), rollbacks=1)
+    assert main([candidate, "--compare", baseline]) == 0
+
+
+def test_compare_gates_swap_p99_when_both_ran_swaps(tmp_path, capsys):
+    baseline = _write_promotion_run(str(tmp_path / "base"), swap_p99_ms=5.0)
+    candidate = _write_promotion_run(str(tmp_path / "cand"), swap_p99_ms=9.0)
+    assert main([candidate, "--compare", baseline]) == 2
+    assert "swap_p99_ms regressed" in capsys.readouterr().err
+
+
+def test_compare_surfaces_swap_p99_ungated_on_phase_mismatch(tmp_path, capsys):
+    baseline = _write_promotion_run(str(tmp_path / "base"), swap_p99_ms=None)
+    candidate = _write_promotion_run(str(tmp_path / "cand"), swap_p99_ms=50.0)
+    assert main([candidate, "--compare", baseline]) == 0
+    out = capsys.readouterr().out
+    assert "swap_p99_ms" in out and "not gated" in out
+
+
+def test_compare_swap_p99_improvement_passes(tmp_path):
+    baseline = _write_promotion_run(str(tmp_path / "base"), swap_p99_ms=9.0)
+    candidate = _write_promotion_run(str(tmp_path / "cand"), swap_p99_ms=5.0)
+    assert main([candidate, "--compare", baseline]) == 0

@@ -37,9 +37,12 @@ def test_bench_serve_one_json_line(tmp_path):
         "REPLAY_TPU_SERVE_TOPK": "3",
         "REPLAY_TPU_SERVE_BATCH_BUCKETS": "1,4",
         # resilience phases: open-loop overload at 4x measured capacity with
-        # per-request deadlines, then deterministic chaos injection
+        # per-request deadlines, then deterministic chaos injection; the swap
+        # phase runs BEFORE them (its zero-error claim must stay unpolluted)
         "REPLAY_TPU_SERVE_CHAOS": "1",
         "REPLAY_TPU_SERVE_OVERLOAD_SECONDS": "1",
+        "REPLAY_TPU_SERVE_SWAPS": "2",
+        "REPLAY_TPU_SERVE_SWAP_GAP_MS": "100",
         # the tiny CPU model outruns a single open-loop generator thread, so
         # admission control must be made reachable: tight lanes + a high
         # factor (the default 4x/auto-depth shape is for real configs)
@@ -87,6 +90,19 @@ def test_bench_serve_one_json_line(tmp_path):
     assert overload["hung_requests"] == 0
     assert overload["p99_ms"] <= 150 + 1000, overload  # deadline + slack, not ∞
     assert overload["errors"] == 0
+
+    # swap under load (serve.promote): N hot swaps completed with ZERO request
+    # errors, every swap a zero-recompile pointer move, p99 bounded/finite,
+    # and the generation tags observed prove both sides of each swap served
+    swap = record["swap"]
+    assert swap["swaps"] == 2
+    assert swap["errors"] == 0, swap["first_error"]
+    assert swap["recompiled_swaps"] == 0  # same shapes: never recompiled
+    assert swap["answered"] > 0
+    assert swap["p99_ms"] > 0 and swap["p99_ms"] < 120_000
+    assert swap["generations_seen"] >= 1
+    assert swap["final_generation"] == 2
+    assert swap["swap_apply_ms_max"] > 0
 
     # chaos: injected engine faults tripped the breaker, degraded traffic is
     # tagged, the breaker re-closed, and no future was left hanging

@@ -139,3 +139,33 @@ class TestRefreshEmbedding:
         # the newer window's own refresh lands
         cache.refresh_embedding("u", newer, np.full(16, 2.0, np.float32))
         assert cache.peek("u").embedding is not None
+
+
+class TestParamGenerationStamp:
+    """Hot-swap staleness (serve.promote): cached embeddings carry the PARAM
+    generation that encoded them, so a weight swap can treat every pre-swap
+    embedding as a miss instead of scoring it through new weights."""
+
+    def test_refresh_stamps_param_generation(self):
+        cache = UserStateCache(4)
+        state = _state([1, 2])
+        cache.store("u", state)
+        cache.refresh_embedding("u", state, np.ones(16, np.float32), param_generation=3)
+        assert cache.peek("u").param_generation == 3
+
+    def test_default_stamp_is_generation_zero(self):
+        cache = UserStateCache(4)
+        state = _state([1])
+        cache.store("u", state)
+        cache.refresh_embedding("u", state, np.ones(16, np.float32))
+        assert cache.peek("u").param_generation == 0
+
+    def test_advance_drops_embedding_and_next_refresh_restamps(self):
+        cache = UserStateCache(4)
+        state = _state([1, 2])
+        cache.store("u", state)
+        cache.refresh_embedding("u", state, np.ones(16, np.float32), param_generation=1)
+        advanced = cache.advance_user("u", [3])
+        assert advanced.embedding is None  # certifies the OLD window only
+        cache.refresh_embedding("u", advanced, np.ones(16, np.float32), param_generation=2)
+        assert cache.peek("u").param_generation == 2

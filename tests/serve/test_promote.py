@@ -1,0 +1,376 @@
+"""Promotion robustness: ParamStore atomicity, the canary state machine, and
+zero-downtime hot swaps through a live ScoringService.
+
+Host-only halves (ParamStore, PromotionController over a fake service) run in
+the core tier; the end-to-end swap/canary tests are jax+smoke and pin the
+PR's acceptance contract: every response carries ONE consistent generation
+(its scores reproduce that generation's direct forward bit-for-bit), a swap
+empties effective cache hits instead of mixing generations, a forced SLO
+breach rolls back exactly once, and chaos mid-swap rides the degradation
+ladder instead of erroring.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from replay_tpu.obs.metrics import MetricsRegistry
+from replay_tpu.obs.slo import SLORule
+from replay_tpu.serve.promote import (
+    ParamStore,
+    PromotionController,
+    in_canary_slice,
+)
+
+
+class RecordingLogger:
+    def __init__(self):
+        self.events = []
+
+    def log_event(self, event):
+        self.events.append(event)
+
+    def close(self):
+        pass
+
+    def named(self, name):
+        return [e for e in self.events if e.event == name]
+
+
+# --------------------------------------------------------------------------- #
+# ParamStore (host-only)
+# --------------------------------------------------------------------------- #
+class TestParamStore:
+    def test_generation_counter_and_resolution(self):
+        store = ParamStore({"w": np.zeros(2)})
+        assert store.stable_generation == 0
+        g1 = store.publish({"w": np.ones(2)}, label="v1")
+        assert g1 == 1
+        assert store.candidate_generation == 1
+        # candidate role resolves the candidate; stable stays pinned
+        assert store.resolve("candidate").number == 1
+        assert store.resolve("stable").number == 0
+
+    def test_candidate_role_falls_back_to_stable(self):
+        store = ParamStore({"w": 0})
+        assert store.resolve("candidate").number == 0  # no candidate yet
+
+    def test_promote_pins_previous_and_rollback_restores(self):
+        store = ParamStore({"w": 0})
+        g1 = store.publish({"w": 1})
+        info = store.promote(g1)
+        assert info == {"from_generation": 0, "to_generation": 1}
+        assert store.stable_generation == 1
+        assert store.previous_generation == 0
+        assert store.candidate_generation is None
+        back = store.rollback()
+        assert back == {"from_generation": 1, "to_generation": 0}
+        assert store.stable_generation == 0
+        assert store.rollbacks == 1
+
+    def test_rollback_without_previous_raises(self):
+        store = ParamStore({"w": 0})
+        with pytest.raises(ValueError, match="nothing to roll back"):
+            store.rollback()
+
+    def test_canary_rollback_drops_candidate_without_moving_stable(self):
+        """Mid-canary rollback: stable never moved, so burning the candidate
+        IS the restoration (no pointer swap, still ONE rollback incident)."""
+        store = ParamStore({"w": 0})
+        g1 = store.publish({"w": 1})
+        info = store.rollback()
+        assert info == {"from_generation": g1, "to_generation": 0}
+        assert store.candidate_generation is None
+        assert store.stable_generation == 0
+        assert store.rollbacks == 1 and store.swaps == 0
+
+    def test_promote_without_candidate_raises(self):
+        store = ParamStore({"w": 0})
+        with pytest.raises(ValueError, match="no candidate"):
+            store.promote()
+
+    def test_eviction_keeps_pinned_generations(self):
+        store = ParamStore({"w": 0}, keep_history=1)
+        first = store.publish({"w": 1})
+        store.promote(first)  # stable=1, previous=0 (both pinned)
+        for i in range(2, 6):
+            store.publish({"w": i})
+        stats = store.stats()
+        assert 0 in stats["resident_generations"]  # pinned previous survives
+        assert 1 in stats["resident_generations"]  # pinned stable survives
+        assert stats["candidate_generation"] in stats["resident_generations"]
+        # unpinned middle generations were dropped
+        assert 2 not in stats["resident_generations"]
+        with pytest.raises(KeyError, match="no longer resident"):
+            store.generation(2)
+
+    def test_history_log_is_pure_json(self):
+        import json
+
+        store = ParamStore({"w": 0})
+        g1 = store.publish({"w": 1}, label="candidate-a")
+        store.promote(g1)
+        store.rollback()
+        log = store.history()
+        assert [entry["event"] for entry in log] == [
+            "published", "published", "promoted", "rolled_back",
+        ]
+        json.dumps(log)  # serializable as-is (the CI artifact)
+
+    def test_concurrent_resolve_never_sees_torn_state(self):
+        """Readers racing promotes always get a COMPLETE generation whose
+        number matches its params (the atomicity contract)."""
+        store = ParamStore({"v": 0})
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                gen = store.resolve("stable")
+                if gen.params["v"] != gen.number:
+                    bad.append((gen.number, gen.params["v"]))
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for i in range(1, 50):
+            number = store.publish({"v": i})
+            assert store.generation(number).params["v"] == i
+            store.promote(number)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not bad
+        # v == generation number by construction in this test
+        assert store.resolve("stable").params["v"] == store.stable_generation
+
+
+class TestCanarySlice:
+    def test_deterministic_and_stable(self):
+        for user in ("alice", "bob", 7, ("t", 1)):
+            assert in_canary_slice(user, 0.3) == in_canary_slice(user, 0.3)
+
+    def test_edges(self):
+        assert not in_canary_slice("anyone", 0.0)
+        assert in_canary_slice("anyone", 1.0)
+
+    def test_fraction_is_monotone_per_user(self):
+        users = [f"user-{i}" for i in range(500)]
+        small = {u for u in users if in_canary_slice(u, 0.1)}
+        large = {u for u in users if in_canary_slice(u, 0.5)}
+        assert small <= large  # growing the slice never reroutes existing members
+        # and the slice size is roughly the fraction
+        assert 20 <= len(small) <= 120
+        assert 180 <= len(large) <= 320
+
+
+# --------------------------------------------------------------------------- #
+# PromotionController state machine (host-only, fake service, injectable clock)
+# --------------------------------------------------------------------------- #
+class FakeService:
+    """Just enough ScoringService surface for the controller."""
+
+    def __init__(self):
+        self.metrics_registry = MetricsRegistry()
+        self.events = []
+        self.next_generation = 1
+        self.canary = None
+        self.promote_calls = []
+        self.rollback_calls = 0
+        self.counts = {
+            "stable": {"requests": 0.0, "answered": 0.0, "errors": 0.0,
+                       "shed": 0.0, "queue_wait_ms_max": 0.0},
+            "candidate": {"requests": 0.0, "answered": 0.0, "errors": 0.0,
+                          "shed": 0.0, "queue_wait_ms_max": 0.0},
+        }
+
+    def _route_event(self, event):
+        self.events.append(event)
+
+    def _emit(self, name, payload):
+        from replay_tpu.obs import TrainerEvent
+
+        self._route_event(TrainerEvent(event=name, payload=payload))
+
+    def publish_candidate(self, params, label="", pipeline=None):
+        generation = self.next_generation
+        self.next_generation += 1
+        return generation
+
+    def begin_canary(self, generation, fraction):
+        self.canary = (generation, fraction)
+
+    def promote(self, generation=None):
+        self.promote_calls.append(generation)
+        self.canary = None
+        return {"from_generation": 0, "to_generation": generation}
+
+    def rollback(self):
+        self.rollback_calls += 1
+        self.canary = None
+        return {"from_generation": 1, "to_generation": 0}
+
+    def canary_stats(self):
+        return {role: dict(stats) for role, stats in self.counts.items()}
+
+    def serve_canary(self, answered=0, errors=0):
+        counts = self.counts["candidate"]
+        counts["requests"] += answered + errors
+        counts["answered"] += answered
+        counts["errors"] += errors
+
+    def named(self, name):
+        return [e for e in self.events if e.event == name]
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def make_controller(service=None, **kwargs):
+    service = service if service is not None else FakeService()
+    clock = FakeClock()
+    kwargs.setdefault("promote_after", 3)
+    kwargs.setdefault("min_canary_requests", 2)
+    controller = PromotionController(service, clock=clock, **kwargs)
+    return controller, service, clock
+
+
+class TestPromotionController:
+    def test_promotes_after_k_clean_evals(self):
+        controller, service, clock = make_controller()
+        generation = controller.publish({"w": 1}, label="v1")
+        assert controller.stage == "shadow"
+        controller.begin_canary(fraction=0.25)
+        assert controller.stage == "canary"
+        assert service.canary == (generation, 0.25)
+        for i in range(3):
+            service.serve_canary(answered=4)
+            clock.advance(1.0)
+            record = controller.evaluate()
+            assert record["error_rate"] == 0.0
+        assert controller.stage == "promoted"
+        assert service.promote_calls == [generation]
+        assert controller.clean_evals == 3
+        promo = service.named("on_promotion")
+        assert len(promo) == 1 and promo[0].payload["generation"] == generation
+
+    def test_empty_windows_are_not_clean_evidence(self):
+        controller, service, clock = make_controller()
+        controller.publish({"w": 1})
+        controller.begin_canary()
+        for _ in range(10):  # no canary traffic at all
+            clock.advance(1.0)
+            controller.evaluate()
+        assert controller.stage == "canary"  # never promoted on silence
+        assert controller.clean_evals == 0
+
+    def test_breach_rolls_back_exactly_once(self):
+        controller, service, clock = make_controller()
+        controller.publish({"w": 1})
+        controller.begin_canary()
+        service.serve_canary(answered=3, errors=2)
+        clock.advance(1.0)
+        record = controller.evaluate()
+        assert record["action"] == "rollback"
+        assert controller.stage == "rolled_back"
+        assert service.rollback_calls == 1
+        # further evaluations are inert: ONE rollback per canary
+        for _ in range(5):
+            clock.advance(1.0)
+            assert controller.evaluate()["action"] is None
+        assert service.rollback_calls == 1
+        assert len(service.named("on_rollback")) == 1
+        assert len(service.named("on_slo_violation")) == 1
+
+    def test_recanary_after_rollback_requires_new_generation(self):
+        controller, service, clock = make_controller()
+        controller.publish({"w": 1})
+        controller.begin_canary()
+        service.serve_canary(answered=1, errors=1)
+        controller.evaluate()
+        assert controller.stage == "rolled_back"
+        with pytest.raises(RuntimeError, match="new generation"):
+            controller.begin_canary()
+        # a NEW publish resets the machine to shadow and canary works again
+        second = controller.publish({"w": 2})
+        controller.begin_canary()
+        assert controller.stage == "canary"
+        assert service.canary[0] == second
+
+    def test_clean_then_dirty_resets_nothing_but_rolls_back(self):
+        """A breach after clean evaluations still rolls back — clean history
+        is not credit against a live regression."""
+        controller, service, clock = make_controller(promote_after=5)
+        controller.publish({"w": 1})
+        controller.begin_canary()
+        for _ in range(3):
+            service.serve_canary(answered=4)
+            clock.advance(1.0)
+            controller.evaluate()
+        assert controller.stage == "canary" and controller.clean_evals == 3
+        service.serve_canary(answered=1, errors=3)
+        clock.advance(1.0)
+        controller.evaluate()
+        assert controller.stage == "rolled_back"
+
+    def test_error_rate_is_windowed_not_cumulative(self):
+        """Errors before the current window must not re-trip the watchdog:
+        each evaluation reads the delta since the previous one."""
+        controller, service, clock = make_controller(
+            rules=(SLORule("replay_canary_error_rate", ">", 0.4, name="canary_err"),),
+            promote_after=2,
+        )
+        controller.publish({"w": 1})
+        controller.begin_canary()
+        service.serve_canary(answered=1, errors=1)  # 50% in window 1 — breach
+        clock.advance(1.0)
+        assert controller.evaluate()["action"] == "rollback"
+
+        second = FakeService()
+        controller2, service2, clock2 = make_controller(
+            service=second,
+            rules=(SLORule("replay_canary_error_rate", ">", 0.4, name="canary_err"),),
+            promote_after=2,
+        )
+        controller2.publish({"w": 1})
+        controller2.begin_canary()
+        service2.serve_canary(answered=8, errors=2)  # 20% — clean window
+        clock2.advance(1.0)
+        assert controller2.evaluate()["action"] is None
+        service2.serve_canary(answered=8, errors=0)
+        clock2.advance(1.0)
+        assert controller2.evaluate()["action"] == "promote"
+
+    def test_canary_gauges_land_in_registry(self):
+        controller, service, clock = make_controller()
+        controller.publish({"w": 1})
+        controller.begin_canary()
+        service.serve_canary(answered=4)
+        clock.advance(1.0)
+        controller.evaluate()
+        registry = controller.registry
+        assert registry.value("replay_canary_error_rate") == 0.0
+        assert registry.value("replay_canary_requests") == 4.0
+        assert registry.value("replay_canary_generation") == 1.0
+        assert registry.value("replay_canary_stage") == 2.0
+
+    def test_eval_events_are_emitted(self):
+        controller, service, clock = make_controller()
+        controller.publish({"w": 1})
+        controller.begin_canary()
+        service.serve_canary(answered=2)
+        controller.evaluate()
+        evals = service.named("on_canary_eval")
+        assert len(evals) == 1
+        payload = evals[0].payload
+        assert payload["generation"] == 1 and payload["window"]["answered"] == 2.0
