@@ -180,6 +180,30 @@ class MIPSIndex:
         self._search_cache[k] = search
         return search
 
+    def search_hlo(self, rows: int, k: int) -> str:
+        """Compiled HLO text of the ``[rows, dim]`` search program — the
+        input :func:`~replay_tpu.parallel.introspect.collective_inventory`
+        hard-asserts over: a mesh-sharded index must move per-shard top-k
+        CANDIDATES (``k x n_shards`` rows) across the mesh, never the
+        ``[I/n, E]`` table rows themselves. Uses the same cached jitted
+        search the serving path runs, so the assertion inspects the real
+        program, not a re-derivation."""
+        import jax
+        import jax.numpy as jnp
+
+        spec = jax.ShapeDtypeStruct((int(rows), self.dim), jnp.float32)
+        return self._compiled_search(k).lower(spec).compile().as_text()
+
+    def table_shard_bytes(self) -> int:
+        """Per-shard payload bytes of the device table (padded rows included)
+        — the collective-size threshold the no-gather assertion compares
+        against."""
+        rows = int(self.item_vectors.shape[0])
+        if self.mesh is not None:
+            rows = rows // int(self.mesh.shape[self.axis_name])
+        itemsize = 1 if self.precision == "int8" else 4
+        return rows * self.dim * itemsize
+
     def exact_rescore(self, query_vectors, candidate_ids):
         """Full-precision scores of already-retrieved candidates.
 

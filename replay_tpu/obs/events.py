@@ -46,6 +46,18 @@ with its own event family::
                                degradation totals, breaker stats, serve
                                goodput)
 
+and the fleet router (``serve/fleet.py``) one level above that::
+
+    on_fleet_start            (replica ids, vnodes, hedge/backoff config)
+      on_replica_health*      (one per health transition: replica, from, to,
+                               reason — heartbeat/gauge driven)
+      on_failover*            (a replica declared dead: replica, reason,
+                               ~fraction of users rerouted)
+      on_hedge*               (a slow request raced on a second replica:
+                               user, primary, hedge target)
+    on_fleet_end              (request/reroute/retry/hedge totals, per-replica
+                               routing counts, router-observed p50/p99)
+
 Every event flattens to one JSON-able dict (``event`` + ``time`` + optional
 ``step``/``epoch`` + the payload), so a run directory's ``events.jsonl`` is a
 self-describing artifact shared by training runs, ``bench.py`` /
@@ -422,6 +434,24 @@ class ConsoleLogger(RunLogger):
                 event.payload.get("count", 1),
                 event.payload.get("to"),
                 event.payload.get("reason"),
+            )
+        elif event.event == "on_replica_health":
+            to_state = event.payload.get("to")
+            emit = logger.warning if to_state in ("degraded", "dead") else logger.info
+            emit(
+                "fleet replica %s: %s -> %s (%s)",
+                event.payload.get("replica"),
+                event.payload.get("from"),
+                to_state,
+                event.payload.get("reason"),
+            )
+        elif event.event == "on_failover":
+            logger.warning(
+                "fleet failover: replica %s dead (%s) — ~%.0f%% of users "
+                "rerouted along the ring",
+                event.payload.get("replica"),
+                event.payload.get("reason"),
+                100.0 * (event.payload.get("users_fraction") or 0.0),
             )
         elif event.event == "on_swap":
             logger.info(

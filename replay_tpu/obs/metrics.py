@@ -336,6 +336,10 @@ def render_prometheus(registry: MetricsRegistry) -> str:
 # the event -> registry bridge
 # --------------------------------------------------------------------------- #
 _BREAKER_STATES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+# fleet replica health as a scrapeable ordinal (serve.router.REPLICA_HEALTH)
+_REPLICA_HEALTH_STATES = {
+    "healthy": 0.0, "degraded": 1.0, "draining": 2.0, "dead": 3.0,
+}
 
 # step-time buckets in seconds: sub-ms CPU microbenches up to multi-second
 # accelerator steps
@@ -562,6 +566,39 @@ class MetricsLogger(RunLogger):
             ):
                 self._gauge(metric, payload.get(key))
             self.registry.set("replay_serve_up", 0.0)
+        # the fleet family (serve.fleet): per-replica health as a labeled
+        # ordinal gauge plus failover/hedge counters — the replay_fleet_*
+        # rows docs/observability.md documents
+        elif name == "on_fleet_start":
+            self.registry.set("replay_fleet_up", 1.0)
+            replicas = payload.get("replicas")
+            if isinstance(replicas, (list, tuple)):
+                self.registry.set("replay_fleet_replicas", float(len(replicas)))
+        elif name == "on_replica_health":
+            self.registry.inc("replay_fleet_health_transitions_total")
+            state = _REPLICA_HEALTH_STATES.get(str(payload.get("to")))
+            if state is not None:
+                self.registry.set(
+                    "replay_fleet_replica_health", state,
+                    labels={"replica": str(payload.get("replica"))},
+                )
+        elif name == "on_failover":
+            self.registry.inc("replay_fleet_failovers_total")
+        elif name == "on_hedge":
+            self.registry.inc("replay_fleet_hedges_total")
+        elif name == "on_fleet_end":
+            for key, metric in (
+                ("requests", "replay_fleet_requests"),
+                ("answered", "replay_fleet_answered"),
+                ("reroutes", "replay_fleet_reroutes"),
+                ("retries", "replay_fleet_retries"),
+                ("hedge_wins", "replay_fleet_hedge_wins"),
+                ("reroute_rate", "replay_fleet_reroute_rate"),
+                ("error_rate", "replay_fleet_error_rate"),
+                ("p99_ms", "replay_fleet_p99_ms"),
+            ):
+                self._gauge(metric, payload.get(key))
+            self.registry.set("replay_fleet_up", 0.0)
         elif name == "on_slo_violation":
             self.registry.inc(
                 "replay_slo_violations_total",

@@ -732,6 +732,105 @@ def summarize_events(
             serve["swap_generations"] = swap.get("generations_seen")
             serve["swap_recompiled"] = swap.get("recompiled_swaps")
     summary["serve"] = serve or None
+
+    # the fleet summary (serve.fleet): router-level health/failover/hedge
+    # events plus the bench_fleet.py record — per-replica serve totals come
+    # from the merged per-replica event shards (each replica logs through
+    # JsonlLogger(process_index=i), the PR-10 multi-host machinery reused
+    # one level up)
+    health_events = [e for e in events if e.get("event") == "on_replica_health"]
+    failover_events = [e for e in events if e.get("event") == "on_failover"]
+    hedge_events = [e for e in events if e.get("event") == "on_hedge"]
+    fleet_ends = [e for e in events if e.get("event") == "on_fleet_end"]
+    fleet_bench = bench[-1] if bench and "fleet" in str(bench[-1].get("metric", "")) else None
+    fleet: Dict[str, Any] = {}
+    if health_events or failover_events or fleet_ends or fleet_bench is not None:
+        fleet["health_transitions"] = len(health_events)
+        fleet["failover_events"] = len(failover_events)
+        fleet["hedge_events"] = len(hedge_events)
+        by_replica: Dict[str, List[str]] = {}
+        for e in health_events:
+            replica = str(e.get("replica"))
+            by_replica.setdefault(replica, []).append(
+                f"{e.get('from')}->{e.get('to')}"
+                + (f"({e.get('reason')})" if e.get("reason") else "")
+            )
+        if by_replica:
+            fleet["replica_transitions"] = {
+                replica: moves for replica, moves in sorted(by_replica.items())
+            }
+        if fleet_ends:
+            record = fleet_ends[-1]
+            for key in (
+                "replicas", "requests", "answered", "errors", "reroutes",
+                "retries", "hedges", "hedge_wins", "hedge_cancelled",
+                "failovers", "reroute_rate", "error_rate", "p50_ms", "p99_ms",
+            ):
+                if _finite(record.get(key)) is not None:
+                    fleet[key] = record.get(key)
+        # per-replica serve totals from the merged shards: each replica's own
+        # on_serve_end, keyed by its shard's process_index — renamed to the
+        # replica id when the bench record carries the shard map
+        shard_names: Dict[str, str] = {}
+        if fleet_bench is not None and isinstance(
+            fleet_bench.get("replica_shards"), Mapping
+        ):
+            shard_names = {
+                str(k): str(v) for k, v in fleet_bench["replica_shards"].items()
+            }
+        per_replica: Dict[str, Any] = {}
+        for e in serve_ends:
+            pid = e.get("process_index")
+            if pid is None:
+                continue
+            per_replica[shard_names.get(str(pid), str(pid))] = {
+                key: e.get(key)
+                for key in (
+                    "requests", "answered", "cache_hit_rate", "error_rate",
+                    "shed", "degraded",
+                )
+                if key in e
+            }
+        if per_replica:
+            fleet["per_replica"] = per_replica
+        if fleet_bench is not None:
+            for key in (
+                "qps", "p50_ms", "p99_ms", "replicas", "requests",
+                "reroutes", "reroute_rate", "cache_hit_locality",
+                "failover_gap_ms", "hung_requests", "fleet_error_rate",
+                "single_replica_qps", "single_replica_hit_rate",
+            ):
+                if fleet_bench.get(key) is not None:
+                    fleet[key] = fleet_bench.get(key)
+            chaos = fleet_bench.get("chaos")
+            if isinstance(chaos, Mapping):
+                fleet["chaos"] = {
+                    key: chaos.get(key)
+                    for key in (
+                        "killed", "revived", "failover_gap_ms", "reroutes",
+                        "hung_requests", "error_rate", "failover_answers",
+                        "failover_served_by",
+                    )
+                    if key in chaos
+                }
+            drain_swap = fleet_bench.get("drain_swap")
+            if isinstance(drain_swap, Mapping):
+                fleet["drain_swap"] = {
+                    key: drain_swap.get(key)
+                    for key in (
+                        "replicas_swapped", "drained", "errors", "generations",
+                        "p99_ms",
+                    )
+                    if key in drain_swap
+                }
+            per_replica_bench = fleet_bench.get("per_replica")
+            if isinstance(per_replica_bench, Mapping):
+                for replica, stats in per_replica_bench.items():
+                    if isinstance(stats, Mapping):
+                        fleet.setdefault("per_replica", {}).setdefault(
+                            str(replica), {}
+                        ).update(stats)
+    summary["fleet"] = fleet or None
     return summary
 
 
@@ -1220,6 +1319,89 @@ def render(summary: Mapping[str, Any]) -> str:
             if serve.get("swap_generations") is not None:
                 parts.append(f"{serve['swap_generations']} generation(s) observed")
             lines.append("  serving swap: " + " · ".join(parts))
+    fleet = summary.get("fleet")
+    if fleet:
+        parts = []
+        if fleet.get("replicas") is not None:
+            parts.append(f"{fleet['replicas']} replica(s)")
+        if _finite(fleet.get("qps")) is not None:
+            parts.append(f"{fleet['qps']:.1f} qps aggregate")
+        if _finite(fleet.get("p50_ms")) is not None or _finite(fleet.get("p99_ms")) is not None:
+            parts.append(
+                f"latency p50/p99 {_fmt(_finite(fleet.get('p50_ms')), '{:.2f}')}"
+                f"/{_fmt(_finite(fleet.get('p99_ms')), '{:.2f}')} ms"
+            )
+        if _finite(fleet.get("reroute_rate")) is not None:
+            parts.append(f"reroute rate {fleet['reroute_rate']:.2%}")
+        locality = _finite(fleet.get("cache_hit_locality"))
+        if locality is not None:
+            parts.append(f"cache-hit locality {locality:.3f}x single replica")
+        lines.append("  fleet: " + (" · ".join(parts) if parts else "events only"))
+        health_parts = [
+            f"{fleet.get('health_transitions', 0)} health transition(s)",
+            f"{fleet.get('failover_events', 0)} failover event(s)",
+        ]
+        if fleet.get("hedges") is not None or fleet.get("hedge_events"):
+            hedges = fleet.get("hedges", fleet.get("hedge_events", 0))
+            health_parts.append(
+                f"hedges {hedges}"
+                + (
+                    f" ({fleet['hedge_wins']} won)"
+                    if fleet.get("hedge_wins") is not None
+                    else ""
+                )
+            )
+        if fleet.get("retries") is not None:
+            health_parts.append(f"retries {fleet['retries']}")
+        lines.append("  fleet health: " + " · ".join(health_parts))
+        transitions = fleet.get("replica_transitions")
+        if isinstance(transitions, Mapping):
+            for replica, moves in transitions.items():
+                lines.append(f"    {replica}: " + " · ".join(moves))
+        per_replica = fleet.get("per_replica")
+        if isinstance(per_replica, Mapping) and per_replica:
+            shown = " · ".join(
+                f"{replica} "
+                + "/".join(
+                    part
+                    for part in (
+                        f"{stats['qps']:.0f}qps" if _finite(stats.get("qps")) is not None else None,
+                        f"p99 {stats['p99_ms']:.1f}ms" if _finite(stats.get("p99_ms")) is not None else None,
+                        f"{stats['answered']}ans" if stats.get("answered") is not None else None,
+                        f"hits {stats['cache_hit_rate']:.0%}" if _finite(stats.get("cache_hit_rate")) is not None else None,
+                    )
+                    if part
+                )
+                for replica, stats in sorted(per_replica.items())
+                if isinstance(stats, Mapping)
+            )
+            lines.append(f"  fleet replicas: {shown}")
+        chaos = fleet.get("chaos")
+        if isinstance(chaos, Mapping):
+            parts = []
+            if chaos.get("killed") is not None:
+                parts.append(f"killed {chaos['killed']}")
+            gap = _finite(chaos.get("failover_gap_ms"))
+            if gap is not None:
+                parts.append(f"failover gap {gap:.1f} ms")
+            if chaos.get("reroutes") is not None:
+                parts.append(f"reroutes {chaos['reroutes']}")
+            if chaos.get("revived") is not None:
+                parts.append(f"revived {chaos['revived']}")
+            parts.append(f"hung {chaos.get('hung_requests', 0)}")
+            lines.append("  fleet chaos: " + " · ".join(parts))
+        drain_swap = fleet.get("drain_swap")
+        if isinstance(drain_swap, Mapping):
+            lines.append(
+                "  fleet rollout: "
+                f"{drain_swap.get('replicas_swapped', 0)} replica(s) drained+swapped · "
+                f"errors {drain_swap.get('errors', 0)}"
+                + (
+                    f" · p99 {drain_swap['p99_ms']:.2f} ms"
+                    if _finite(drain_swap.get("p99_ms")) is not None
+                    else ""
+                )
+            )
     return "\n".join(lines)
 
 
@@ -1251,7 +1433,10 @@ def compare_runs(
     remat-on strictly below remat-off on ``hbm_peak_bytes`` (the
     candidate-alone invariant, like the packing gate). Serving ``quant`` blocks
     gate ``recall_at_candidates`` / ``topk_match_rate`` higher-better with an
-    absolute 0.005 floor.
+    absolute 0.005 floor. Fleet runs (``bench_fleet.py``) gate ``fleet_qps``
+    higher-better always, and ``fleet_p99_ms`` / ``fleet_reroute_rate``
+    lower-better only when the chaos phase matches on both sides (a kill's
+    failover gap and reroutes must not fail against a no-chaos baseline).
     """
     if memory_threshold is None:
         memory_threshold = threshold
@@ -1474,6 +1659,30 @@ def compare_runs(
     # LOWER-better — a p99 that grew beyond threshold is a regression even
     # when throughput held (the micro-batcher trading latency for fill is
     # exactly the failure mode this catches)
+    # resilience-rate gates, LOWER-better with an absolute floor: rates
+    # start at 0.0 in healthy runs, so the relative rule alone (cand >
+    # base * (1+t)) would never fire on a 0 -> 0.05 regression — a
+    # half-percent absolute rise gates regardless of the baseline
+    def check_rate(name: str, cand: Optional[float], base: Optional[float]) -> None:
+        if cand is None or base is None:
+            lines.append(
+                f"  {name}: candidate={_fmt(cand, '{:.4f}')} "
+                f"baseline={_fmt(base, '{:.4f}')} (not comparable)"
+            )
+            return
+        lines.append(f"  {name}: {cand:.4f} vs {base:.4f}")
+        if cand > base + max(threshold * base, 0.005):
+            regressions.append(
+                f"{name} regressed {base:.4f} -> {cand:.4f} (lower is better)"
+            )
+
+    def surface_rate(name: str, cand: Optional[float], base: Optional[float], why: str) -> None:
+        if cand is not None or base is not None:
+            lines.append(
+                f"  {name}: candidate={_fmt(cand, '{:.4f}')} "
+                f"baseline={_fmt(base, '{:.4f}')} (not gated: {why})"
+            )
+
     cand_serve, base_serve = candidate.get("serve") or {}, baseline.get("serve") or {}
     if cand_serve or base_serve:
         check("serve_qps", _finite(cand_serve.get("qps")), _finite(base_serve.get("qps")))
@@ -1489,30 +1698,6 @@ def compare_runs(
             if base_p99 > 0 and cand_p99 > base_p99 * (1.0 + threshold):
                 regressions.append(
                     f"serve_p99_ms regressed {delta:+.1%} (> {threshold:.0%} threshold)"
-                )
-
-        # resilience-rate gates, LOWER-better with an absolute floor: rates
-        # start at 0.0 in healthy runs, so the relative rule alone (cand >
-        # base * (1+t)) would never fire on a 0 -> 0.05 regression — a
-        # half-percent absolute rise gates regardless of the baseline
-        def check_rate(name: str, cand: Optional[float], base: Optional[float]) -> None:
-            if cand is None or base is None:
-                lines.append(
-                    f"  {name}: candidate={_fmt(cand, '{:.4f}')} "
-                    f"baseline={_fmt(base, '{:.4f}')} (not comparable)"
-                )
-                return
-            lines.append(f"  {name}: {cand:.4f} vs {base:.4f}")
-            if cand > base + max(threshold * base, 0.005):
-                regressions.append(
-                    f"{name} regressed {base:.4f} -> {cand:.4f} (lower is better)"
-                )
-
-        def surface_rate(name: str, cand: Optional[float], base: Optional[float], why: str) -> None:
-            if cand is not None or base is not None:
-                lines.append(
-                    f"  {name}: candidate={_fmt(cand, '{:.4f}')} "
-                    f"baseline={_fmt(base, '{:.4f}')} (not gated: {why})"
                 )
 
         # the run-wide rates are dominated by the OPT-IN phases — deadline
@@ -1594,6 +1779,41 @@ def compare_runs(
                         f"serve_quant_{name} regressed "
                         f"{base_value:.4f} -> {cand_value:.4f} (higher is better)"
                     )
+    # fleet gates (serve.fleet / bench_fleet.py): aggregate QPS is higher-
+    # better; tail latency and the reroute rate are LOWER-better — but a
+    # chaos run's p99 includes the failover gap and its reroutes are the
+    # injected kill's whole point, so both gate only when the chaos phase
+    # matches on both sides (the PR-9 phase-matching rule). Cache-hit
+    # locality is surfaced — its gate is the candidate-alone acceptance
+    # check bench_fleet/CI applies, not a cross-run comparison.
+    cand_fleet, base_fleet = candidate.get("fleet") or {}, baseline.get("fleet") or {}
+    if cand_fleet or base_fleet:
+        check(
+            "fleet_qps", _finite(cand_fleet.get("qps")), _finite(base_fleet.get("qps"))
+        )
+        fleet_chaos_match = bool(cand_fleet.get("chaos")) == bool(base_fleet.get("chaos"))
+        cand_p99 = _finite(cand_fleet.get("p99_ms"))
+        base_p99 = _finite(base_fleet.get("p99_ms"))
+        if fleet_chaos_match:
+            check_lower_better("fleet_p99_ms", cand_p99, base_p99, threshold, unit="ms")
+        else:
+            surface_rate(
+                "fleet_p99_ms", cand_p99, base_p99,
+                "chaos phase ran on one side only",
+            )
+        cand_reroute = _finite(cand_fleet.get("reroute_rate"))
+        base_reroute = _finite(base_fleet.get("reroute_rate"))
+        if fleet_chaos_match:
+            check_rate("fleet_reroute_rate", cand_reroute, base_reroute)
+        else:
+            surface_rate(
+                "fleet_reroute_rate", cand_reroute, base_reroute,
+                "chaos phase ran on one side only",
+            )
+        cand_loc = _finite(cand_fleet.get("cache_hit_locality"))
+        base_loc = _finite(base_fleet.get("cache_hit_locality"))
+        if cand_loc is not None and base_loc is not None:
+            lines.append(f"  fleet_cache_hit_locality: {cand_loc:.3f} vs {base_loc:.3f}")
     # cross-host balance: the straggler index (max/median per-host step time)
     # gates lower-better, but ONLY between two genuinely multi-process runs —
     # a single-process run's index is 1.0 by construction and comparing it

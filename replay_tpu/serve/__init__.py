@@ -23,6 +23,14 @@ ANN-index stack (SURVEY §2.8), built from this repo's own pieces:
   generations hot-swap into the running executables without recompiling,
   behind a shadow→canary→promoted|rolled_back state machine (docs/robustness
   "Zero-downtime swaps and canary promotion").
+* :class:`ServingFleet` / :class:`HashRing` — N replicas behind a host-side
+  consistent-hash router (``fleet``/``router``): bounded-movement user →
+  replica mapping so state caches stay hot, per-replica health states
+  (healthy → degraded → draining → dead) driven by heartbeats + exporter
+  gauges, failover with the rerouted users riding the degradation ladder,
+  p99-hedged requests, retry backoff honoring ``retry_after_s``, and a
+  drain-and-swap rollout composing with the promotion path (docs/serving.md
+  "The fleet").
 
 ``bench_serve.py`` (repo root) drives it with closed/open-loop load — plus
 open-loop OVERLOAD and ``--chaos`` fault-injection modes — and emits the
@@ -38,10 +46,12 @@ from .engine import ScoringEngine
 from .errors import (
     CircuitOpen,
     DeadlineExceeded,
+    NoHealthyReplica,
     RequestShed,
     ServeError,
     ServiceClosed,
 )
+from .fleet import ReplicaHandle, ServingFleet
 from .pipeline import CandidatePipeline
 from .promote import (
     PROMOTION_STAGES,
@@ -52,20 +62,27 @@ from .promote import (
 )
 from .quant import QuantizedTable, quantization_error, quantize_embeddings
 from .request import ScoreRequest, ScoreResponse, make_window
+from .router import REPLICA_HEALTH, BackoffPolicy, HashRing, ReplicaHealth
 from .service import ScoringService
 
 __all__ = [
     "DEGRADATION_LADDER",
     "PROMOTION_STAGES",
+    "REPLICA_HEALTH",
+    "BackoffPolicy",
     "CandidatePipeline",
     "CircuitBreaker",
     "CircuitOpen",
     "DeadlineExceeded",
     "FallbackScorer",
+    "HashRing",
     "MicroBatcher",
+    "NoHealthyReplica",
     "ParamGeneration",
     "ParamStore",
     "PromotionController",
+    "ReplicaHandle",
+    "ReplicaHealth",
     "RequestShed",
     "ScoreRequest",
     "ScoreResponse",
@@ -73,6 +90,7 @@ __all__ = [
     "ScoringService",
     "ServeError",
     "ServiceClosed",
+    "ServingFleet",
     "UserState",
     "QuantizedTable",
     "UserStateCache",

@@ -126,6 +126,20 @@ class MicroBatcher:
             self.submitted += 1
             self._wake.notify()
 
+    @property
+    def live(self) -> bool:
+        """Whether the batcher currently accepts work (the fleet heartbeat's
+        liveness bit): running and not crashed past the restart budget."""
+        with self._lock:
+            return self._running
+
+    @property
+    def idle(self) -> bool:
+        """True when nothing is queued on any lane AND no batch is in flight
+        — the drain protocol's 'safe to swap weights' condition."""
+        with self._lock:
+            return self._inflight is None and not any(self._lanes.values())
+
     def queued_depth(self, lane: Optional[Hashable] = None) -> int:
         """Items currently queued on ``lane`` (or across all lanes)."""
         with self._lock:

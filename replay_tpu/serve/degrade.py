@@ -20,6 +20,15 @@ The reference serves a dedicated popularity model (``PopRec``) for cold
 traffic; here the same ranking doubles as the outage floor — built from
 interaction counts (or any score-per-item array) once, then served as
 O(k) host gathers per request.
+
+The fleet (``serve/fleet.py``) leans on this rung one more way: with
+``ScoringService(cold_miss="fallback")``, a state-less READ for an
+UNKNOWN user (no history, no new_items) rides the floor instead of
+erroring — the failover setting, where a dead replica's users arrive
+downstream with cold caches by construction and a generic answer beats a
+``KeyError`` (``served_by == "fallback"`` keeps the path visible).
+Interaction-bearing ``new_items`` requests still error: an event that
+cannot land is never masked by a success response.
 """
 
 from __future__ import annotations

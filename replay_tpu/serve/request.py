@@ -81,6 +81,12 @@ class ScoreResponse:
     # that routed it ("stable", or "candidate" during a canary).
     generation: int = 0
     role: str = "stable"
+    # which fleet replica answered (serve.fleet): stamped by the router when
+    # the request rode a ServingFleet — together with ``served_by`` this is
+    # the failover proof trail (a rerouted user's degraded answer names both
+    # the rung AND the replica that took it). None for direct single-service
+    # scoring.
+    replica: Optional[str] = None
 
 
 @dataclass

@@ -55,7 +55,7 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
 
@@ -70,6 +70,7 @@ from .cache import UserState, UserStateCache
 from .degrade import FallbackScorer
 from .engine import ScoringEngine
 from .errors import CircuitOpen, DeadlineExceeded, RequestShed
+from .futures import mark_running, safe_fail, safe_set_result
 from .pipeline import CandidatePipeline
 from .promote import ROLES, ParamStore, in_canary_slice
 from .request import PendingRequest, ScoreRequest, ScoreResponse, make_window
@@ -100,6 +101,16 @@ class ScoringService:
     :param slo_rules: :class:`~replay_tpu.obs.SLORule` set evaluated after
         every dispatched batch; breaches emit ``on_slo_violation`` through
         the attached ``logger`` and count in the registry.
+    :param cold_miss: what a state-less request (unknown user, no
+        ``history``) gets. ``"error"`` (default) keeps the original contract
+        — the future fails with ``KeyError`` naming the cold path. With
+        ``"fallback"`` (and a ``fallback`` scorer attached) it rides the
+        degradation ladder's floor instead: the fleet-failover setting, where
+        a rerouted user's cache is cold on the new replica by construction
+        and a generic answer beats an error (``served_by == "fallback"``
+        keeps the degradation visible). ``new_items`` requests error in BOTH
+        modes — an interaction that cannot land must never be masked by a
+        success response.
     """
 
     def __init__(
@@ -124,10 +135,15 @@ class ScoringService:
         metrics_port: Optional[int] = None,
         slo_rules: Optional[Sequence[Any]] = None,
         param_store: Optional[ParamStore] = None,
+        cold_miss: str = "error",
     ) -> None:
         if retrieval is not None and candidates is not None:
             msg = "retrieval mode and a fixed candidate slate are mutually exclusive"
             raise ValueError(msg)
+        if cold_miss not in ("error", "fallback"):
+            msg = f"cold_miss must be 'error' or 'fallback', got {cold_miss!r}"
+            raise ValueError(msg)
+        self.cold_miss = cold_miss
         self.mode = (
             "retrieval" if retrieval is not None
             else "slate" if candidates is not None
@@ -608,19 +624,11 @@ class ScoringService:
                 request.user_id, request.new_items, self.pad_id
             )
             if advanced is None:
-                msg = (
-                    f"user {request.user_id!r} has no cached state; "
-                    "provide history= for the cold path"
-                )
-                raise KeyError(msg)
+                return self._cold_miss(request, future, role)
             return self._encode_or_degrade(request, future, advanced, "advance", previous, role)
         state = self.cache.lookup(request.user_id)
         if state is None:
-            msg = (
-                f"user {request.user_id!r} has no cached state; "
-                "provide history= for the cold path"
-            )
-            raise KeyError(msg)
+            return self._cold_miss(request, future, role)
         if state.embedding is not None:
             # hot-swap staleness guard (serve.promote): an embedding encoded
             # by an older parameter generation must never be scored through
@@ -647,6 +655,34 @@ class ScoringService:
         # away, or certifies an older param generation): re-encode the cached
         # window — still no history re-send
         return self._encode_or_degrade(request, future, state, "advance", state, role)
+
+    def _cold_miss(
+        self, request: ScoreRequest, future: "Future[ScoreResponse]", role: str
+    ) -> Optional[Tuple[Hashable, PendingRequest]]:
+        """A state-less request with no history: error (the original
+        contract) or the ladder floor (``cold_miss="fallback"`` — the fleet
+        failover setting, where the user's cache lives on a replica that just
+        died and a popularity answer beats an exception). ``new_items``
+        requests ALWAYS error here, even under ``cold_miss="fallback"``:
+        without a cached window the interaction cannot land, and a success
+        response over a silently dropped event is worse than an explicit
+        "re-anchor with history=" refusal (degradation never loses an event
+        — docs/robustness.md "Fleet failover semantics")."""
+        if request.new_items:
+            msg = (
+                f"user {request.user_id!r} has no cached state to advance; "
+                "re-anchor with history= (the new_items interaction cannot "
+                "land without a window)"
+            )
+            raise KeyError(msg)
+        if self.cold_miss == "fallback" and self.fallback is not None:
+            self._finish_fallback(request, future, reason="cold_miss", role=role)
+            return None
+        msg = (
+            f"user {request.user_id!r} has no cached state; "
+            "provide history= for the cold path"
+        )
+        raise KeyError(msg)
 
     def _encode_or_degrade(
         self,
@@ -1199,36 +1235,10 @@ class ScoringService:
             role=role,
         )
 
-    # -- future resolution helpers ------------------------------------------ #
-    @staticmethod
-    def _mark_running(future: Future) -> bool:
-        try:
-            return future.set_running_or_notify_cancel()
-        except RuntimeError:
-            # a finished future raises bare RuntimeError here (NOT
-            # InvalidStateError): another thread resolved it between the
-            # done() check and this commit — treat it as abandoned
-            return False
-
-    @staticmethod
-    def _safe_fail(future: Future, exc: BaseException) -> bool:
-        try:
-            if not future.done():
-                future.set_exception(exc)
-                return True
-        except InvalidStateError:
-            pass
-        return False
-
-    @staticmethod
-    def _safe_set_result(future: Future, result: ScoreResponse) -> bool:
-        try:
-            if not future.done():
-                future.set_result(result)
-                return True
-        except InvalidStateError:
-            pass
-        return False
+    # -- future resolution helpers (shared with the fleet: serve.futures) --- #
+    _mark_running = staticmethod(mark_running)
+    _safe_fail = staticmethod(safe_fail)
+    _safe_set_result = staticmethod(safe_set_result)
 
     # -- accounting --------------------------------------------------------- #
     def _route_event(self, event: TrainerEvent) -> None:
@@ -1288,6 +1298,25 @@ class ScoringService:
                 self._chained_transition(old, new, info)
             except Exception:  # noqa: BLE001 — an alerting hook raising must
                 pass  # not poison the dispatch path that recorded the outcome
+
+    def heartbeat(self) -> Dict[str, Any]:
+        """Cheap host-side liveness + load snapshot — the fleet monitor's
+        raw material (``serve.fleet``). No device work, no engine call: the
+        liveness bit is the batcher's worker state, the load signals are the
+        same gauges the exporter already serves (lane depth, breaker state,
+        cumulative request/error counters the monitor windows itself)."""
+        with self._count_lock:
+            requests = self._requests
+            errors = self._errors
+        return {
+            "live": self._started and self.batcher.live,
+            "queued": self.batcher.queued_depth(),
+            "max_depth": self.batcher.max_depth,
+            "breaker_state": self.breaker.state,
+            "requests": requests,
+            "errors": errors,
+            "error_rate": errors / requests if requests else 0.0,
+        }
 
     def stats(self) -> Dict[str, Any]:
         engine = self.engine.stats()

@@ -1,0 +1,664 @@
+"""Host-only fleet routing core: the hash ring, health machine, backoff,
+hedging, failover and drain — no jax, no device, fake replicas.
+
+The fleet (``serve/fleet.py``) is duck-typed over its replicas exactly so
+this tier exists: every routing decision, retry, hedge race and drain
+handshake is exercised against an in-process fake with controllable latency,
+shedding and liveness — the micro-batcher/breaker testing strategy applied
+one level up.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from replay_tpu.obs import TrainerEvent
+from replay_tpu.serve import (
+    BackoffPolicy,
+    HashRing,
+    NoHealthyReplica,
+    ReplicaHealth,
+    RequestShed,
+    ServingFleet,
+)
+from replay_tpu.serve.request import ScoreResponse
+
+pytestmark = pytest.mark.core
+
+
+class EventLog:
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def log_event(self, event: TrainerEvent) -> None:
+        with self._lock:
+            self.events.append((event.event, dict(event.payload)))
+
+    def named(self, name):
+        with self._lock:
+            return [payload for event, payload in self.events if event == name]
+
+
+class FakeBatcher:
+    def __init__(self):
+        self.live = True
+        self.pending = 0
+
+    @property
+    def idle(self):
+        return self.pending == 0
+
+    def queued_depth(self, lane=None):
+        return self.pending
+
+
+class FakeService:
+    """A controllable ScoringService stand-in: resolves (optionally delayed),
+    sheds the first N submits, and flips liveness for heartbeat tests."""
+
+    def __init__(self, name, delay_s=0.0, shed_first=0, retry_after_s=0.02):
+        self.name = name
+        self.delay_s = delay_s
+        self.shed_remaining = shed_first
+        self.retry_after_s = retry_after_s
+        self.alive = True
+        self.submits = 0
+        self.submitted_kwargs = []
+        self.futures = []
+        self.batcher = FakeBatcher()
+        self.published = []
+        self.promoted = []
+        self.closed = False
+
+    def start(self):
+        return self
+
+    def close(self):
+        self.closed = True
+        self.alive = False
+
+    def heartbeat(self):
+        if not self.alive:
+            raise RuntimeError(f"{self.name} is down")
+        return {
+            "live": True,
+            "queued": self.batcher.pending,
+            "max_depth": 16,
+            "breaker_state": "closed",
+            "requests": self.submits,
+            "errors": 0,
+        }
+
+    def stats(self):
+        return {"submits": self.submits}
+
+    def publish_candidate(self, params, label="", pipeline=None):
+        self.published.append(label)
+        return len(self.published)
+
+    def promote(self, generation):
+        self.promoted.append(generation)
+        return {"to_generation": generation}
+
+    def close_fails_pending(self):
+        """The real service's close() contract: pending futures resolve."""
+        from replay_tpu.serve import ServiceClosed
+
+        self.close()
+        for future in self.futures:
+            if not future.done():
+                future.set_exception(ServiceClosed())
+
+    def submit(self, user_id, **kwargs):
+        self.submits += 1
+        self.submitted_kwargs.append(kwargs)
+        future = Future()
+        self.futures.append(future)
+        if self.shed_remaining > 0:
+            self.shed_remaining -= 1
+            future.set_exception(
+                RequestShed(("encode", 1), 16, 16, retry_after_s=self.retry_after_s)
+            )
+            return future
+
+        def resolve():
+            if future.set_running_or_notify_cancel():
+                future.set_result(
+                    ScoreResponse(
+                        user_id=user_id,
+                        scores=np.zeros(3),
+                        item_ids=None,
+                        served_from="hit",
+                        lane="hit",
+                        queue_wait_s=0.0,
+                    )
+                )
+
+        if self.delay_s:
+            timer = threading.Timer(self.delay_s, resolve)
+            timer.daemon = True
+            timer.start()
+        else:
+            resolve()
+        return future
+
+
+def _fleet(services, **kwargs):
+    kwargs.setdefault("heartbeat_interval_s", None)  # poll() driven
+    kwargs.setdefault("hedge_ms", 0)  # hedging off unless the test wants it
+    return ServingFleet(services, **kwargs)
+
+
+# --------------------------------------------------------------------------- #
+# the hash ring
+# --------------------------------------------------------------------------- #
+class TestHashRing:
+    def test_routing_is_deterministic_and_membership_pure(self):
+        ring_a = HashRing(("a", "b", "c"))
+        ring_b = HashRing(("c", "a", "b"))  # insertion order must not matter
+        for user in range(200):
+            assert ring_a.route(user) == ring_b.route(user)
+        assert ring_a.preference(7) == ring_b.preference(7)
+        assert len(set(ring_a.preference(7))) == 3
+
+    def test_spread_is_roughly_balanced(self):
+        ring = HashRing(("a", "b", "c", "d"))
+        spread = ring.spread(8000)
+        assert set(spread) == {"a", "b", "c", "d"}
+        for fraction in spread.values():
+            # 64 vnodes keeps the imbalance moderate; the bound is loose on
+            # purpose — balance is statistical, stability is exact
+            assert 0.1 < fraction < 0.45, spread
+
+    def test_bounded_movement_on_add(self):
+        """Adding a 4th replica must remap roughly 1/4 of users — and NEVER
+        remap a user between two old replicas (movement only TOWARD the new
+        one): the property that keeps every other replica's cache hot."""
+        ring = HashRing(("a", "b", "c"))
+        before = {user: ring.route(user) for user in range(8000)}
+        ring.add("d")
+        moved = 0
+        for user, home in before.items():
+            after = ring.route(user)
+            if after != home:
+                moved += 1
+                assert after == "d", "a user moved between two OLD replicas"
+        assert 0.10 < moved / len(before) < 0.40, moved / len(before)
+
+    def test_bounded_movement_on_remove(self):
+        """Removing a replica remaps ONLY its own users."""
+        ring = HashRing(("a", "b", "c", "d"))
+        before = {user: ring.route(user) for user in range(8000)}
+        ring.remove("d")
+        for user, home in before.items():
+            if home != "d":
+                assert ring.route(user) == home, "a survivor's user moved"
+            else:
+                assert ring.route(user) != "d"
+
+    def test_add_remove_round_trip_restores_routing(self):
+        ring = HashRing(("a", "b", "c"))
+        before = {user: ring.route(user) for user in range(2000)}
+        ring.add("d")
+        ring.remove("d")
+        assert {user: ring.route(user) for user in range(2000)} == before
+
+    def test_empty_ring_raises(self):
+        with pytest.raises(LookupError, match="empty"):
+            HashRing(()).route(1)
+
+
+# --------------------------------------------------------------------------- #
+# health machine + backoff
+# --------------------------------------------------------------------------- #
+class TestReplicaHealth:
+    def test_legal_lifecycle(self):
+        health = ReplicaHealth("r0")
+        assert health.takes_traffic and health.takes_failover
+        assert health.transition("degraded", "lane_depth")
+        assert health.takes_traffic and not health.takes_failover
+        assert health.transition("draining", "drain")
+        assert not health.takes_traffic
+        assert health.transition("healthy", "rejoin")
+        assert health.transition("dead", "heartbeat")
+        assert health.transition("healthy", "revived")
+        assert len(health.transitions) == 5
+
+    def test_illegal_transitions_raise(self):
+        health = ReplicaHealth("r0")
+        health.transition("dead", "heartbeat")
+        with pytest.raises(ValueError, match="illegal"):
+            health.transition("degraded", "nope")  # dead -> degraded
+        with pytest.raises(ValueError, match="unknown"):
+            health.transition("zombie")
+
+    def test_same_state_is_a_noop(self):
+        health = ReplicaHealth("r0")
+        assert not health.transition("healthy", "again")
+        assert health.transitions == []
+
+
+class TestBackoffPolicy:
+    def test_exponential_growth_and_cap(self):
+        policy = BackoffPolicy(base_s=0.01, multiplier=2.0, cap_s=0.05, max_retries=3)
+        assert policy.delay(0) == pytest.approx(0.01)
+        assert policy.delay(1) == pytest.approx(0.02)
+        assert policy.delay(10) == pytest.approx(0.05)  # capped
+        assert not policy.exhausted(2)
+        assert policy.exhausted(3)
+
+    def test_retry_after_hint_is_honored(self):
+        """The shed lane's own drain estimate is a FLOOR on the delay: the
+        backoff may wait longer, never shorter."""
+        policy = BackoffPolicy(base_s=0.001, multiplier=2.0, cap_s=1.0)
+        assert policy.delay(0, retry_after_s=0.25) >= 0.25
+        # a hint beyond the cap still wins (the lane knows its backlog)
+        assert policy.delay(0, retry_after_s=5.0) >= 5.0
+        # backoff already past the hint: backoff stands (capped)
+        assert policy.delay(12, retry_after_s=0.01) == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------------- #
+# the fleet: routing, failover, hedging, retries, drain
+# --------------------------------------------------------------------------- #
+class TestFleetRouting:
+    def test_routes_to_home_and_stamps_replica(self):
+        services = {name: FakeService(name) for name in ("a", "b", "c")}
+        with _fleet(services) as fleet:
+            for user in range(20):
+                response = fleet.score(user, timeout=5)
+                assert response.replica == fleet.ring.route(user)
+            assert fleet.stats()["reroutes"] == 0
+            assert fleet.stats()["answered"] == 20
+
+    def test_no_healthy_replica_fails_fast(self):
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services, heartbeat_misses=1) as fleet:
+            for service in services.values():
+                service.alive = False
+            fleet.poll()
+            future = fleet.submit(1)
+            with pytest.raises(NoHealthyReplica):
+                future.result(timeout=5)
+            assert fleet.stats()["no_healthy_refusals"] == 1
+
+
+class TestFailover:
+    def test_dead_replica_rehomes_its_users(self):
+        """Heartbeat death: the victim's users are served by their ring
+        successor; other replicas' users stay put (cache locality)."""
+        services = {name: FakeService(name) for name in ("a", "b", "c")}
+        log = EventLog()
+        with _fleet(services, heartbeat_misses=2, logger=log) as fleet:
+            victim = fleet.ring.route("victim-user")
+            others = {
+                user: fleet.ring.route(user)
+                for user in range(50)
+                if fleet.ring.route(user) != victim
+            }
+            services[victim].alive = False
+            fleet.poll()
+            assert fleet.health()[victim] != "dead"  # 1 miss < threshold
+            fleet.poll()
+            assert fleet.health()[victim] == "dead"
+            # the victim's user is served by its preference successor
+            response = fleet.score("victim-user", timeout=5)
+            expected = [
+                rid for rid in fleet.ring.preference("victim-user") if rid != victim
+            ][0]
+            assert response.replica == expected
+            # everyone else stays home
+            for user, home in list(others.items())[:10]:
+                assert fleet.score(user, timeout=5).replica == home
+            stats = fleet.stats()
+            assert stats["failovers"] == 1
+            assert stats["reroutes"] >= 1
+            # one on_failover + the health transition event
+            assert len(log.named("on_failover")) == 1
+            transitions = log.named("on_replica_health")
+            assert any(
+                e["replica"] == victim and e["to"] == "dead" for e in transitions
+            )
+
+    def test_revived_replica_takes_its_users_back(self):
+        services = {name: FakeService(name) for name in ("a", "b", "c")}
+        with _fleet(services, heartbeat_misses=1) as fleet:
+            victim = fleet.ring.route("victim-user")
+            services[victim].alive = False
+            fleet.poll()
+            assert fleet.score("victim-user", timeout=5).replica != victim
+            services[victim].alive = True
+            fleet.poll()
+            assert fleet.health()[victim] == "healthy"
+            assert fleet.score("victim-user", timeout=5).replica == victim
+
+    def test_degraded_breaker_signal_from_heartbeat(self):
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services) as fleet:
+            original = services["a"].heartbeat
+
+            def degraded_heartbeat():
+                record = original()
+                record["breaker_state"] = "open"
+                return record
+
+            services["a"].heartbeat = degraded_heartbeat
+            fleet.poll()
+            assert fleet.health()["a"] == "degraded"
+            # degraded still takes HOME traffic (warm cache beats rerouting)
+            user = next(u for u in range(100) if fleet.ring.route(u) == "a")
+            assert fleet.score(user, timeout=5).replica == "a"
+            services["a"].heartbeat = original
+            fleet.poll()
+            assert fleet.health()["a"] == "healthy"
+
+
+class TestHedging:
+    def test_hedge_cancels_the_loser_exactly_once(self):
+        """A slow primary past the hedge delay races a second replica; the
+        fast hedge wins and the slow loser is cancelled exactly once."""
+        services = {
+            "slow": FakeService("slow", delay_s=0.5),
+            "b": FakeService("b"),
+            "c": FakeService("c"),
+        }
+        with _fleet(services, hedge_ms=25) as fleet:
+            user = next(u for u in range(200) if fleet.ring.route(u) == "slow")
+            started = time.perf_counter()
+            response = fleet.score(user, timeout=5)
+            elapsed = time.perf_counter() - started
+            assert response.replica != "slow"
+            assert elapsed < 0.4  # beat the slow primary's 0.5 s
+            stats = fleet.stats()
+            assert stats["hedges"] == 1
+            assert stats["hedge_wins"] == 1
+            assert stats["hedge_cancelled"] == 1  # exactly once
+
+    def test_fast_primary_never_hedges(self):
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services, hedge_ms=50) as fleet:
+            for user in range(10):
+                fleet.score(user, timeout=5)
+            assert fleet.stats()["hedges"] == 0
+
+    def test_non_idempotent_requests_never_hedge(self):
+        services = {
+            "slow": FakeService("slow", delay_s=0.2),
+            "b": FakeService("b"),
+        }
+        with _fleet(services, hedge_ms=10) as fleet:
+            user = next(u for u in range(200) if fleet.ring.route(u) == "slow")
+            response = fleet.score(user, new_items=[5], timeout=5)
+            assert response.replica == "slow"  # waited for the mutation's home
+            assert fleet.stats()["hedges"] == 0
+
+
+class TestRetryBackoff:
+    def test_retry_honors_retry_after_s(self):
+        """A shed with a retry-after hint is retried no EARLIER than the
+        hint — on the same (only) replica, which then accepts."""
+        shedder = FakeService("s", shed_first=1, retry_after_s=0.08)
+        with _fleet(
+            {"s": shedder}, backoff=BackoffPolicy(base_s=0.001, max_retries=2)
+        ) as fleet:
+            started = time.perf_counter()
+            response = fleet.score(1, timeout=5)
+            elapsed = time.perf_counter() - started
+            assert response.replica == "s"
+            assert elapsed >= 0.08, f"retried before retry_after_s ({elapsed:.3f}s)"
+            assert shedder.submits == 2
+            assert fleet.stats()["retries"] == 1
+
+    def test_retries_are_capped(self):
+        shedder = FakeService("s", shed_first=100, retry_after_s=0.005)
+        with _fleet(
+            {"s": shedder}, backoff=BackoffPolicy(base_s=0.001, max_retries=2)
+        ) as fleet:
+            future = fleet.submit(1)
+            with pytest.raises(RequestShed):
+                future.result(timeout=5)
+            assert shedder.submits == 3  # initial + 2 retries
+            assert fleet.stats()["retries"] == 2
+
+    def test_non_idempotent_requests_are_never_retried(self):
+        """new_items traffic mutates the home cache at submit: re-sending it
+        would double-land the interaction, so the shed propagates."""
+        shedder = FakeService("s", shed_first=1, retry_after_s=0.01)
+        with _fleet(
+            {"s": shedder}, backoff=BackoffPolicy(base_s=0.001, max_retries=2)
+        ) as fleet:
+            future = fleet.submit(1, new_items=[3])
+            with pytest.raises(RequestShed):
+                future.result(timeout=5)
+            assert shedder.submits == 1
+            assert fleet.stats()["retries"] == 0
+
+    def test_shed_retry_fails_over_to_another_replica(self):
+        services = {
+            "a": FakeService("a", shed_first=5, retry_after_s=0.005),
+            "b": FakeService("b"),
+            "c": FakeService("c"),
+        }
+        with _fleet(
+            services, backoff=BackoffPolicy(base_s=0.001, max_retries=2)
+        ) as fleet:
+            user = next(u for u in range(200) if fleet.ring.route(u) == "a")
+            response = fleet.score(user, timeout=5)
+            assert response.replica != "a"
+            assert fleet.stats()["reroutes"] >= 1
+
+
+class TestDrainProtocol:
+    def test_drain_waits_for_idle_with_zero_orphans(self):
+        """Drain blocks until queued+in-flight work empties; traffic routed
+        during the drain goes elsewhere; rejoin restores the replica."""
+        services = {name: FakeService(name) for name in ("a", "b", "c")}
+        log = EventLog()
+        with _fleet(services, logger=log) as fleet:
+            services["a"].batcher.pending = 3  # simulated in-flight backlog
+
+            def finish_backlog():
+                time.sleep(0.05)
+                services["a"].batcher.pending = 0
+
+            worker = threading.Thread(target=finish_backlog, daemon=True)
+            worker.start()
+            started = time.perf_counter()
+            assert fleet.drain("a", timeout_s=5.0)
+            assert time.perf_counter() - started >= 0.04
+            assert fleet.health()["a"] == "draining"
+            # new traffic for a's users goes elsewhere while draining
+            user = next(u for u in range(200) if fleet.ring.route(u) == "a")
+            assert fleet.score(user, timeout=5).replica != "a"
+            fleet.rejoin("a")
+            assert fleet.health()["a"] == "healthy"
+            assert fleet.score(user, timeout=5).replica == "a"
+            transitions = [
+                (e["from"], e["to"]) for e in log.named("on_replica_health")
+            ]
+            assert ("healthy", "draining") in transitions
+            assert ("draining", "healthy") in transitions
+
+    def test_stale_health_sweeps_never_override_a_drain(self):
+        """The poll-vs-drain race guard: a gauge-driven transition decided on
+        a STALE state observation (the operator drained the replica between
+        the sweep's read and its write) is dropped — never applied to the
+        wrong state, never an illegal-transition crash of the monitor."""
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services) as fleet:
+            handle = fleet.handles["a"]
+            services["a"].batcher.pending = 0
+            assert fleet.drain("a", timeout_s=1.0)
+            # a sweep that observed "healthy" before the drain landed:
+            # its degrade verdict must be dropped, not raised on
+            fleet._transition(handle, "degraded", "lane_depth", expected="healthy")
+            assert fleet.health()["a"] == "draining"
+            # and a full poll() against a draining replica (whatever its
+            # gauges say) leaves the drain in place
+            original = services["a"].heartbeat
+            services["a"].heartbeat = lambda: {**original(), "breaker_state": "open"}
+            fleet.poll()
+            assert fleet.health()["a"] == "draining"
+
+    def test_drain_times_out_on_a_wedged_replica(self):
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services) as fleet:
+            services["a"].batcher.pending = 1  # never drains
+            assert not fleet.drain("a", timeout_s=0.05)
+            assert fleet.health()["a"] == "draining"
+
+    def test_drain_and_swap_runs_the_promotion_path(self):
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services) as fleet:
+            result = fleet.drain_and_swap("a", params={"w": 1}, label="roll")
+            assert result["drained"] and result["replica"] == "a"
+            assert services["a"].published == ["roll"]
+            assert services["a"].promoted == [1]
+            assert fleet.health()["a"] == "healthy"
+
+    def test_rolling_swap_covers_every_replica(self):
+        services = {name: FakeService(name) for name in ("a", "b", "c")}
+        with _fleet(services) as fleet:
+            results = fleet.rolling_swap(params={"w": 1}, label="fleet-roll")
+            assert {r["replica"] for r in results} == {"a", "b", "c"}
+            for service in services.values():
+                assert service.published == ["fleet-roll"]
+
+
+class TestReviewHardening:
+    def test_close_resolves_inflight_clients_not_hangs_them(self):
+        """The shutdown-hang regression: a client in flight when close()
+        runs must RESOLVE (the replica's ServiceClosed propagates), never
+        wait on a retry timer whose scheduler is already gone."""
+        from replay_tpu.serve import ServiceClosed
+
+        slow = FakeService("a", delay_s=30.0)  # never resolves on its own
+        fleet = _fleet({"a": slow})
+        fleet.start()
+        client = fleet.submit(1)
+        assert not client.done()
+        fleet.close()
+        slow.close_fails_pending()  # what the real service.close() does
+        deadline = time.perf_counter() + 2.0
+        while not client.done() and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert client.done(), "close() left an in-flight client hanging"
+        with pytest.raises(ServiceClosed):
+            client.result(timeout=0)
+
+    def test_revival_does_not_judge_the_death_burst(self):
+        """The error-rate window re-anchors on revival: errors accumulated
+        while dying must not re-degrade the freshly-healthy replica."""
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services, heartbeat_misses=1) as fleet:
+            counters = {"requests": 100.0, "errors": 0.0, "live": True}
+
+            def heartbeat():
+                if not counters["live"]:
+                    raise RuntimeError("down")
+                return {
+                    "live": True, "queued": 0, "max_depth": 16,
+                    "breaker_state": "closed",
+                    "requests": counters["requests"], "errors": counters["errors"],
+                }
+
+            services["a"].heartbeat = heartbeat
+            fleet.poll()  # anchor the window at 100 clean requests
+            counters["live"] = False
+            fleet.poll()
+            assert fleet.health()["a"] == "dead"
+            # the dying burst: 20 more requests, 18 of them errors
+            counters.update(requests=120.0, errors=18.0, live=True)
+            fleet.poll()
+            assert fleet.health()["a"] == "healthy"
+            fleet.poll()  # next sweep judges only the POST-revival window
+            assert fleet.health()["a"] == "healthy"
+
+    def test_rolling_swap_skips_dead_replicas(self):
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services, heartbeat_misses=1) as fleet:
+            services["a"].alive = False
+            fleet.poll()
+            results = fleet.rolling_swap(params={"w": 1}, label="roll")
+            by_replica = {r["replica"]: r for r in results}
+            assert by_replica["a"].get("skipped") == "dead"
+            assert by_replica["b"]["generation"] == 1
+            assert services["a"].published == []
+
+    def test_failed_swap_rejoins_the_replica(self):
+        """A publish that raises must not strand the replica in draining:
+        traffic resumes on the OLD generation and the error surfaces."""
+        services = {name: FakeService(name) for name in ("a", "b")}
+        with _fleet(services) as fleet:
+            def bad_publish(params, label="", pipeline=None):
+                raise RuntimeError("candidate rejected")
+
+            services["a"].publish_candidate = bad_publish
+            with pytest.raises(RuntimeError, match="candidate rejected"):
+                fleet.drain_and_swap("a", params={"w": 1})
+            assert fleet.health()["a"] == "healthy"
+            assert services["a"].promoted == []
+
+    def test_score_timeout_cancels_the_inner_request(self):
+        """A fleet-level client give-up propagates to the replica: the inner
+        future is cancelled so the batch builder can skip it."""
+        from concurrent.futures import TimeoutError as FutureTimeoutError
+
+        slow = FakeService("a", delay_s=5.0)
+        with _fleet({"a": slow}) as fleet:
+            with pytest.raises(FutureTimeoutError):
+                fleet.score(1, timeout=0.05)
+            deadline = time.perf_counter() + 1.0
+            while time.perf_counter() < deadline:
+                if slow.futures and slow.futures[-1].cancelled():
+                    break
+                time.sleep(0.01)
+            assert slow.futures[-1].cancelled(), "inner request not cancelled"
+
+    def test_concurrent_refusals_schedule_one_retry(self):
+        """The attempt-race guard: at most one retry timer per flight, and
+        the retry budget is enforced under the flight lock."""
+        shedder = FakeService("s", shed_first=10, retry_after_s=0.005)
+        with _fleet(
+            {"s": shedder}, backoff=BackoffPolicy(base_s=0.001, max_retries=3)
+        ) as fleet:
+            future = fleet.submit(1)
+            with pytest.raises(RequestShed):
+                future.result(timeout=5)
+            # initial + exactly max_retries submissions, no double-scheduling
+            assert shedder.submits == 4
+            assert fleet.stats()["retries"] == 3
+
+
+class TestFleetLifecycle:
+    def test_close_closes_every_replica_and_emits_end(self):
+        services = {name: FakeService(name) for name in ("a", "b")}
+        log = EventLog()
+        fleet = _fleet(services, logger=log)
+        fleet.start()
+        fleet.score(1, timeout=5)
+        fleet.close()
+        assert all(service.closed for service in services.values())
+        ends = log.named("on_fleet_end")
+        assert len(ends) == 1 and ends[0]["answered"] == 1
+        assert log.named("on_fleet_start")
+
+    def test_monitor_thread_detects_death_in_real_time(self):
+        """The one timing-based check: a real monitor thread (tiny interval)
+        declares a dead replica without any poll() call."""
+        services = {name: FakeService(name) for name in ("a", "b")}
+        fleet = ServingFleet(
+            services, heartbeat_interval_s=0.01, heartbeat_misses=2, hedge_ms=0
+        )
+        with fleet:
+            services["a"].alive = False
+            deadline = time.perf_counter() + 2.0
+            while time.perf_counter() < deadline:
+                if fleet.health()["a"] == "dead":
+                    break
+                time.sleep(0.01)
+            assert fleet.health()["a"] == "dead"
