@@ -50,8 +50,15 @@ runs tiny configs, flagged ``shape_override``), mirroring the
 ``events.p<i>.jsonl`` shard and the fleet logs to ``events.jsonl`` in
 ``runs/bench_fleet/`` — ``python -m replay_tpu.obs.report runs/bench_fleet``
 merges them into the "fleet" section (per-replica totals + health
-transitions), and ``--compare`` gates ``fleet_qps`` / ``fleet_p99_ms`` /
-``fleet_reroute_rate``.
+transitions + hedge/retry counters), and ``--compare`` gates ``fleet_qps``
+/ ``fleet_p99_ms`` / ``fleet_reroute_rate`` plus 10-point shifts in the p99
+hop mix. The run is fully TRACED: the router and every replica each run a
+live :class:`~replay_tpu.obs.Tracer`, merged after close into ONE
+``runs/bench_fleet/trace.json`` (labeled Perfetto tracks; a hedged or
+failed-over request's spans share a trace_id across tracks), from which the
+report derives the "tail attribution" section; the JSON record carries the
+slowest-request exemplar trace ids, and the chaos row links the failover
+probe's answer to its timeline via ``failover_trace_id``.
 
 Backend policy mirrors bench.py: probe the default backend in a throwaway
 subprocess; unhealthy → re-exec on clean CPU (metric renamed
@@ -401,6 +408,7 @@ def _run_chaos(fleet, traffic, victim: str, seconds: float):
     failover_gap_ms = None
     failover_served_by = None
     failover_replica = None
+    failover_trace_id = None
     probe_deadline = time.perf_counter() + max(10.0, seconds)
     probe_rng = np.random.default_rng(47)
     while time.perf_counter() < probe_deadline:
@@ -414,6 +422,7 @@ def _run_chaos(fleet, traffic, victim: str, seconds: float):
         failover_gap_ms = (time.perf_counter() - kill_at) * 1000.0
         failover_served_by = response.served_by
         failover_replica = response.replica
+        failover_trace_id = response.trace_id
         break
 
     time.sleep(max(seconds * 2.0 / 3.0 - (time.perf_counter() - kill_at), 0.0))
@@ -441,6 +450,14 @@ def _run_chaos(fleet, traffic, victim: str, seconds: float):
         ),
         "failover_served_by": failover_served_by,
         "failover_replica": failover_replica,
+        # the probe answer's trace id plus the slowest-request exemplars as
+        # of the chaos phase's end: during the chaos window the exemplar
+        # store is dominated by failover-gap requests, so these ids link
+        # "the failover was slow" straight to timelines in trace.json
+        "failover_trace_id": failover_trace_id,
+        "exemplar_trace_ids": [
+            e["trace_id"] for e in stats_after.get("latency_exemplars", ())
+        ],
         "reroutes": stats_after["reroutes"] - stats_before["reroutes"],
         "retries": stats_after["retries"] - stats_before["retries"],
         "failovers": stats_after["failovers"] - stats_before["failovers"],
@@ -597,7 +614,7 @@ def main() -> None:
     from replay_tpu.data import FeatureHint, FeatureType
     from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
     from replay_tpu.nn.sequential.sasrec import SasRec
-    from replay_tpu.obs import JsonlLogger
+    from replay_tpu.obs import JsonlLogger, Tracer, merge_traces
     from replay_tpu.serve import FallbackScorer, ScoringService, ServingFleet
 
     rng = np.random.default_rng(0)
@@ -634,7 +651,7 @@ def main() -> None:
     # serving phases' latencies
     sharded_retrieval = _run_sharded_retrieval()
 
-    def build_service(logger=None):
+    def build_service(logger=None, tracer=None):
         return ScoringService(
             model,
             params,
@@ -642,6 +659,7 @@ def main() -> None:
             max_wait_ms=MAX_WAIT_MS,
             cache_capacity=CACHE,
             logger=logger,
+            tracer=tracer,
             cold_miss="fallback",
             fallback=FallbackScorer(fallback.item_scores),
         )
@@ -654,8 +672,14 @@ def main() -> None:
     replica_loggers = [
         JsonlLogger(RUN_DIR, mode="w", process_index=i + 1) for i in range(REPLICAS)
     ]
+    # the distributed-tracing plane: one tracer per replica plus the router's
+    # own — merged after the run into ONE trace.json with labeled tracks, so
+    # a hedged/failed-over request reads as one connected timeline
+    router_tracer = Tracer(enabled=True)
+    replica_tracers = {f"r{i}": Tracer(enabled=True) for i in range(REPLICAS)}
     services = {
-        f"r{i}": build_service(logger=replica_loggers[i]) for i in range(REPLICAS)
+        f"r{i}": build_service(logger=replica_loggers[i], tracer=replica_tracers[f"r{i}"])
+        for i in range(REPLICAS)
     }
     baseline_service = build_service()
     compile_seconds = time.perf_counter() - compile_start
@@ -682,6 +706,7 @@ def main() -> None:
         hedge_ms=HEDGE_MS,
         heartbeat_interval_s=HEARTBEAT_S,
         logger=fleet_logger,
+        tracer=router_tracer,
     )
     with fleet:
         # ---- steady state: closed-loop saturation + open-loop latency --- #
@@ -707,16 +732,30 @@ def main() -> None:
         per_replica = {}
         for rid, service in services.items():
             stats = service.stats()
+            router_view = final_stats["per_replica"][rid]
             per_replica[rid] = {
-                "routed": final_stats["per_replica"][rid]["routed"],
+                "routed": router_view["routed"],
                 "answered": stats["answered"],
                 "cache_hit_rate": round(stats["cache_hit_rate"], 4),
                 "errors": stats["errors"],
-                "health": final_stats["per_replica"][rid]["health"],
-                "health_transitions": final_stats["per_replica"][rid][
-                    "health_transitions"
-                ],
+                "health": router_view["health"],
+                "health_transitions": router_view["health_transitions"],
+                # router-side resilience counters: hedges landed here as the
+                # racing twin (wins/cancels), retries this replica's refusals
+                # caused — the per-replica half of the fleet report section
+                "hedges": router_view["hedges"],
+                "hedge_wins": router_view["hedge_wins"],
+                "hedge_cancelled": router_view["hedge_cancelled"],
+                "retries": router_view["retries"],
             }
+
+    # ONE merged trace for the whole run: the router's track plus every
+    # replica's, epoch-aligned — a hedged-and-failed-over request's spans
+    # share a trace_id across tracks and render as one connected timeline
+    merge_traces(
+        {"router": router_tracer, **replica_tracers},
+        os.path.join(RUN_DIR, "trace.json"),
+    )
 
     locality = (
         fleet_hit_rate / single_hit_rate if single_hit_rate else float("nan")
@@ -761,6 +800,10 @@ def main() -> None:
             else None
         ),
         "per_replica": per_replica,
+        # slowest answered requests with their trace ids (the exemplar store
+        # riding the fleet latency histogram): the JSON record's link into
+        # the merged trace.json alongside it
+        "latency_exemplars": final_stats["latency_exemplars"],
         # shard index -> replica id: replica i logs to events.p<i+1>.jsonl,
         # and obs.report uses this map to merge the shard-derived per-replica
         # totals under the replica's name instead of its shard number

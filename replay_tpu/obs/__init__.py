@@ -56,10 +56,14 @@ from .roofline import (
 )
 from .trace import (
     GOODPUT_SPANS,
+    REQUEST_HOP_SPANS,
     SERVE_GOODPUT_SPANS,
+    TraceContext,
     Tracer,
     goodput_breakdown,
     lifecycle_span,
+    merge_traces,
+    tail_attribution,
     traced_iterator,
 )
 
@@ -76,6 +80,7 @@ __all__ = [
     "MetricsRegistry",
     "MultiLogger",
     "NAMED_SCOPES",
+    "REQUEST_HOP_SPANS",
     "SLORule",
     "SLOWatchdog",
     "PEAK_BF16_TFLOPS",
@@ -84,6 +89,7 @@ __all__ = [
     "SERVE_GOODPUT_SPANS",
     "StepTelemetry",
     "TensorBoardLogger",
+    "TraceContext",
     "Tracer",
     "TrainerEvent",
     "analyze_program",
@@ -96,11 +102,13 @@ __all__ = [
     "health_metrics",
     "latest_capture",
     "lifecycle_span",
+    "merge_traces",
     "mfu",
     "of_ceiling",
     "peak_bandwidth",
     "peak_tflops",
     "program_costs",
     "scope_of",
+    "tail_attribution",
     "traced_iterator",
 ]

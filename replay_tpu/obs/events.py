@@ -453,6 +453,35 @@ class ConsoleLogger(RunLogger):
                 event.payload.get("reason"),
                 100.0 * (event.payload.get("users_fraction") or 0.0),
             )
+        elif event.event == "on_hedge":
+            logger.warning(
+                "fleet hedge: user %s slow on %s — racing %s",
+                event.payload.get("user_id"),
+                event.payload.get("primary"),
+                event.payload.get("hedge"),
+            )
+        elif event.event == "on_fleet_start":
+            logger.info(
+                "fleet up: %s replica(s) %s (vnodes=%s, hedge_ms=%s, "
+                "max_retries=%s)",
+                len(event.payload.get("replicas") or ()),
+                event.payload.get("replicas"),
+                event.payload.get("vnodes"),
+                event.payload.get("hedge_ms"),
+                event.payload.get("max_retries"),
+            )
+        elif event.event == "on_fleet_end":
+            logger.info(
+                "fleet down: %s request(s) on %s replica(s) — %s rerouted, "
+                "%s retried, %s hedged (%s won), p99 %.1f ms",
+                event.payload.get("requests"),
+                event.payload.get("replicas"),
+                event.payload.get("reroutes"),
+                event.payload.get("retries"),
+                event.payload.get("hedges"),
+                event.payload.get("hedge_wins"),
+                event.payload.get("p99_ms") or 0.0,
+            )
         elif event.event == "on_swap":
             logger.info(
                 "weight swap (%s): generation %s -> %s%s",

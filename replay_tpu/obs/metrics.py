@@ -118,6 +118,10 @@ class Histogram:
 
     kind = "histogram"
 
+    # slowest-N exemplars kept per histogram: enough to name the offending
+    # traces without growing per-request state
+    EXEMPLAR_CAPACITY = 8
+
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
         bounds = sorted(float(b) for b in buckets)
         if not bounds:
@@ -132,8 +136,11 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # bounded slowest-N (value, exemplar) pairs, only populated when a
+        # caller passes ``exemplar=`` — a plain histogram pays nothing
+        self._exemplars: List[Tuple[float, str]] = []
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         value = float(value)
         if math.isnan(value):
             return  # a NaN observation poisons sum and ranks nothing
@@ -147,6 +154,31 @@ class Histogram:
         self.sum += value
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
+        if exemplar is not None:
+            self._offer_exemplar(value, str(exemplar))
+
+    def _offer_exemplar(self, value: float, exemplar: str) -> None:
+        """Keep the slowest :data:`EXEMPLAR_CAPACITY` (value, exemplar) pairs:
+        the tail's trace ids, attached to the distribution that says the tail
+        is slow. Mutate under the same lock as :meth:`observe` (the registry's
+        or the owning object's)."""
+        store = self._exemplars
+        if len(store) < self.EXEMPLAR_CAPACITY:
+            store.append((value, exemplar))
+            store.sort(key=lambda pair: pair[0])
+            return
+        if value <= store[0][0]:
+            return  # faster than the fastest kept exemplar: not tail material
+        store[0] = (value, exemplar)
+        store.sort(key=lambda pair: pair[0])
+
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """Slowest-first ``{value, trace_id}`` records (empty when no
+        observation carried an exemplar)."""
+        return [
+            {"value": value, "trace_id": exemplar}
+            for value, exemplar in sorted(self._exemplars, key=lambda p: -p[0])
+        ]
 
     def quantile(self, q: float) -> Optional[float]:
         if not 0.0 <= q <= 1.0:
@@ -182,7 +214,7 @@ class Histogram:
         return self.sum / self.total if self.total else None
 
     def sample(self) -> Dict[str, Any]:
-        return {
+        out = {
             "type": self.kind,
             "count": self.total,
             "sum": self.sum,
@@ -194,6 +226,9 @@ class Histogram:
                 f"p{int(q * 100)}": self.quantile(q) for q in (0.5, 0.9, 0.99)
             },
         }
+        if self._exemplars:
+            out["exemplars"] = self.exemplars()
+        return out
 
 
 class MetricsRegistry:
@@ -243,9 +278,12 @@ class MetricsRegistry:
         value: float,
         labels: Optional[Mapping[str, str]] = None,
         buckets: Sequence[float] = DEFAULT_BUCKETS,
+        exemplar: Optional[str] = None,
     ) -> None:
         with self._lock:
-            self._get(name, "histogram", labels, lambda: Histogram(buckets)).observe(value)
+            self._get(name, "histogram", labels, lambda: Histogram(buckets)).observe(
+                value, exemplar=exemplar
+            )
 
     # -- readers ------------------------------------------------------------ #
     def value(self, ref: str, labels: Optional[Mapping[str, str]] = None) -> Optional[float]:
@@ -598,6 +636,22 @@ class MetricsLogger(RunLogger):
                 ("p99_ms", "replay_fleet_p99_ms"),
             ):
                 self._gauge(metric, payload.get(key))
+            # the fleet's slowest-N latency exemplars, re-observed into a
+            # registry histogram so ``/snapshot`` names the offending traces
+            exemplars = payload.get("latency_exemplars")
+            if isinstance(exemplars, (list, tuple)):
+                for record in exemplars:
+                    if not isinstance(record, Mapping):
+                        continue
+                    latency = _finite(record.get("latency_ms"))
+                    trace_id = record.get("trace_id")
+                    if latency is not None and trace_id:
+                        self.registry.observe(
+                            "replay_fleet_latency_exemplar_ms",
+                            latency,
+                            buckets=QUEUE_WAIT_MS_BUCKETS,
+                            exemplar=str(trace_id),
+                        )
             self.registry.set("replay_fleet_up", 0.0)
         elif name == "on_slo_violation":
             self.registry.inc(

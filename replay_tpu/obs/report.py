@@ -33,7 +33,11 @@ throughput/MFU drops, new retraces, ``peak_memory_bytes`` growth beyond
 ``--memory-threshold``, ``compile_seconds`` growth beyond
 ``--compile-threshold``, and per-bench-row throughput (rows with an ``error``
 field — by-design OOM evidence — are skipped, not tripped on), so CI can
-gate on it.
+gate on it. A fleet run's merged ``trace.json`` (serve.fleet distributed
+tracing) additionally yields the "tail attribution" section — p50/p99 of
+traced requests decomposed into per-hop fractions summing to 1.0 — plus the
+slowest-request exemplar trace ids; ``--compare`` gates a hop's p99 SHARE
+growing by more than 10 points even when p99 itself is flat.
 
 Import-light by design (stdlib only): the CLI must run in seconds with no
 jax/device involvement, and a malformed artifact must fail loudly (non-zero
@@ -50,11 +54,12 @@ import os
 import sys
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
-from .trace import GOODPUT_SPANS, SERVE_GOODPUT_SPANS
+from .trace import GOODPUT_SPANS, SERVE_GOODPUT_SPANS, tail_attribution
 
 __all__ = [
     "compare_runs",
     "load_events",
+    "load_trace_events",
     "main",
     "render",
     "straggler_summary",
@@ -153,11 +158,13 @@ def load_events(path: str) -> List[Dict[str, Any]]:
     return [dict(r) for r in records]
 
 
-def load_trace(path: str) -> Dict[str, Dict[str, float]]:
-    """Validate Chrome trace-event JSON and aggregate ``{name: {count, seconds}}``.
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """The validated raw ``traceEvents`` list of a Chrome trace-event JSON.
 
     The validation IS the contract check CI leans on: every event must carry
-    ``name``/``ph``/``ts`` and a non-negative duration.
+    ``name``/``ph``/``ts`` and a non-negative duration. Tail attribution needs
+    the per-event ``trace_id`` args the name-level aggregation of
+    :func:`load_trace` folds away, so the raw list is its own loader.
     """
     with open(path) as fh:
         payload = json.load(fh)
@@ -165,7 +172,6 @@ def load_trace(path: str) -> Dict[str, Dict[str, float]]:
     if not isinstance(events, list):
         msg = f"{path}: no traceEvents list"
         raise ValueError(msg)
-    spans: Dict[str, Dict[str, float]] = {}
     for i, event in enumerate(events):
         if not isinstance(event, Mapping) or not all(
             key in event for key in ("name", "ph", "ts")
@@ -176,10 +182,25 @@ def load_trace(path: str) -> Dict[str, Dict[str, float]]:
         if not isinstance(duration, (int, float)) or duration < 0:
             msg = f"{path}: traceEvents[{i}] has a negative or non-numeric dur"
             raise ValueError(msg)
+    return [dict(e) for e in events]
+
+
+def _aggregate_trace(events: Sequence[Mapping[str, Any]]) -> Dict[str, Dict[str, float]]:
+    spans: Dict[str, Dict[str, float]] = {}
+    for event in events:
+        if event.get("ph") == "M":
+            # metadata (the merged fleet trace's process_name track labels):
+            # not a timed span, excluded from the name-level aggregation
+            continue
         entry = spans.setdefault(str(event["name"]), {"count": 0, "seconds": 0.0})
         entry["count"] += 1
-        entry["seconds"] += float(duration) / 1e6
+        entry["seconds"] += float(event.get("dur", 0)) / 1e6
     return spans
+
+
+def load_trace(path: str) -> Dict[str, Dict[str, float]]:
+    """Validate Chrome trace-event JSON and aggregate ``{name: {count, seconds}}``."""
+    return _aggregate_trace(load_trace_events(path))
 
 
 # --------------------------------------------------------------------------- #
@@ -219,15 +240,22 @@ def summarize_run(path: str) -> Dict[str, Any]:
                 # filename still carries the process identity
                 record["process_index"] = process_index
             events.append(record)
-    trace = load_trace(trace_path) if trace_path else None
+    raw_trace = load_trace_events(trace_path) if trace_path else None
     summary = summarize_events(events, source=path)
-    if trace is not None:
+    if raw_trace is not None:
+        trace = _aggregate_trace(raw_trace)
         summary["trace"] = trace
         # total h2d time ACROSS threads: in a chunked run the device feed
         # places chunks on a feeder thread, so most of this never shows up in
         # the fit thread's goodput fractions — the delta IS the overlap win
         if "h2d" in trace:
             summary["h2d_seconds"] = float(trace["h2d"]["seconds"])
+        # tail attribution (fleet traces): decompose the slow tail of traced
+        # requests into per-hop fractions — None for training traces, whose
+        # spans carry no request roots
+        attribution = tail_attribution(raw_trace)
+        if attribution is not None:
+            summary["tail_attribution"] = attribution
     return summary
 
 
@@ -791,6 +819,37 @@ def summarize_events(
                 )
                 if key in e
             }
+        if fleet_ends:
+            # per-replica ROUTER counters from the final fleet stats: hedges
+            # LANDED on each replica as the racing twin, hedge wins/cancels,
+            # and retries each replica's refusals caused — merged into the
+            # same per-replica map the serve-side shards fill ("answered"
+            # stays serve-side: the router's count excludes lost hedge twins)
+            router_stats = fleet_ends[-1].get("per_replica")
+            if isinstance(router_stats, Mapping):
+                for replica, stats in router_stats.items():
+                    if not isinstance(stats, Mapping):
+                        continue
+                    dest = per_replica.setdefault(str(replica), {})
+                    for key in (
+                        "routed", "hedges", "hedge_wins", "hedge_cancelled",
+                        "retries",
+                    ):
+                        if _finite(stats.get(key)) is not None:
+                            dest[key] = stats.get(key)
+            # the exemplar store: the slowest answered requests with their
+            # trace ids — the report's link from "p99 is slow" to the exact
+            # timelines in the merged trace.json
+            exemplars = fleet_ends[-1].get("latency_exemplars")
+            if isinstance(exemplars, (list, tuple)) and exemplars:
+                fleet["latency_exemplars"] = [
+                    {
+                        "latency_ms": e.get("latency_ms"),
+                        "trace_id": e.get("trace_id"),
+                    }
+                    for e in exemplars
+                    if isinstance(e, Mapping)
+                ]
         if per_replica:
             fleet["per_replica"] = per_replica
         if fleet_bench is not None:
@@ -809,7 +868,7 @@ def summarize_events(
                     for key in (
                         "killed", "revived", "failover_gap_ms", "reroutes",
                         "hung_requests", "error_rate", "failover_answers",
-                        "failover_served_by",
+                        "failover_served_by", "exemplar_trace_ids",
                     )
                     if key in chaos
                 }
@@ -1369,6 +1428,18 @@ def render(summary: Mapping[str, Any]) -> str:
                         f"p99 {stats['p99_ms']:.1f}ms" if _finite(stats.get("p99_ms")) is not None else None,
                         f"{stats['answered']}ans" if stats.get("answered") is not None else None,
                         f"hits {stats['cache_hit_rate']:.0%}" if _finite(stats.get("cache_hit_rate")) is not None else None,
+                        (
+                            f"hedges {stats['hedges']}"
+                            + (
+                                f"({stats['hedge_wins']}w/{stats['hedge_cancelled']}c)"
+                                if stats.get("hedge_wins") is not None
+                                or stats.get("hedge_cancelled") is not None
+                                else ""
+                            )
+                        )
+                        if stats.get("hedges") is not None
+                        else None,
+                        f"retries {stats['retries']}" if stats.get("retries") is not None else None,
                     )
                     if part
                 )
@@ -1376,6 +1447,16 @@ def render(summary: Mapping[str, Any]) -> str:
                 if isinstance(stats, Mapping)
             )
             lines.append(f"  fleet replicas: {shown}")
+        exemplars = fleet.get("latency_exemplars")
+        if isinstance(exemplars, (list, tuple)) and exemplars:
+            lines.append(
+                "  fleet exemplars (slowest): "
+                + " · ".join(
+                    f"{_fmt(_finite(e.get('latency_ms')), '{:.1f}')}ms {e.get('trace_id')}"
+                    for e in exemplars[:4]
+                    if isinstance(e, Mapping)
+                )
+            )
         chaos = fleet.get("chaos")
         if isinstance(chaos, Mapping):
             parts = []
@@ -1389,6 +1470,9 @@ def render(summary: Mapping[str, Any]) -> str:
             if chaos.get("revived") is not None:
                 parts.append(f"revived {chaos['revived']}")
             parts.append(f"hung {chaos.get('hung_requests', 0)}")
+            trace_ids = chaos.get("exemplar_trace_ids")
+            if isinstance(trace_ids, (list, tuple)) and trace_ids:
+                parts.append("traces " + ",".join(str(t) for t in trace_ids[:3]))
             lines.append("  fleet chaos: " + " · ".join(parts))
         drain_swap = fleet.get("drain_swap")
         if isinstance(drain_swap, Mapping):
@@ -1401,6 +1485,31 @@ def render(summary: Mapping[str, Any]) -> str:
                     if _finite(drain_swap.get("p99_ms")) is not None
                     else ""
                 )
+            )
+    attribution = summary.get("tail_attribution")
+    if isinstance(attribution, Mapping) and isinstance(
+        attribution.get("quantiles"), Mapping
+    ):
+        lines.append(
+            f"  tail attribution ({attribution.get('requests', 0)} traced "
+            "request(s)):"
+        )
+        for label, entry in attribution["quantiles"].items():
+            if not isinstance(entry, Mapping):
+                continue
+            fractions = entry.get("fractions")
+            if not isinstance(fractions, Mapping):
+                continue
+            shown = " · ".join(
+                f"{hop} {float(frac):.0%}"
+                for hop, frac in sorted(
+                    fractions.items(), key=lambda kv: -float(kv[1])
+                )
+                if _finite(frac) is not None and float(frac) >= 0.005
+            )
+            lines.append(
+                f"    {label} {_fmt(_finite(entry.get('latency_ms')), '{:.1f}')} ms: "
+                f"{shown} (n={entry.get('n')})"
             )
     return "\n".join(lines)
 
@@ -1814,6 +1923,42 @@ def compare_runs(
         base_loc = _finite(base_fleet.get("cache_hit_locality"))
         if cand_loc is not None and base_loc is not None:
             lines.append(f"  fleet_cache_hit_locality: {cand_loc:.3f} vs {base_loc:.3f}")
+    # tail-attribution gate: a hop's SHARE of the p99 mix growing by more
+    # than 10 points is a regression even when p99 itself is flat — where
+    # the tail's time goes is its own contract (e.g. queue_wait swallowing
+    # the mix says batching went wrong before latency SLOs notice). Absolute
+    # point shift, not relative: a 2%→4% hop doubling is noise, 30%→42%
+    # is not. Chaos-phase-matched like the fleet latency gates; smaller
+    # shifts (≥ 2 points) are surfaced without gating.
+    cand_attr = candidate.get("tail_attribution") or {}
+    base_attr = baseline.get("tail_attribution") or {}
+    cand_p99_mix = ((cand_attr.get("quantiles") or {}).get("p99") or {}).get("fractions")
+    base_p99_mix = ((base_attr.get("quantiles") or {}).get("p99") or {}).get("fractions")
+    if isinstance(cand_p99_mix, Mapping) and isinstance(base_p99_mix, Mapping):
+        attr_chaos_match = bool((candidate.get("fleet") or {}).get("chaos")) == bool(
+            (baseline.get("fleet") or {}).get("chaos")
+        )
+        for name in sorted(set(cand_p99_mix) | set(base_p99_mix)):
+            cand_frac = _finite(cand_p99_mix.get(name))
+            base_frac = _finite(base_p99_mix.get(name))
+            if cand_frac is None or base_frac is None:
+                continue
+            shift = cand_frac - base_frac
+            if abs(shift) >= 0.02:
+                lines.append(
+                    f"  tail_p99_share/{name}: {cand_frac:.1%} vs {base_frac:.1%}"
+                )
+            if shift > 0.10:
+                if attr_chaos_match:
+                    regressions.append(
+                        f"tail_p99_share/{name} grew {base_frac:.1%} -> "
+                        f"{cand_frac:.1%} (> 10-point shift in the p99 hop mix)"
+                    )
+                else:
+                    lines.append(
+                        f"  tail_p99_share/{name}: not gated "
+                        "(chaos phase ran on one side only)"
+                    )
     # cross-host balance: the straggler index (max/median per-host step time)
     # gates lower-better, but ONLY between two genuinely multi-process runs —
     # a single-process run's index is 1.0 by construction and comparing it

@@ -52,7 +52,7 @@ from concurrent.futures import CancelledError, Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable, Dict, Hashable, List, Mapping, Optional, Sequence, Tuple
 
-from replay_tpu.obs import TrainerEvent
+from replay_tpu.obs import TraceContext, Tracer, TrainerEvent
 
 from .errors import CircuitOpen, NoHealthyReplica, RequestShed, ServiceClosed
 from .futures import safe_fail, safe_set_result
@@ -80,6 +80,14 @@ class ReplicaHandle:
         self.last_errors = 0.0
         self.routed = 0
         self.answered = 0
+        # per-replica resilience accounting (stats()["per_replica"], rendered
+        # by obs.report): hedges LANDED here as the racing twin, wins where
+        # this replica's hedge answered first, cancels where its twin lost,
+        # and retries this replica's refusals caused
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.hedge_cancelled = 0
+        self.retries = 0
 
 
 class _Flight:
@@ -88,10 +96,11 @@ class _Flight:
     __slots__ = (
         "user_id", "kwargs", "client", "idempotent", "home", "attempt",
         "tried", "inflight", "scheduled", "retry_scheduled", "failure",
-        "hedged", "hedge_replica", "submitted_at", "lock",
+        "hedged", "hedge_replica", "submitted_at", "trace", "trace_t0", "lock",
     )
 
-    def __init__(self, user_id, kwargs, client, idempotent, home, submitted_at):
+    def __init__(self, user_id, kwargs, client, idempotent, home, submitted_at,
+                 trace=None, trace_t0=0.0):
         self.user_id = user_id
         self.kwargs = kwargs
         self.client = client
@@ -106,6 +115,12 @@ class _Flight:
         self.hedged = False
         self.hedge_replica: Optional[str] = None  # who the hedge raced on
         self.submitted_at = submitted_at
+        # distributed-trace identity: a TraceContext minted at admission when
+        # the fleet tracer is on (None otherwise — the disabled hot path
+        # carries no per-request trace state), and the router-tracer-relative
+        # admission timestamp anchoring the root "request" span
+        self.trace: Optional[TraceContext] = trace
+        self.trace_t0 = trace_t0
         self.lock = threading.Lock()
 
 
@@ -135,6 +150,15 @@ class ServingFleet:
     :param logger: any :class:`~replay_tpu.obs.RunLogger`; receives
         ``on_fleet_start`` / ``on_replica_health`` / ``on_failover`` /
         ``on_hedge`` / ``on_fleet_end``.
+    :param tracer: the ROUTER's :class:`~replay_tpu.obs.Tracer` (the "router"
+        track of the merged fleet trace). When enabled, every :meth:`submit`
+        mints a :class:`~replay_tpu.obs.TraceContext` and propagates it to the
+        replica (``service.submit(..., _trace=...)`` as pure JSON) on every
+        launch — primary, hedge and retry alike — while the router records its
+        own hops (``route`` / ``hedge_wait`` / ``backoff_wait`` /
+        ``failover_reroute`` / ``hedge_cancel``) and the root ``request`` span
+        keyed by the same trace_id. ``None`` (default) disables tracing: no
+        context is minted, no kwarg injected — the hot path is unchanged.
     """
 
     def __init__(
@@ -149,6 +173,7 @@ class ServingFleet:
         degrade_depth_fraction: float = 0.75,
         degrade_error_rate: float = 0.5,
         logger=None,
+        tracer: Optional[Tracer] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if isinstance(replicas, Mapping):
@@ -160,6 +185,7 @@ class ServingFleet:
             raise ValueError(msg)
         self._clock = clock
         self.logger = logger
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
         self.handles: Dict[str, ReplicaHandle] = {
             str(rid): ReplicaHandle(str(rid), service, clock)
             for rid, service in named.items()
@@ -290,6 +316,13 @@ class ServingFleet:
         }
         with self._lock:
             self._requests += 1
+        # trace admission: mint the context BEFORE routing so the hash lookup
+        # itself is a traced hop. Tracing off mints nothing — None everywhere
+        trace: Optional[TraceContext] = None
+        trace_t0 = 0.0
+        if self.tracer.enabled:
+            trace = TraceContext.mint()
+            trace_t0 = self.tracer.now()
         order = self.ring.preference(user_id)
         flight = _Flight(
             user_id=user_id,
@@ -298,8 +331,20 @@ class ServingFleet:
             idempotent=not new_items,
             home=order[0] if order else None,
             submitted_at=self._clock(),
+            trace=trace,
+            trace_t0=trace_t0,
         )
         target = self._pick_target(order, skip=())
+        if trace is not None:
+            # the replica-bound context rides in flight.kwargs, so EVERY
+            # launch (primary, hedge, retry) forwards it — as pure JSON,
+            # the same payload a future socket boundary would carry
+            kwargs["_trace"] = trace.child("route").to_json()
+            self.tracer.add_span(
+                "route", trace_t0, self.tracer.now() - trace_t0,
+                trace_id=trace.trace_id, user=str(user_id),
+                home=flight.home, target=target,
+            )
         if target is None:
             with self._lock:
                 self._no_healthy_refusals += 1
@@ -309,6 +354,11 @@ class ServingFleet:
         if target != flight.home:
             with self._lock:
                 self._reroutes += 1
+            if trace is not None:
+                self.tracer.add_span(
+                    "failover_reroute", self.tracer.now(), 0.0,
+                    trace_id=trace.trace_id, home=flight.home, target=target,
+                )
         self._launch(flight, target, hedge_eligible=True)
         # a client-side give-up (score(timeout=...) cancels) propagates to
         # the in-flight replica requests, so the batch builder skips them
@@ -425,6 +475,17 @@ class ServingFleet:
             flight.hedge_replica = target
         with self._lock:
             self._hedges += 1
+            target_handle = self.handles.get(target)
+            if target_handle is not None:
+                target_handle.hedges += 1
+        if flight.trace is not None:
+            # the window the primary was given before the race began —
+            # admission to hedge launch, on the router track
+            now = self.tracer.now()
+            self.tracer.add_span(
+                "hedge_wait", flight.trace_t0, now - flight.trace_t0,
+                trace_id=flight.trace.trace_id, primary=primary, hedge=target,
+            )
         self._emit(
             "on_hedge",
             {"user_id": str(flight.user_id), "primary": primary, "hedge": target},
@@ -451,6 +512,10 @@ class ServingFleet:
 
     def _on_answer(self, flight: _Flight, replica_id: str, response) -> None:
         response.replica = replica_id
+        if flight.trace is not None:
+            # stamp the winning answer with its trace id (like ``.replica``):
+            # a chaos probe's slow failover answer links straight to its trace
+            response.trace_id = flight.trace.trace_id
         if not self._safe_set_result(flight.client, response):
             return  # a racing hedge already won (or the client gave up)
         handle = self.handles.get(replica_id)
@@ -459,20 +524,48 @@ class ServingFleet:
             self._answered += 1
             if handle is not None:
                 handle.answered += 1
-            self._latency_ms.observe((now - flight.submitted_at) * 1000.0)
+            self._latency_ms.observe(
+                (now - flight.submitted_at) * 1000.0,
+                exemplar=flight.trace.trace_id if flight.trace is not None else None,
+            )
             # a win is the HEDGE replica answering — not whoever happened to
             # be tried last (a post-hedge backoff retry answering is a retry
             # win, and the hedge itself lost)
             if flight.hedged and replica_id == flight.hedge_replica:
                 self._hedge_wins += 1
+                if handle is not None:
+                    handle.hedge_wins += 1
+        if flight.trace is not None:
+            # the root span of the whole request: admission → winning answer.
+            # Its duration is the denominator of the report's tail attribution
+            # (every hop span sharing this trace_id is a numerator slice), and
+            # ``served_by`` names the degradation-ladder rung that answered
+            self.tracer.add_span(
+                "request", flight.trace_t0, self.tracer.now() - flight.trace_t0,
+                trace_id=flight.trace.trace_id, user=str(flight.user_id),
+                replica=replica_id,
+                served_by=getattr(response, "served_by", None),
+                served_from=getattr(response, "served_from", None),
+                hedged=flight.hedged, attempts=flight.attempt,
+            )
         # cancel the losers through the existing future-cancel path: a still-
         # queued twin is skipped at batch build before any device work
         with flight.lock:
-            losers = [f for f in flight.inflight if not f.done()]
-        for loser in losers:
+            losers = [
+                (f, rid) for f, rid in flight.inflight.items() if not f.done()
+            ]
+        for loser, loser_rid in losers:
             if loser.cancel():
                 with self._lock:
                     self._hedge_cancelled += 1
+                    loser_handle = self.handles.get(loser_rid)
+                    if loser_handle is not None:
+                        loser_handle.hedge_cancelled += 1
+                if flight.trace is not None:
+                    self.tracer.add_span(
+                        "hedge_cancel", self.tracer.now(), 0.0,
+                        trace_id=flight.trace.trace_id, replica=loser_rid,
+                    )
 
     def _on_refusal(self, flight: _Flight, replica_id: str, exc: BaseException) -> None:
         retryable = isinstance(exc, (RequestShed, CircuitOpen, ServiceClosed))
@@ -503,6 +596,18 @@ class ServingFleet:
         if schedule_retry:
             with self._lock:
                 self._retries += 1
+                refusing = self.handles.get(replica_id)
+                if refusing is not None:
+                    refusing.retries += 1
+            if flight.trace is not None:
+                # the backoff window is known NOW (the scheduler fires exactly
+                # ``delay`` later): record it as a span so the wait the
+                # refusal bought is visible on the request's timeline
+                self.tracer.add_span(
+                    "backoff_wait", self.tracer.now(), delay,
+                    trace_id=flight.trace.trace_id, replica=replica_id,
+                    attempt=flight.attempt, error=type(exc).__name__,
+                )
             self._schedule_flight(delay, flight, lambda: self._fire_retry(flight, exc))
             return
         self._maybe_finalize(flight)
@@ -528,6 +633,12 @@ class ServingFleet:
         if target != flight.home:
             with self._lock:
                 self._reroutes += 1
+            if flight.trace is not None:
+                self.tracer.add_span(
+                    "failover_reroute", self.tracer.now(), 0.0,
+                    trace_id=flight.trace.trace_id, home=flight.home,
+                    target=target, retry=True,
+                )
         self._launch(flight, target, hedge_eligible=False)
         self._maybe_finalize(flight)
 
@@ -832,9 +943,21 @@ class ServingFleet:
                     rid: {
                         "routed": handle.routed,
                         "answered": handle.answered,
+                        "hedges": handle.hedges,
+                        "hedge_wins": handle.hedge_wins,
+                        "hedge_cancelled": handle.hedge_cancelled,
+                        "retries": handle.retries,
                     }
                     for rid, handle in self.handles.items()
                 },
+                # slowest-N answered requests with their trace ids (the
+                # exemplar store riding the latency histogram) — the
+                # report's / bench record's link from "p99 is slow" to the
+                # exact traces that made it slow
+                "latency_exemplars": [
+                    {"latency_ms": e["value"], "trace_id": e["trace_id"]}
+                    for e in self._latency_ms.exemplars()
+                ],
             }
         with self._health_lock:
             for rid, handle in self.handles.items():

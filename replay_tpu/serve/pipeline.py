@@ -104,24 +104,28 @@ class CandidatePipeline:
 
         return rerank
 
-    def rank(self, hidden, tracer=None) -> Tuple[np.ndarray, np.ndarray]:
+    def rank(self, hidden, tracer=None, span_args=None) -> Tuple[np.ndarray, np.ndarray]:
         """``[B, E]`` query states → (scores ``[B, k]``, item ids ``[B, k]``).
 
         The device stages are traced as ``retrieve`` / ``rescore`` /
         ``rerank`` spans when a tracer is supplied (``rescore`` only for a
         quantized index: exact f32 scores of the retrieved candidates replace
-        the quantized sweep's approximate values before the re-rank cut)."""
+        the quantized sweep's approximate values before the re-rank cut);
+        ``span_args`` merges extra args into each span — the service passes
+        the batch's distributed ``trace_ids`` here so retrieval time lands on
+        every traced co-rider's request timeline."""
         import contextlib
 
         span = tracer.span if tracer is not None else (lambda *_a, **_k: contextlib.nullcontext())
-        with span("retrieve", rows=int(np.shape(hidden)[0]), k=self.num_candidates):
+        extra = span_args or {}
+        with span("retrieve", rows=int(np.shape(hidden)[0]), k=self.num_candidates, **extra):
             values, ids = self.index.search_jax(hidden, self.num_candidates)
         if getattr(self.index, "precision", "f32") != "f32":
             # full-precision re-rank input: the int8 sweep only chose WHICH C
             # rows to score; their ranking scores are exact f32
-            with span("rescore", rows=int(np.shape(hidden)[0]), k=self.num_candidates):
+            with span("rescore", rows=int(np.shape(hidden)[0]), k=self.num_candidates, **extra):
                 values = self.index.exact_rescore(hidden, ids)
-        with span("rerank", rows=int(np.shape(hidden)[0]), k=self.top_k):
+        with span("rerank", rows=int(np.shape(hidden)[0]), k=self.top_k, **extra):
             scores, items = self._rerank(values, ids)
             scores = np.asarray(scores)
             items = np.asarray(items)

@@ -87,6 +87,11 @@ class ScoreResponse:
     # the rung AND the replica that took it). None for direct single-service
     # scoring.
     replica: Optional[str] = None
+    # the distributed-trace id of the request that produced this answer,
+    # stamped by the fleet router when its tracer is on — the client-side
+    # handle into the merged trace.json (chaos probes record it so a slow
+    # failover links straight to its timeline). None when tracing is off.
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -131,6 +136,13 @@ class PendingRequest:
     role: str = "stable"
     embedding_generation: int = 0
     canary_epoch: int = 0
+    # distributed-trace context forwarded by the fleet router (the pure-JSON
+    # ``TraceContext.to_json()`` payload: at least ``{"trace_id": ...}``).
+    # Dispatch-side spans (queue_wait, the batch's build/score window) carry
+    # its trace_id in their args so the request's replica-side time lands on
+    # its timeline. None when the request arrived untraced — the default path
+    # allocates nothing
+    trace: Optional[dict] = None
 
 
 def make_window(

@@ -259,7 +259,14 @@ class BackoffPolicy:
 
     def delay(self, attempt: int, retry_after_s: Optional[float] = None) -> float:
         """Seconds to wait before retry number ``attempt`` (0-based)."""
-        backoff = min(self.base_s * self.multiplier ** max(int(attempt), 0), self.cap_s)
+        try:
+            grown = self.base_s * self.multiplier ** max(int(attempt), 0)
+        except OverflowError:
+            # multiplier**attempt past float range (~2.0**1024): the growth is
+            # monotonic, so the cap is the exact answer — never an exception
+            # out of a retry scheduler
+            grown = float("inf")
+        backoff = min(grown, self.cap_s)
         if retry_after_s is not None:
             # the hint wins when it is LONGER; the cap still bounds the total
             backoff = min(max(backoff, float(retry_after_s)), max(self.cap_s, float(retry_after_s)))

@@ -317,6 +317,7 @@ class ScoringService:
         candidates: Optional[Sequence[int]] = None,
         deadline_ms: Optional[float] = None,
         _role: Optional[str] = None,
+        _trace: Optional[dict] = None,
     ) -> "Future[ScoreResponse]":
         """Enqueue one scoring request; resolves to a :class:`ScoreResponse`.
 
@@ -329,6 +330,13 @@ class ScoringService:
         ``_role`` forces the traffic-slice routing ("stable"/"candidate") —
         the shadow-stage probe seam; normal traffic routes by the canary's
         deterministic hash slice.
+
+        ``_trace`` is the fleet router's distributed-trace context (the
+        pure-JSON ``TraceContext.to_json()`` payload): when present, this
+        request's replica-side spans — ``queue_wait``, its batch's
+        build/score window, a fallback answer — carry its trace_id, so the
+        merged fleet trace shows the request's replica time on its own
+        timeline. ``None`` (untraced/direct traffic) changes nothing.
         """
         future: "Future[ScoreResponse]" = Future()
         if deadline_ms is None:
@@ -351,7 +359,7 @@ class ScoringService:
             else None
         )
         try:
-            resolved = self._resolve(request, future, role)
+            resolved = self._resolve(request, future, role, trace=_trace)
             if resolved is None:  # answered inline by the fallback floor
                 return future
             lane, pending = resolved
@@ -574,10 +582,15 @@ class ScoringService:
 
     # -- request resolution (client thread) --------------------------------- #
     def _resolve(
-        self, request: ScoreRequest, future: "Future[ScoreResponse]", role: str = "stable"
+        self,
+        request: ScoreRequest,
+        future: "Future[ScoreResponse]",
+        role: str = "stable",
+        trace: Optional[dict] = None,
     ) -> Optional[Tuple[Hashable, PendingRequest]]:
         """Route a request to a (lane, pending) — or answer it inline
-        (fallback floor, returning None)."""
+        (fallback floor, returning None). ``trace`` (the fleet's JSON trace
+        context) rides on whatever pending this resolves to."""
         if request.candidates is not None and self.mode != "full":
             msg = (
                 f"per-request candidates need the full-scoring service "
@@ -610,7 +623,9 @@ class ScoringService:
                 generation=previous.generation + 1 if previous else 0,
             )
             self.cache.store(request.user_id, state)
-            return self._encode_or_degrade(request, future, state, "cold", previous, role)
+            return self._encode_or_degrade(
+                request, future, state, "cold", previous, role, trace=trace
+            )
 
         if request.new_items:
             # atomic lookup+advance+store: concurrent appends for one user
@@ -624,11 +639,13 @@ class ScoringService:
                 request.user_id, request.new_items, self.pad_id
             )
             if advanced is None:
-                return self._cold_miss(request, future, role)
-            return self._encode_or_degrade(request, future, advanced, "advance", previous, role)
+                return self._cold_miss(request, future, role, trace=trace)
+            return self._encode_or_degrade(
+                request, future, advanced, "advance", previous, role, trace=trace
+            )
         state = self.cache.lookup(request.user_id)
         if state is None:
-            return self._cold_miss(request, future, role)
+            return self._cold_miss(request, future, role, trace=trace)
         if state.embedding is not None:
             # hot-swap staleness guard (serve.promote): an embedding encoded
             # by an older parameter generation must never be scored through
@@ -649,15 +666,22 @@ class ScoringService:
                     extra=(state,),
                     role=role,
                     embedding_generation=state.param_generation,
+                    trace=trace,
                 )
                 return ("hit", role), pending
         # cached window whose embedding is still in flight (or was raced
         # away, or certifies an older param generation): re-encode the cached
         # window — still no history re-send
-        return self._encode_or_degrade(request, future, state, "advance", state, role)
+        return self._encode_or_degrade(
+            request, future, state, "advance", state, role, trace=trace
+        )
 
     def _cold_miss(
-        self, request: ScoreRequest, future: "Future[ScoreResponse]", role: str
+        self,
+        request: ScoreRequest,
+        future: "Future[ScoreResponse]",
+        role: str,
+        trace: Optional[dict] = None,
     ) -> Optional[Tuple[Hashable, PendingRequest]]:
         """A state-less request with no history: error (the original
         contract) or the ladder floor (``cold_miss="fallback"`` — the fleet
@@ -676,7 +700,9 @@ class ScoringService:
             )
             raise KeyError(msg)
         if self.cold_miss == "fallback" and self.fallback is not None:
-            self._finish_fallback(request, future, reason="cold_miss", role=role)
+            self._finish_fallback(
+                request, future, reason="cold_miss", role=role, trace=trace
+            )
             return None
         msg = (
             f"user {request.user_id!r} has no cached state; "
@@ -692,6 +718,7 @@ class ScoringService:
         served_from: str,
         previous: Optional[UserState],
         role: str = "stable",
+        trace: Optional[dict] = None,
     ) -> Optional[Tuple[Hashable, PendingRequest]]:
         """The primary encode route, gated by the breaker; refused traffic
         walks the degradation ladder instead."""
@@ -699,14 +726,16 @@ class ScoringService:
         stale_length = previous.length if previous is not None else 0
         stale_generation = previous.param_generation if previous is not None else 0
         if self.breaker.allow():
-            lane, pending = self._encode_pending(request, future, state, served_from, role)
+            lane, pending = self._encode_pending(
+                request, future, state, served_from, role, trace=trace
+            )
             pending.stale_embedding = stale_embedding
             pending.stale_length = stale_length
             pending.embedding_generation = stale_generation
             return lane, pending
         return self._degrade(
             request, future, stale_embedding, stale_length, stale_generation,
-            role, reason="breaker_open",
+            role, reason="breaker_open", trace=trace,
         )
 
     def _cache_only_pending(
@@ -719,6 +748,7 @@ class ScoringService:
         expires_at: Optional[float] = None,
         role: str = "stable",
         embedding_generation: int = 0,
+        trace: Optional[dict] = None,
     ) -> PendingRequest:
         """The cache_only rung's pending: the stale cached state routed to the
         hit lane. The on_degrade emit happens at enqueue success, not here."""
@@ -734,6 +764,7 @@ class ScoringService:
             degrade_reason=reason,
             role=role,
             embedding_generation=embedding_generation,
+            trace=trace,
         )
 
     def _emit_degraded(self, pending: PendingRequest) -> None:
@@ -756,17 +787,20 @@ class ScoringService:
         stale_generation: int,
         role: str,
         reason: str,
+        trace: Optional[dict] = None,
     ) -> Optional[Tuple[Hashable, PendingRequest]]:
         """Walk the ladder below primary: cache_only (hit lane on the stale
         cached state), then the fallback floor, then an explicit refusal."""
         if stale_embedding is not None:
             pending = self._cache_only_pending(
                 request, future, stale_embedding, stale_length, reason,
-                role=role, embedding_generation=stale_generation,
+                role=role, embedding_generation=stale_generation, trace=trace,
             )
             return ("hit", role), pending
         if self.fallback is not None:
-            self._finish_fallback(request, future, reason=reason, role=role)
+            self._finish_fallback(
+                request, future, reason=reason, role=role, trace=trace
+            )
             return None
         raise CircuitOpen(self.breaker.retry_after_s())
 
@@ -788,6 +822,7 @@ class ScoringService:
                 expires_at=pending.expires_at,
                 role=role,
                 embedding_generation=pending.embedding_generation,
+                trace=pending.trace,
             )
             degraded.canary_epoch = pending.canary_epoch
             try:
@@ -798,7 +833,10 @@ class ScoringService:
                 self._emit_degraded(degraded)
                 return True
         if self.fallback is not None:
-            self._finish_fallback(request, pending.future, reason="overload", role=role)
+            self._finish_fallback(
+                request, pending.future, reason="overload", role=role,
+                trace=pending.trace,
+            )
             return True
         return False
 
@@ -808,6 +846,7 @@ class ScoringService:
         future: "Future[ScoreResponse]",
         reason: str,
         role: str = "stable",
+        trace: Optional[dict] = None,
     ) -> None:
         response = self._fallback_response(request)
         response.role = role
@@ -819,6 +858,15 @@ class ScoringService:
                 self._served_by["fallback"] += 1
                 self._served_from["fallback"] += 1
                 self._role_stats[role]["answered"] += 1
+            if trace:
+                # the degradation ladder's floor, as a timeline marker: a
+                # traced request answered inline by the host-side scorer shows
+                # WHERE on its timeline it left the primary path, and why
+                self.tracer.add_span(
+                    "fallback", self.tracer.now(), 0.0,
+                    trace_id=trace.get("trace_id"), served_by="fallback",
+                    reason=reason,
+                )
             self._emit_throttled(
                 f"degrade:fallback:{reason}",
                 "on_degrade",
@@ -862,6 +910,7 @@ class ScoringService:
         state: UserState,
         served_from: str,
         role: str = "stable",
+        trace: Optional[dict] = None,
     ) -> Tuple[Hashable, PendingRequest]:
         length_bucket = self.engine.route_length(state.length)
         pending = PendingRequest(
@@ -874,6 +923,7 @@ class ScoringService:
             enqueued_at=self.tracer.now(),
             extra=(state,),
             role=role,
+            trace=trace,
         )
         return ("encode", length_bucket, role), pending
 
@@ -1008,6 +1058,27 @@ class ScoringService:
             return
         self._score_hit_batch(lane, role, gen, current, expired, abandoned)
 
+    def _trace_args(self, item: PendingRequest) -> dict:
+        """Span args keying a per-request span to its distributed trace.
+
+        Empty (and allocation-free for the common case) when the request
+        arrived untraced — the span renders as before; with a fleet-forwarded
+        trace context the replica-side span joins the request's timeline."""
+        if item.trace is None:
+            return {}
+        return {"trace_id": item.trace.get("trace_id"), "served_by": item.served_by}
+
+    def _batch_trace_ids(self, items: List[PendingRequest]) -> dict:
+        """Span args for a BATCH-scoped span (build/score/retrieve/rerank):
+        every traced co-rider's trace_id, as one ``trace_ids`` list — the
+        whole batch window is attributed to each traced request riding it."""
+        traced = [
+            item.trace["trace_id"]
+            for item in items
+            if item.trace is not None and "trace_id" in item.trace
+        ]
+        return {"trace_ids": traced} if traced else {}
+
     def _score_hit_batch(
         self,
         lane,
@@ -1018,21 +1089,25 @@ class ScoringService:
         abandoned: int,
     ) -> None:
         waits = [
-            lifecycle_span(self.tracer, "queue_wait", item.enqueued_at, lane=self._lane_name(lane))
+            lifecycle_span(
+                self.tracer, "queue_wait", item.enqueued_at,
+                lane=self._lane_name(lane), **self._trace_args(item),
+            )
             for item in items
         ]
         rows = len(items)
+        batch_trace = self._batch_trace_ids(items)
         engine = gen.engine if gen.engine is not None else self.engine
         bucket = engine.batch_bucket(rows)
-        with self.tracer.span("batch_build", rows=rows):
+        with self.tracer.span("batch_build", rows=rows, **batch_trace):
             hidden = np.stack([item.embedding for item in items]).astype(np.float32)
         if self.mode == "retrieval":
             engine.record_ranked_batch(rows, bucket)
             pipeline = gen.pipeline if gen.pipeline is not None else self.retrieval
-            scores, ids = self._rank(pipeline, hidden, rows, bucket)
+            scores, ids = self._rank(pipeline, hidden, rows, bucket, batch_trace)
             logits = None
         else:
-            with self.tracer.span("score", rows=rows, lane="hit"):
+            with self.tracer.span("score", rows=rows, lane="hit", **batch_trace):
                 logits = np.asarray(engine.score_hidden(hidden, params=gen.params))
             scores = ids = None
         self._resolve_batch_futures(
@@ -1047,17 +1122,21 @@ class ScoringService:
                 self._emit_batch(lane, 0, 0, [], expired, abandoned)
             return
         waits = [
-            lifecycle_span(self.tracer, "queue_wait", item.enqueued_at, lane=self._lane_name(lane))
+            lifecycle_span(
+                self.tracer, "queue_wait", item.enqueued_at,
+                lane=self._lane_name(lane), **self._trace_args(item),
+            )
             for item in items
         ]
         rows = len(items)
+        batch_trace = self._batch_trace_ids(items)
         _, length_bucket, _ = lane
         engine = gen.engine if gen.engine is not None else self.engine
         bucket = engine.batch_bucket(rows)
-        with self.tracer.span("batch_build", rows=rows):
+        with self.tracer.span("batch_build", rows=rows, **batch_trace):
             ids_batch = np.stack([item.window[-length_bucket:] for item in items])
             mask_batch = np.stack([item.mask[-length_bucket:] for item in items])
-        with self.tracer.span("score", rows=rows, lane=self._lane_name(lane)):
+        with self.tracer.span("score", rows=rows, lane=self._lane_name(lane), **batch_trace):
             # the breaker's raw material: one engine call = one outcome
             # (a batch-wide exception counts once, not once per rider)
             try:
@@ -1077,7 +1156,7 @@ class ScoringService:
             )
         if self.mode == "retrieval":
             pipeline = gen.pipeline if gen.pipeline is not None else self.retrieval
-            scores, ids = self._rank(pipeline, hidden_np, rows, bucket)
+            scores, ids = self._rank(pipeline, hidden_np, rows, bucket, batch_trace)
         else:
             scores = ids = None
         self._resolve_batch_futures(
@@ -1098,7 +1177,8 @@ class ScoringService:
             return
         try:
             resolved = self._encode_or_degrade(
-                item.request, item.future, state, "advance", state, role
+                item.request, item.future, state, "advance", state, role,
+                trace=item.trace,
             )
         except CircuitOpen as exc:
             with self._count_lock:
@@ -1183,13 +1263,20 @@ class ScoringService:
             },
         )
 
-    def _rank(self, pipeline: CandidatePipeline, hidden: np.ndarray, rows: int, bucket: int):
+    def _rank(
+        self,
+        pipeline: CandidatePipeline,
+        hidden: np.ndarray,
+        rows: int,
+        bucket: int,
+        span_args: Optional[dict] = None,
+    ):
         """Run the fused retrieve→rerank path at the padded batch bucket —
         the pipeline's jitted programs then only ever see the bucket ladder's
         shapes (no per-fill retrace)."""
         if rows < bucket:
             hidden = np.concatenate([hidden, np.repeat(hidden[:1], bucket - rows, 0)])
-        scores, ids = pipeline.rank(hidden, tracer=self.tracer)
+        scores, ids = pipeline.rank(hidden, tracer=self.tracer, span_args=span_args)
         return scores[:rows], ids[:rows]
 
     def _build_response(

@@ -261,6 +261,31 @@ class TestBackoffPolicy:
         # backoff already past the hint: backoff stands (capped)
         assert policy.delay(12, retry_after_s=0.01) == pytest.approx(1.0)
 
+    def test_hint_beyond_cap_is_exact_not_inflated(self):
+        """``retry_after_s > cap_s``: the delay is EXACTLY the hint — the cap
+        yields to the lane's drain estimate, but nothing may stretch the wait
+        past what the lane itself asked for."""
+        policy = BackoffPolicy(base_s=0.01, multiplier=2.0, cap_s=0.05)
+        assert policy.delay(0, retry_after_s=0.5) == pytest.approx(0.5)
+        # even with the backoff term saturated at the cap, the hint stands
+        assert policy.delay(10_000, retry_after_s=0.5) == pytest.approx(0.5)
+
+    def test_extreme_attempts_never_overflow(self):
+        """``multiplier**attempt`` past float range (2.0**1024 raises
+        OverflowError in raw float math) must come back as the cap, never as
+        an exception out of the retry scheduler — and ``exhausted`` must hold
+        at any magnitude."""
+        policy = BackoffPolicy(base_s=0.01, multiplier=2.0, cap_s=0.05, max_retries=3)
+        assert policy.delay(20_000) == pytest.approx(0.05)
+        assert policy.delay(2**40) == pytest.approx(0.05)
+        assert policy.exhausted(2**40)
+
+    def test_negative_attempt_clamps_to_base(self):
+        """A (buggy or wrapped) negative attempt behaves as attempt 0: the
+        first delay, not a sub-base or negative wait."""
+        policy = BackoffPolicy(base_s=0.01, multiplier=2.0, cap_s=0.05)
+        assert policy.delay(-3) == pytest.approx(0.01)
+
 
 # --------------------------------------------------------------------------- #
 # the fleet: routing, failover, hedging, retries, drain

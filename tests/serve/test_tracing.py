@@ -1,0 +1,446 @@
+"""End-to-end distributed request tracing: context propagation, the merged
+multi-track trace, latency exemplars, and tail attribution.
+
+Host-only (fake replicas, the ``test_router.py`` strategy): the tentpole's
+claims live here — a ``TraceContext`` minted at fleet admission rides every
+hop as pure JSON, the router's spans and each replica's spans merge into ONE
+Chrome trace where a hedged request's spans share a trace_id across tracks,
+the latency histogram keeps bounded slowest-N exemplar trace ids, and
+``obs.report`` decomposes the tail into per-hop fractions summing to 1.0
+(with ``--compare`` gating shifts in the p99 hop mix). The off switch is a
+contract too: tracing disabled must inject no kwarg and allocate no context.
+"""
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from replay_tpu.obs import (
+    MetricsLogger,
+    REQUEST_HOP_SPANS,
+    TraceContext,
+    Tracer,
+    TrainerEvent,
+    lifecycle_span,
+    merge_traces,
+    tail_attribution,
+)
+from replay_tpu.obs.report import compare_runs, load_trace, load_trace_events, render
+from replay_tpu.serve import BackoffPolicy, RequestShed, ServingFleet
+from replay_tpu.serve.request import ScoreResponse
+
+pytestmark = pytest.mark.core
+
+
+class TracedFakeService:
+    """A replica stand-in honoring the tracing contract: accepts the
+    ``_trace`` kwarg and records its ``queue_wait`` span (cross-thread, via
+    :func:`lifecycle_span`) keyed by the forwarded trace_id — the way the
+    real ``ScoringService`` dispatch path does."""
+
+    def __init__(self, name, delay_s=0.0, shed_first=0, tracer=None):
+        self.name = name
+        self.delay_s = delay_s
+        self.shed_remaining = shed_first
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.alive = True
+        self.submits = 0
+        self.submitted_kwargs = []
+        self.closed = False
+
+    def start(self):
+        return self
+
+    def close(self):
+        self.closed = True
+        self.alive = False
+
+    def heartbeat(self):
+        if not self.alive:
+            raise RuntimeError(f"{self.name} is down")
+        return {
+            "live": True,
+            "queued": 0,
+            "max_depth": 16,
+            "breaker_state": "closed",
+            "requests": self.submits,
+            "errors": 0,
+        }
+
+    def stats(self):
+        return {"submits": self.submits}
+
+    def submit(self, user_id, _trace=None, **kwargs):
+        self.submits += 1
+        self.submitted_kwargs.append({"_trace": _trace, **kwargs})
+        future = Future()
+        if self.shed_remaining > 0:
+            self.shed_remaining -= 1
+            future.set_exception(
+                RequestShed(("encode", 1), 16, 16, retry_after_s=0.005)
+            )
+            return future
+        enqueued_at = self.tracer.now()
+
+        def resolve():
+            if _trace is not None:
+                lifecycle_span(
+                    self.tracer, "queue_wait", enqueued_at,
+                    trace_id=_trace.get("trace_id"), lane="hit",
+                )
+            if future.set_running_or_notify_cancel():
+                future.set_result(
+                    ScoreResponse(
+                        user_id=user_id,
+                        scores=np.zeros(3),
+                        item_ids=None,
+                        served_from="hit",
+                        lane="hit",
+                        queue_wait_s=0.0,
+                    )
+                )
+
+        if self.delay_s:
+            timer = threading.Timer(self.delay_s, resolve)
+            timer.daemon = True
+            timer.start()
+        else:
+            resolve()
+        return future
+
+
+def _traced_fleet(replicas, **kwargs):
+    """A fleet with the full tracing plane on: router tracer + one live
+    tracer per replica, plus the label->tracer map for merge_traces."""
+    router_tracer = Tracer(enabled=True)
+    tracers = {name: Tracer(enabled=True) for name in replicas}
+    services = {
+        name: TracedFakeService(name, tracer=tracers[name], **replicas[name])
+        for name in replicas
+    }
+    kwargs.setdefault("heartbeat_interval_s", None)
+    kwargs.setdefault("hedge_ms", 0)
+    fleet = ServingFleet(services, tracer=router_tracer, **kwargs)
+    return fleet, services, {"router": router_tracer, **tracers}
+
+
+class TestTraceContext:
+    def test_mint_child_and_json_round_trip(self):
+        context = TraceContext.mint()
+        assert context.trace_id.startswith("t-")
+        assert context.parent_span is None
+        child = context.child("route")
+        assert child.trace_id == context.trace_id
+        assert child.parent_span == "route"
+        payload = child.to_json()
+        # the socket-boundary contract: plain JSON strings, nothing richer
+        assert json.loads(json.dumps(payload)) == payload
+        restored = TraceContext.from_json(payload)
+        assert restored.trace_id == context.trace_id
+        assert restored.parent_span == "route"
+        assert TraceContext.from_json(None) is None
+        assert TraceContext.from_json({}) is None
+
+    def test_minted_ids_are_unique(self):
+        ids = {TraceContext.mint().trace_id for _ in range(500)}
+        assert len(ids) == 500
+
+
+class TestFleetPropagation:
+    def test_trace_rides_every_hop_and_stamps_the_response(self):
+        fleet, services, _ = _traced_fleet({"a": {}, "b": {}})
+        with fleet:
+            response = fleet.score(7, timeout=5)
+        assert response.trace_id is not None
+        home = services[response.replica]
+        forwarded = home.submitted_kwargs[-1]["_trace"]
+        assert forwarded["trace_id"] == response.trace_id
+        assert forwarded["parent_span"] == "route"
+
+    def test_router_records_route_and_request_root_spans(self):
+        fleet, _, tracers = _traced_fleet({"a": {}, "b": {}})
+        with fleet:
+            response = fleet.score(7, timeout=5)
+        summary = tracers["router"].summary()
+        assert summary["route"]["count"] == 1
+        assert summary["request"]["count"] == 1
+        events = tracers["router"].to_chrome_trace()["traceEvents"]
+        root = next(e for e in events if e["name"] == "request")
+        assert root["args"]["trace_id"] == response.trace_id
+        assert root["args"]["served_by"] == "primary"
+        # the root spans admission -> answer: it must cover the route hop
+        route = next(e for e in events if e["name"] == "route")
+        assert route["args"]["trace_id"] == response.trace_id
+        assert root["dur"] >= route["dur"]
+
+    def test_tracing_off_injects_nothing(self):
+        """The zero-allocation contract: no tracer => no context minted, no
+        ``_trace`` kwarg injected (duck-typed replicas without the parameter
+        keep working), no trace_id on the response."""
+        services = {"a": TracedFakeService("a"), "b": TracedFakeService("b")}
+        fleet = ServingFleet(services, heartbeat_interval_s=None, hedge_ms=0)
+        with fleet:
+            response = fleet.score(7, timeout=5)
+        assert response.trace_id is None
+        assert not fleet.tracer.enabled
+        for service in services.values():
+            for kwargs in service.submitted_kwargs:
+                assert kwargs["_trace"] is None
+        assert fleet.stats()["latency_exemplars"] == []
+
+    def test_retry_records_backoff_wait_on_the_timeline(self):
+        fleet, _, tracers = _traced_fleet(
+            {"s": {"shed_first": 1}},
+            backoff=BackoffPolicy(base_s=0.001, max_retries=2),
+        )
+        with fleet:
+            response = fleet.score(1, timeout=5)
+        events = tracers["router"].to_chrome_trace()["traceEvents"]
+        backoff = next(e for e in events if e["name"] == "backoff_wait")
+        assert backoff["args"]["trace_id"] == response.trace_id
+        assert backoff["args"]["error"] == "RequestShed"
+        assert backoff["dur"] > 0
+
+
+class TestHedgedTimeline:
+    def test_hedged_request_spans_share_one_trace_id_across_tracks(self):
+        """The tentpole's acceptance render: one hedged request = router
+        ``hedge_wait`` + BOTH replicas' ``queue_wait`` spans, all carrying
+        the same trace_id, landing on different pids in the merged trace."""
+        fleet, services, tracers = _traced_fleet(
+            {"slow": {"delay_s": 0.5}, "b": {}, "c": {}}, hedge_ms=25
+        )
+        with fleet:
+            user = next(u for u in range(200) if fleet.ring.route(u) == "slow")
+            response = fleet.score(user, timeout=5)
+        assert response.replica != "slow"
+        merged = merge_traces(tracers)
+        by_pid = {}
+        for event in merged["traceEvents"]:
+            if response.trace_id in (
+                [event.get("args", {}).get("trace_id")]
+                + list(event.get("args", {}).get("trace_ids") or [])
+            ):
+                by_pid.setdefault(event["pid"], []).append(event["name"])
+        # router track + the winning replica (the slow loser was cancelled
+        # before resolving, so its queue_wait span may never record)
+        assert len(by_pid) >= 2, by_pid
+        router_pid = merged["otherData"]["tracks"]["router"]
+        assert "hedge_wait" in by_pid[router_pid]
+        assert "request" in by_pid[router_pid]
+        winner_pid = merged["otherData"]["tracks"][response.replica]
+        assert "queue_wait" in by_pid[winner_pid]
+        stats = fleet.stats()
+        assert stats["per_replica"][response.replica]["hedge_wins"] == 1
+        assert stats["per_replica"]["slow"]["hedge_cancelled"] == 1
+
+    def test_cross_thread_lifecycle_spans_survive_a_mid_span_cancel(self):
+        """Satellite hardening: the loser replica is cancelled while its
+        (timer-thread) lifecycle span is still open. Every recorded span must
+        still come out well-formed — non-negative durations, correct
+        per-thread attribution, loadable as a Chrome trace."""
+        fleet, services, tracers = _traced_fleet(
+            {"slow": {"delay_s": 0.2}, "b": {}}, hedge_ms=10
+        )
+        with fleet:
+            user = next(u for u in range(200) if fleet.ring.route(u) == "slow")
+            for _ in range(3):
+                fleet.score(user, timeout=5)
+        # let the slow loser's timers fire their (post-cancel) resolve
+        time.sleep(0.5)
+        merged = merge_traces(tracers)
+        tids = set()
+        for event in merged["traceEvents"]:
+            if event.get("ph") == "M":
+                continue
+            assert event["dur"] >= 0
+            assert event["ts"] >= 0
+            tids.add((event["pid"], event["tid"]))
+        # spans were recorded from more than one thread (client + timer)
+        assert len(tids) >= 2
+        # and the loser's queue_wait, when it DID record, kept its trace args
+        slow_pid = merged["otherData"]["tracks"]["slow"]
+        for event in merged["traceEvents"]:
+            if event["pid"] == slow_pid and event.get("ph") != "M":
+                assert event["name"] == "queue_wait"
+                assert event["args"]["trace_id"].startswith("t-")
+
+
+class TestExemplars:
+    def test_fleet_keeps_bounded_slowest_n_exemplars(self):
+        fleet, _, _ = _traced_fleet({"a": {}, "b": {}})
+        with fleet:
+            responses = [fleet.score(user, timeout=5) for user in range(20)]
+            stats = fleet.stats()
+        exemplars = stats["latency_exemplars"]
+        assert 0 < len(exemplars) <= 8
+        answered_ids = {r.trace_id for r in responses}
+        for record in exemplars:
+            assert record["trace_id"] in answered_ids
+            assert record["latency_ms"] >= 0
+        # slowest-first ordering
+        latencies = [record["latency_ms"] for record in exemplars]
+        assert latencies == sorted(latencies, reverse=True)
+
+    def test_histogram_exemplar_store_keeps_the_slowest(self):
+        from replay_tpu.obs.metrics import Histogram
+
+        histogram = Histogram(buckets=(1.0, 10.0, 100.0))
+        for i in range(50):
+            histogram.observe(float(i), exemplar=f"t-{i}")
+        kept = histogram.exemplars()
+        assert len(kept) == Histogram.EXEMPLAR_CAPACITY
+        assert [record["value"] for record in kept] == [
+            49.0, 48.0, 47.0, 46.0, 45.0, 44.0, 43.0, 42.0
+        ]
+        assert kept[0]["trace_id"] == "t-49"
+        assert histogram.sample()["exemplars"] == kept
+        # exemplar-free histograms pay (and expose) nothing
+        assert "exemplars" not in Histogram(buckets=(1.0,)).sample()
+
+    def test_metrics_bridge_surfaces_fleet_exemplars_on_snapshot(self):
+        bridge = MetricsLogger()
+        bridge.log_event(
+            TrainerEvent(
+                "on_fleet_end",
+                payload={
+                    "requests": 10,
+                    "latency_exemplars": [
+                        {"latency_ms": 120.5, "trace_id": "t-slow"},
+                        {"latency_ms": 80.0, "trace_id": "t-slower"},
+                    ],
+                },
+            )
+        )
+        snapshot = bridge.registry.snapshot()
+        series = snapshot["replay_fleet_latency_exemplar_ms"]
+        assert series["count"] == 2
+        kept = {record["trace_id"] for record in series["exemplars"]}
+        assert kept == {"t-slow", "t-slower"}
+
+
+class TestMergedTraceAndAttribution:
+    def test_merge_aligns_epochs_and_labels_tracks(self, tmp_path):
+        early, late = Tracer(enabled=True), Tracer(enabled=True)
+        early._wall0, late._wall0 = 100.0, 100.25  # late started 250 ms after
+        early.add_span("request", 0.0, 0.010, trace_id="t-x")
+        late.add_span("queue_wait", 0.0, 0.004, trace_id="t-x")
+        path = str(tmp_path / "trace.json")
+        merged = merge_traces({"router": early, "r0": late}, path)
+        assert merged["otherData"]["tracks"] == {"router": 1, "r0": 2}
+        names = {
+            (e["pid"], e["name"]): e
+            for e in merged["traceEvents"]
+            if e.get("ph") != "M"
+        }
+        # the late shard's events shifted onto the early epoch: +250 ms
+        assert names[(2, "queue_wait")]["ts"] == pytest.approx(250_000.0)
+        assert names[(1, "request")]["ts"] == pytest.approx(0.0)
+        meta = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+        assert {e["args"]["name"] for e in meta} == {"router", "r0"}
+        # the written file round-trips through the report loader, and the
+        # M events stay out of the name-level aggregation
+        aggregated = load_trace(path)
+        assert set(aggregated) == {"request", "queue_wait"}
+        assert len(load_trace_events(path)) == 4
+
+    def test_tail_attribution_fractions_sum_to_one(self):
+        tracer = Tracer(enabled=True)
+        # 99 fast requests: 10 ms total, 4 ms queue_wait + 4 ms score
+        for i in range(99):
+            tid = f"t-fast-{i}"
+            tracer.add_span("request", 0.0, 0.010, trace_id=tid)
+            tracer.add_span("queue_wait", 0.0, 0.004, trace_id=tid)
+            tracer.add_span("score", 0.004, 0.004, trace_ids=[tid])
+        # one disaster: 1 s total, 900 ms queue_wait
+        tracer.add_span("request", 0.0, 1.0, trace_id="t-slow")
+        tracer.add_span("queue_wait", 0.0, 0.9, trace_id="t-slow")
+        events = tracer.to_chrome_trace()["traceEvents"]
+        attribution = tail_attribution(events)
+        assert attribution["requests"] == 100
+        assert attribution["hops"] == list(REQUEST_HOP_SPANS) + ["other"]
+        for entry in attribution["quantiles"].values():
+            fractions = entry["fractions"]
+            assert sum(fractions.values()) == pytest.approx(1.0)
+            assert all(f >= 0.0 for f in fractions.values())
+        p99 = attribution["quantiles"]["p99"]
+        assert p99["n"] == 1
+        assert p99["latency_ms"] == pytest.approx(1000.0)
+        assert p99["fractions"]["queue_wait"] == pytest.approx(0.9)
+        p50 = attribution["quantiles"]["p50"]
+        # the median mix is dominated by the fast requests' 40/40/20 split
+        assert p50["fractions"]["queue_wait"] < 0.5
+
+    def test_tail_attribution_none_without_traced_roots(self):
+        tracer = Tracer(enabled=True)
+        tracer.add_span("train_step", 0.0, 0.01)  # a training trace
+        assert tail_attribution(tracer.to_chrome_trace()["traceEvents"]) is None
+        assert tail_attribution([]) is None
+
+    def test_overlapping_hops_renormalize_within_the_root(self):
+        """A hedged request's hop seconds can exceed its root window (two
+        replicas racing): the per-request fractions must still sum to 1.0."""
+        tracer = Tracer(enabled=True)
+        tracer.add_span("request", 0.0, 0.010, trace_id="t-h")
+        tracer.add_span("queue_wait", 0.0, 0.009, trace_id="t-h")  # primary
+        tracer.add_span("queue_wait", 0.002, 0.008, trace_id="t-h")  # twin
+        attribution = tail_attribution(tracer.to_chrome_trace()["traceEvents"])
+        fractions = attribution["quantiles"]["p99"]["fractions"]
+        assert sum(fractions.values()) == pytest.approx(1.0)
+        assert fractions["queue_wait"] == pytest.approx(1.0)
+
+
+class TestCompareGate:
+    @staticmethod
+    def _summary(queue_share):
+        score_share = max(0.9 - queue_share, 0.0)
+        return {
+            "source": "x",
+            "tail_attribution": {
+                "requests": 100,
+                "hops": ["queue_wait", "score", "other"],
+                "quantiles": {
+                    "p99": {
+                        "latency_ms": 50.0,
+                        "n": 1,
+                        "fractions": {
+                            "queue_wait": queue_share,
+                            "score": score_share,
+                            "other": 0.1,
+                        },
+                    }
+                },
+            },
+        }
+
+    def test_p99_hop_share_shift_gates_even_with_flat_p99(self):
+        lines, regressions = compare_runs(
+            self._summary(0.55), self._summary(0.30)
+        )
+        assert any("tail_p99_share/queue_wait" in r for r in regressions), (
+            lines, regressions,
+        )
+
+    def test_small_shift_is_surfaced_not_gated(self):
+        lines, regressions = compare_runs(
+            self._summary(0.35), self._summary(0.30)
+        )
+        assert not any("tail_p99_share" in r for r in regressions)
+        assert any("tail_p99_share/queue_wait" in line for line in lines)
+
+    def test_chaos_mismatch_suppresses_the_gate(self):
+        candidate = self._summary(0.55)
+        candidate["fleet"] = {"chaos": {"killed": "r1"}}
+        baseline = self._summary(0.30)
+        baseline["fleet"] = {}
+        _, regressions = compare_runs(candidate, baseline)
+        assert not any("tail_p99_share" in r for r in regressions)
+
+    def test_render_shows_tail_attribution(self):
+        text = render(self._summary(0.55))
+        assert "tail attribution" in text
+        assert "p99" in text and "queue_wait 55%" in text
