@@ -36,6 +36,14 @@ timed phases never trace):
   in ``served_by``), and the row records the failover gap (kill → first
   successful answer for a user homed on the victim), the reroute count, the
   bounded error rate and the zero-hung-requests invariant;
+* **socket chaos** (``SOCKET_CHAOS=1``, default on, alongside the in-process
+  phase) — the hard-kill upgrade: a fleet of replica server PROCESSES behind
+  real HTTP (``serve.remote``, portfile-handshaked ephemeral ports), one
+  ``SIGKILL``-ed mid-traffic — no close path, just dead sockets. Same
+  invariants, proven across a process boundary: zero hung requests, bounded
+  failover gap, taxonomy-only errors (``taxonomy_only``), death declared
+  from failed ``/healthz`` scrapes, and the victim respawned on a FRESH
+  port that the fleet picks up without a rebuild (``socket_chaos`` row);
 * **sharded retrieval** — the TP-sharded ``MIPSIndex`` (the CEFusedTP
   ``[I/n, E]`` row layout, int8 variant included): per-shard local top-k +
   candidate-only merge, checked bitwise against the unsharded search and
@@ -87,6 +95,8 @@ _DEFAULTS = {
     "SECONDS": 6,  # steady open-loop duration
     "CHAOS_SECONDS": 6,  # 0 = no chaos phase
     "SWAP": 1,  # 0 = no drain-and-swap phase
+    "SOCKET_CHAOS": 1,  # 0 = no socket-boundary SIGKILL chaos phase
+    "SOCKET_REPLICAS": 3,  # server PROCESSES in the socket-chaos fleet
     "CACHE": 4096,  # per-service UserStateCache capacity (fleet AND baseline)
     "SHARD_ITEMS": 262_144,  # sharded-retrieval catalog (10_000_000 on TPU)
     "SHARD_DIM": 64,
@@ -99,6 +109,8 @@ def _knob(name: str) -> int:
 
 
 REPLICAS = max(_knob("REPLICAS"), 1)
+SOCKET_CHAOS = _knob("SOCKET_CHAOS")
+SOCKET_REPLICAS = max(_knob("SOCKET_REPLICAS"), 2)
 SEQ_LEN = _knob("SEQ_LEN")
 NUM_ITEMS = _knob("NUM_ITEMS")
 EMBEDDING_DIM = _knob("EMBEDDING_DIM")
@@ -470,6 +482,143 @@ def _run_chaos(fleet, traffic, victim: str, seconds: float):
     }
 
 
+def _run_socket_chaos(seconds: float):
+    """The process-real chaos phase: a fleet of replica server PROCESSES
+    behind real HTTP (``serve.remote``), one SIGKILLed mid-traffic.
+
+    The in-process ``_run_chaos`` kills a replica by closing it — a polite
+    death that resolves its own futures. This one sends ``SIGKILL`` to a
+    server process: no handler, no close path, just connection-refused
+    sockets. The claims upgrade accordingly: the router's only signals are
+    transport errors (surfaced as the retryable ``ServiceClosed``) and
+    failed ``/healthz`` scrapes, and STILL — zero hung requests, a bounded
+    failover gap, taxonomy-only errors, and a respawned server on a fresh
+    ephemeral port picked up without rebuilding the fleet.
+
+    Servers run tiny fixed shapes on clean CPU (never the TPU grant): this
+    phase measures the socket boundary, not the model.
+    """
+    from replay_tpu.parallel import clean_cpu_env
+    from replay_tpu.serve import RemoteReplica, ReplicaServerProcess, ServingFleet
+    from replay_tpu.utils import KillAtStep
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = clean_cpu_env(local_devices=1, repo_root=repo_root)
+    spawn_start = time.perf_counter()
+    servers = [
+        ReplicaServerProcess(
+            env=env,
+            args=[
+                "--num-items", "64", "--seq-len", "12",
+                "--embedding-dim", "8", "--num-blocks", "1",
+            ],
+        )
+        for _ in range(SOCKET_REPLICAS)
+    ]
+    try:
+        for server in servers:  # engines compile concurrently
+            server.spawn(wait=False)
+        for server in servers:
+            server.wait_ready()
+        spawn_seconds = time.perf_counter() - spawn_start
+
+        replicas = {f"s{i}": RemoteReplica(server) for i, server in enumerate(servers)}
+        fleet = ServingFleet(
+            replicas,
+            hedge_ms=HEDGE_MS,
+            heartbeat_interval_s=HEARTBEAT_S,
+            heartbeat_misses=3,
+        )
+        traffic = Traffic(10_000, 64, 12)
+        victim = "s1"
+        victim_server = servers[1]
+        with fleet:
+            futures_box = {}
+            done = threading.Event()
+
+            def generator():
+                record, futures = _run_open_loop(
+                    fleet, traffic, min(RATE, 100), seconds, seed=53
+                )
+                futures_box["record"] = record
+                done.set()
+
+            thread = threading.Thread(target=generator, daemon=True)
+            thread.start()
+
+            time.sleep(seconds / 3.0)
+            probe_user = next(
+                user for user in range(traffic.population)
+                if fleet.ring.route(user) == victim
+            )
+            traffic.history_for(probe_user)
+            try:
+                fleet.score(probe_user, history=traffic.history_for(probe_user))
+            except Exception:  # noqa: BLE001 — seeding is best-effort
+                pass
+
+            kill_at = time.perf_counter()
+            KillAtStep(pid=victim_server.pid).fire()
+            sigkill_rc = victim_server.proc.wait(timeout=10)
+
+            failover_gap_ms = None
+            failover_replica = None
+            probe_rng = np.random.default_rng(59)
+            probe_deadline = time.perf_counter() + max(10.0, seconds)
+            while time.perf_counter() < probe_deadline:
+                try:
+                    response = traffic.submit_one(
+                        fleet, probe_rng, user=probe_user
+                    ).result(timeout=5.0)
+                except Exception:  # noqa: BLE001 — the gap IS these failures
+                    time.sleep(0.01)
+                    continue
+                failover_gap_ms = (time.perf_counter() - kill_at) * 1000.0
+                failover_replica = response.replica
+                break
+
+            time.sleep(max(seconds * 2.0 / 3.0 - (time.perf_counter() - kill_at), 0.0))
+            dead_observed = fleet.health().get(victim)
+            old_address = replicas[victim].address
+            victim_server.respawn()
+            address_changed = replicas[victim].address != old_address
+            revive_deadline = time.perf_counter() + max(5.0, 30 * HEARTBEAT_S)
+            revived = False
+            while time.perf_counter() < revive_deadline:
+                if fleet.health().get(victim) == "healthy":
+                    revived = True
+                    break
+                time.sleep(HEARTBEAT_S)
+            done.wait(timeout=seconds + 120.0)
+            record = futures_box.get("record", {})
+        errors_by_kind = record.get("errors_by_kind") or {}
+        return {
+            "replicas": SOCKET_REPLICAS,
+            "killed": victim,
+            "sigkill_rc": sigkill_rc,
+            "dead_observed": dead_observed,
+            "revived": revived,
+            "respawned_address_changed": address_changed,
+            "failover_gap_ms": (
+                round(failover_gap_ms, 1) if failover_gap_ms is not None else None
+            ),
+            "failover_replica": failover_replica,
+            "submitted": record.get("submitted"),
+            "answered": record.get("answered"),
+            "hung_requests": record.get("hung_requests"),
+            "error_rate": record.get("error_rate"),
+            "errors_by_kind": errors_by_kind,
+            # a SIGKILLed process produces ONLY taxonomy refusals through the
+            # socket client — raw transport garbage would land under "error"
+            "taxonomy_only": errors_by_kind.get("error", 0) == 0,
+            "p99_ms": record.get("p99_ms"),
+            "spawn_seconds": round(spawn_seconds, 2),
+        }
+    finally:
+        for server in servers:
+            server.terminate()
+
+
 def _run_drain_swap(fleet, traffic, params, clients: int):
     """Fleet-wide drain-and-swap rollout under closed-loop load: every
     replica drained → hot-swapped (pointer move) → rejoined while clients
@@ -749,6 +898,11 @@ def main() -> None:
                 "retries": router_view["retries"],
             }
 
+    # ---- socket-boundary chaos: SIGKILL a real server PROCESS ----------- #
+    socket_chaos = None
+    if SOCKET_CHAOS and CHAOS_SECONDS > 0:
+        socket_chaos = _run_socket_chaos(float(CHAOS_SECONDS))
+
     # ONE merged trace for the whole run: the router's track plus every
     # replica's, epoch-aligned — a hedged-and-failed-over request's spans
     # share a trace_id across tracks and render as one connected timeline
@@ -821,6 +975,8 @@ def main() -> None:
         record["drain_swap"] = drain_swap
     if chaos is not None:
         record["chaos"] = chaos
+    if socket_chaos is not None:
+        record["socket_chaos"] = socket_chaos
     if SHAPE_OVERRIDE:
         record["shape_override"] = {
             "replicas": REPLICAS,

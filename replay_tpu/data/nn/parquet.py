@@ -36,14 +36,17 @@ v}}`` marks list columns; scalar columns need no entry.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from replay_tpu.data.nn.partitioning import Partitioning
+from replay_tpu.data.nn.partitioning import Partitioning, ReplicasInfo
 from replay_tpu.native import gather_pad, gather_pad_2d
+
+logger = logging.getLogger("replay_tpu")
 
 Batch = Dict[str, np.ndarray]
 
@@ -86,7 +89,14 @@ class StreamCursor:
     # slab sequence is only meaningful under the SAME plan — restoring a
     # cursor under a changed replica count / seed would silently re-train
     # consumed row groups and skip unseen ones, so mismatches fail loudly
+    # (an INTENDED replica-count change goes through :meth:`rehash` instead)
     plan: Optional[Dict[str, Any]] = None
+    # elastic-resume marker (:meth:`rehash`): ``{"old_plan": ..., "batches":
+    # B}`` — the pre-migration plan and the globally aligned batch ordinal the
+    # migration starts from. Set on a rehashed cursor and on every cursor
+    # recorded while iterating the migrated epoch; the batcher rebuilds the
+    # migration work list from it deterministically on every restore.
+    migration: Optional[Dict[str, Any]] = None
 
     def to_metadata(self) -> Dict[str, Any]:
         """Pure-JSON form (the checkpoint sidecar is a JSON document)."""
@@ -98,6 +108,7 @@ class StreamCursor:
             "carry": self.carry,
             "pad_spec": self.pad_spec,
             "plan": self.plan,
+            "migration": self.migration,
         }
 
     @classmethod
@@ -110,6 +121,56 @@ class StreamCursor:
             carry=record.get("carry"),
             pad_spec=record.get("pad_spec"),
             plan=record.get("plan"),
+            migration=record.get("migration"),
+        )
+
+    def rehash(self, new_replica_count: int) -> "StreamCursor":
+        """Migrate this mid-epoch position onto ``new_replica_count`` replicas.
+
+        The elastic-resume entrypoint: where :meth:`ParquetBatcher.
+        restore_cursor` REFUSES a changed replica layout (restoring a
+        one-replica slab sequence on a different layout would silently replay
+        consumed row groups and skip unseen ones), a rehashed cursor is a
+        sanctioned, loudly-logged migration. It works because the stream's
+        step-alignment invariant makes every replica's position at a global
+        checkpoint arithmetically recomputable: all replicas sit at the same
+        batch ordinal ``B``, so old replica *r* has consumed exactly
+        ``min(B * batch_size, its_total_rows)`` rows of its deterministic
+        (plan-replayable) slab stream. The batcher rebuilds every old
+        replica's remainder from footer metadata alone, pools the remaining
+        (sub-)slabs — with a skip offset on the one partially consumed slab
+        per old replica — and deals them round-robin to the new layout, with
+        an exactly-once coverage audit (consumed rows never re-emitted,
+        unseen rows all assigned — :meth:`ParquetBatcher.migration_coverage`).
+
+        Every NEW replica restores the SAME rehashed cursor (it is
+        replica-id-agnostic); each batcher then takes its own share of the
+        migration work list. Chained rehashes are refused — finish (or
+        restart) the migrated epoch first.
+        """
+        new = int(new_replica_count)
+        if new < 1:
+            msg = f"new_replica_count must be >= 1, got {new}"
+            raise ValueError(msg)
+        if self.migration is not None:
+            msg = (
+                "cursor already carries a migration (rehash-of-rehash): finish "
+                "the migrated epoch (or restart it) before rehashing again"
+            )
+            raise ValueError(msg)
+        if self.plan is None:
+            msg = "cursor carries no plan fingerprint; cannot rehash"
+            raise ValueError(msg)
+        return StreamCursor(
+            epoch=self.epoch,
+            slab=0,
+            rows=0,
+            batches=self.batches,
+            carry=None,  # positions are recomputed arithmetically from B
+            pad_spec=self.pad_spec,
+            # replica_id None = any replica of the new layout may restore this
+            plan={**self.plan, "num_replicas": new, "replica_id": None},
+            migration={"old_plan": dict(self.plan), "batches": int(self.batches)},
         )
 
 
@@ -260,13 +321,47 @@ class ParquetBatcher:
             raise ValueError(msg)
         if isinstance(cursor, dict):
             cursor = StreamCursor.from_metadata(cursor)
-        if cursor.plan is not None and cursor.plan != self._plan_signature():
+        signature = self._plan_signature()
+        if cursor.migration is not None:
+            # elastic resume (StreamCursor.rehash): the plan must match on
+            # everything EXCEPT replica identity — a fresh rehashed cursor is
+            # replica-id-agnostic (replica_id None), a cursor recorded DURING
+            # a migrated epoch pins the replica it was recorded on
+            ignore = (
+                ("replica_id",)
+                if (cursor.plan or {}).get("replica_id") is None
+                else ()
+            )
+            theirs = {k: v for k, v in (cursor.plan or {}).items() if k not in ignore}
+            mine = {k: v for k, v in signature.items() if k not in ignore}
+            if theirs != mine:
+                msg = (
+                    "rehashed stream cursor targets a different plan "
+                    f"(cursor {cursor.plan} vs batcher {signature}): rehash "
+                    "changes ONLY the replica count — seed, shuffle, batch "
+                    "size and memory budget must match the recording run, and "
+                    "the batcher's replica layout must match the rehash target."
+                )
+                raise ValueError(msg)
+            old_plan = cursor.migration.get("old_plan") or {}
+            logger.warning(
+                "elastic resume: migrating row-group plan from %s to %s "
+                "replicas at batch ordinal %s (epoch %s); consumed groups are "
+                "never re-emitted, unseen groups are re-dealt round-robin "
+                "(coverage audited when iteration starts)",
+                old_plan.get("num_replicas"),
+                signature["num_replicas"],
+                cursor.migration.get("batches"),
+                cursor.epoch,
+            )
+        elif cursor.plan is not None and cursor.plan != signature:
             msg = (
                 "stream cursor was recorded under a different epoch plan "
-                f"(cursor {cursor.plan} vs batcher {self._plan_signature()}): "
+                f"(cursor {cursor.plan} vs batcher {signature}): "
                 "its slab sequence would replay/skip the wrong row groups. "
                 "Resume with the SAME replica layout, seed, shuffle and "
-                "batch size, or restart the epoch."
+                "batch size, restart the epoch, or — for an intended replica-"
+                "count change — migrate with StreamCursor.rehash(new_count)."
             )
             raise ValueError(msg)
         self._pending_cursor = cursor
@@ -422,32 +517,24 @@ class ParquetBatcher:
                 table.append((path, g, group.num_rows, group.total_byte_size))
         return table
 
-    def _plan(self, epoch: int):
-        """The epoch plan: THIS replica's slab sequence + the globally aligned
-        batch count. Pure function of (footer metadata, seed, epoch, replica)
-        — both sides of a preemption compute the identical plan."""
+    def _effective_partitioning(self) -> Partitioning:
         part = self.partitioning or Partitioning(shuffle=self.shuffle, seed=self.seed)
         if self.shuffle and not part.shuffle:
             part = Partitioning(part.replicas, shuffle=True, seed=self.seed)
-        groups = self._group_table()
-        replicas = part.replicas
-        if groups and len(groups) < replicas.num_replicas:
-            msg = (
-                f"shard='row_groups' needs at least one row group per replica: "
-                f"{len(groups)} group(s) for {replicas.num_replicas} replicas. "
-                "Write smaller row groups "
-                "(write_sequence_parquet(rows_per_chunk=...))."
-            )
-            raise ValueError(msg)
-        # alignment: every replica must emit the same number of batches (the
-        # collective-friendly invariant) — compute each replica's row total
-        # from the shared plan and pad the short ones with valid=False batches
-        max_batches = 0
-        for replica in range(replicas.num_replicas):
-            assigned = part.shard_items(len(groups), epoch=epoch, replica_id=replica)
-            rows = int(sum(groups[i][2] for i in assigned))
-            max_batches = max(max_batches, -(-rows // self.batch_size))
-        mine = part.shard_items(len(groups), epoch=epoch)
+        return part
+
+    def _slabs_for(
+        self,
+        groups: List[Tuple[str, int, int, int]],
+        part: Partitioning,
+        epoch: int,
+        replica_id: Optional[int] = None,
+    ) -> Tuple[List[_Slab], List[str]]:
+        """One replica's deterministic slab sequence under ``part`` — the
+        replayable half of the epoch plan, parameterized so an elastic
+        migration can reconstruct ANY replica's stream of ANY (old) layout
+        from footer metadata alone."""
+        mine = part.shard_items(len(groups), epoch=epoch, replica_id=replica_id)
         slabs: List[_Slab] = []
         paths: List[str] = []
         for seq, index in enumerate(mine):
@@ -478,7 +565,152 @@ class ParquetBatcher:
                 paths.append(path)  # slabs and paths zip by position
                 start += take
                 sub += 1
+        return slabs, paths
+
+    def _plan(self, epoch: int):
+        """The epoch plan: THIS replica's slab sequence + the globally aligned
+        batch count. Pure function of (footer metadata, seed, epoch, replica)
+        — both sides of a preemption compute the identical plan."""
+        part = self._effective_partitioning()
+        groups = self._group_table()
+        replicas = part.replicas
+        if groups and len(groups) < replicas.num_replicas:
+            msg = (
+                f"shard='row_groups' needs at least one row group per replica: "
+                f"{len(groups)} group(s) for {replicas.num_replicas} replicas. "
+                "Write smaller row groups "
+                "(write_sequence_parquet(rows_per_chunk=...))."
+            )
+            raise ValueError(msg)
+        # alignment: every replica must emit the same number of batches (the
+        # collective-friendly invariant) — compute each replica's row total
+        # from the shared plan and pad the short ones with valid=False batches
+        max_batches = 0
+        for replica in range(replicas.num_replicas):
+            assigned = part.shard_items(len(groups), epoch=epoch, replica_id=replica)
+            rows = int(sum(groups[i][2] for i in assigned))
+            max_batches = max(max_batches, -(-rows // self.batch_size))
+        slabs, paths = self._slabs_for(groups, part, epoch)
         return slabs, paths, max_batches
+
+    # -- elastic migration (StreamCursor.rehash) -------------------------- #
+    def _migration_work(
+        self, epoch: int, migration: Dict[str, Any]
+    ) -> Tuple[List[Tuple[_Slab, str, int]], Dict[str, Any]]:
+        """The GLOBAL migration work list + coverage audit.
+
+        Replays every OLD replica's deterministic slab stream (footer
+        metadata only, no data reads) and cuts it at the rows that replica
+        had consumed by the aligned batch ordinal ``B`` — ``min(B *
+        batch_size, its total rows)``, exact because rows are emitted in
+        stream order and short replicas pad with valid=False alignment
+        batches AFTER their data ends. The remainder — whole unread
+        (sub-)slabs plus at most one partially consumed slab per old replica,
+        carried with its skip offset into the slab's deterministic shuffled
+        order — is the work list, in a deterministic global order every new
+        replica computes identically.
+        """
+        old_plan = dict(migration["old_plan"])
+        batches = int(migration["batches"])
+        batch_size = int(old_plan["batch_size"])
+        groups = self._group_table()
+        old_part = Partitioning(
+            ReplicasInfo(int(old_plan["num_replicas"]), 0),
+            shuffle=bool(old_plan["shuffle"]),
+            seed=int(old_plan["seed"]),
+        )
+        work: List[Tuple[_Slab, str, int]] = []
+        total_rows = sum(g[2] for g in groups)
+        consumed_rows = 0
+        partial_slabs = 0
+        for replica in range(int(old_plan["num_replicas"])):
+            slabs_r, paths_r = self._slabs_for(groups, old_part, epoch, replica)
+            replica_rows = sum(s.rows for s in slabs_r)
+            consumed = min(batches * batch_size, replica_rows)
+            consumed_rows += consumed
+            acc = 0
+            for slab, path in zip(slabs_r, paths_r):
+                if acc + slab.rows <= consumed:
+                    acc += slab.rows  # fully consumed: never re-read
+                    continue
+                skip = max(0, consumed - acc)
+                if skip:
+                    partial_slabs += 1
+                work.append((slab, path, skip))
+                acc += slab.rows
+        assigned_rows = sum(slab.rows - skip for slab, _, skip in work)
+        audit = {
+            "total_rows": int(total_rows),
+            "consumed_rows": int(consumed_rows),
+            "assigned_rows": int(assigned_rows),
+            "work_slabs": len(work),
+            "partially_consumed_slabs": int(partial_slabs),
+            "old_replicas": int(old_plan["num_replicas"]),
+            "batches": batches,
+        }
+        if consumed_rows + assigned_rows != total_rows:
+            msg = (
+                "elastic migration coverage audit failed: consumed "
+                f"{consumed_rows} + assigned {assigned_rows} != total "
+                f"{total_rows} rows ({audit})"
+            )
+            raise RuntimeError(msg)
+        return work, audit
+
+    def migration_coverage(self, cursor) -> Dict[str, Any]:
+        """The exactly-once coverage audit of a rehashed cursor against THIS
+        batcher's layout: per-new-replica assigned row counts plus the global
+        consumed/assigned/total accounting (``consumed + assigned == total``
+        is hard-asserted — a failure means the migration would re-read or
+        drop rows). Pure footer arithmetic; reads no data."""
+        if isinstance(cursor, dict):
+            cursor = StreamCursor.from_metadata(cursor)
+        if cursor.migration is None:
+            msg = "migration_coverage needs a rehashed cursor (StreamCursor.rehash)"
+            raise ValueError(msg)
+        work, audit = self._migration_work(cursor.epoch, cursor.migration)
+        part = self._effective_partitioning()
+        per_replica: Dict[int, int] = {}
+        for replica in range(part.replicas.num_replicas):
+            share = part.shard_items(len(work), epoch=cursor.epoch, replica_id=replica)
+            per_replica[replica] = int(
+                sum(work[i][0].rows - work[i][2] for i in share)
+            )
+        audit["assigned_rows_per_replica"] = per_replica
+        audit["new_replicas"] = int(part.replicas.num_replicas)
+        if sum(per_replica.values()) != audit["assigned_rows"]:
+            msg = f"migration deal dropped/duplicated work items: {audit}"
+            raise RuntimeError(msg)
+        return audit
+
+    def _migration_plan(
+        self, epoch: int, migration: Dict[str, Any]
+    ) -> Tuple[List[Tuple[_Slab, str, int]], int, int]:
+        """THIS new replica's share of the migration work list, the batch
+        ordinal the migrated stream starts at, and the migrated epoch's
+        globally aligned total batch count."""
+        work, audit = self._migration_work(epoch, migration)
+        part = self._effective_partitioning()
+        base = int(migration["batches"])
+        remaining_max = 0
+        for replica in range(part.replicas.num_replicas):
+            share = part.shard_items(len(work), epoch=epoch, replica_id=replica)
+            rows = int(sum(work[i][0].rows - work[i][2] for i in share))
+            remaining_max = max(remaining_max, -(-rows // self.batch_size))
+        mine = part.shard_items(len(work), epoch=epoch)
+        items = [work[i] for i in mine]
+        logger.warning(
+            "elastic resume: migration plan for replica %s/%s — %s of %s "
+            "work slabs, %s rows to emit from ordinal %s (audit: %s)",
+            part.replicas.replica_id,
+            part.replicas.num_replicas,
+            len(items),
+            len(work),
+            sum(slab.rows - skip for slab, _, skip in items),
+            base,
+            audit,
+        )
+        return items, base, base + remaining_max
 
     def _read_slab(self, path: str, slab: _Slab):
         """One bounded read: the slab's row range of its row group.
@@ -566,11 +798,27 @@ class ParquetBatcher:
     def _iter_row_groups(self) -> Iterator[Batch]:
         """Shard-aware streaming: disjoint row-group shares per replica,
         bounded sub-slab reads, optional read-ahead, cursor recording, and
-        valid=False alignment batches so every replica steps the same count."""
+        valid=False alignment batches so every replica steps the same count.
+
+        Iterates WORK ITEMS — ``(slab, path, base_skip)`` triples. A normal
+        epoch's items are the replica's planned slabs with ``base_skip`` 0; a
+        migrated epoch's (:meth:`StreamCursor.rehash`) are this replica's
+        share of the global migration work list, where ``base_skip`` drops the
+        rows an OLD replica already emitted from a partially consumed slab's
+        deterministic order. Cursor ``slab``/``rows`` index the item list and
+        the post-``base_skip`` stream, so mid-epoch resume works identically
+        in both modes.
+        """
         epoch = self.epoch
-        slabs, paths, max_batches = self._plan(epoch)
         start_cursor, self._pending_cursor = self._pending_cursor, None
-        first_slab, skip_rows, emitted = 0, 0, 0
+        migration = start_cursor.migration if start_cursor is not None else None
+        if migration is not None:
+            items, base_emitted, max_batches = self._migration_plan(epoch, migration)
+        else:
+            slabs, paths, max_batches = self._plan(epoch)
+            items = [(slab, path, 0) for slab, path in zip(slabs, paths)]
+            base_emitted = 0
+        first_item, skip_rows, emitted = 0, 0, base_emitted
         carry: Optional[Batch] = None
         pad_spec: Optional[Dict[str, Any]] = None
         if start_cursor is not None:
@@ -580,7 +828,7 @@ class ParquetBatcher:
                     f"batcher is at epoch {epoch}; call set_epoch first"
                 )
                 raise ValueError(msg)
-            first_slab = start_cursor.slab
+            first_item = start_cursor.slab
             skip_rows = start_cursor.rows
             emitted = start_cursor.batches
             carry = _deserialize_carry(start_cursor.carry)
@@ -588,17 +836,18 @@ class ParquetBatcher:
         self._record_cursor(
             StreamCursor(
                 epoch=epoch,
-                slab=first_slab,
+                slab=first_item,
                 rows=skip_rows,
                 batches=emitted,
                 carry=_serialize_carry(carry),
                 pad_spec=pad_spec,
+                migration=migration,
             )
         )
 
         def reads() -> Iterator[Tuple[int, Any]]:
-            for index in range(first_slab, len(slabs)):
-                yield index, self._read_slab(paths[index], slabs[index])
+            for index in range(first_item, len(items)):
+                yield index, self._read_slab(items[index][1], items[index][0])
 
         source: Iterator[Tuple[int, Any]] = reads()
         if self.read_ahead:
@@ -607,15 +856,17 @@ class ParquetBatcher:
             source = _prefetch(source, depth=self.read_ahead)
         try:
             for index, table in source:
-                slab = slabs[index]
+                slab, _, base_skip = items[index]
                 order = self._slab_order(slab, epoch)
                 block = self._materialize(table, order)
                 consumed = 0
-                if index == first_slab and skip_rows:
-                    # resume mid-slab: drop what the pre-preemption run already
-                    # emitted from this slab's deterministic order
-                    block = {k: v[skip_rows:] for k, v in block.items()}
-                    consumed = skip_rows
+                drop = base_skip + (skip_rows if index == first_item else 0)
+                if drop:
+                    # resume mid-slab (and/or migration skip): drop what an
+                    # earlier run already emitted from this slab's
+                    # deterministic order
+                    block = {k: v[drop:] for k, v in block.items()}
+                    consumed = skip_rows if index == first_item else 0
                 carry_before = carry
                 if carry_before is not None:
                     stream = {
@@ -648,6 +899,7 @@ class ParquetBatcher:
                             rows=consumed + start + self.batch_size - carry_rows,
                             batches=emitted,
                             pad_spec=pad_spec,
+                            migration=migration,
                         )
                     )
                     yield chunk
@@ -664,6 +916,7 @@ class ParquetBatcher:
                         batches=emitted,
                         carry=_serialize_carry(carry),
                         pad_spec=pad_spec,
+                        migration=migration,
                     )
                 )
         finally:
@@ -684,8 +937,8 @@ class ParquetBatcher:
             emitted += 1
             self._record_cursor(
                 StreamCursor(
-                    epoch=epoch, slab=len(slabs), rows=0, batches=emitted,
-                    pad_spec=pad_spec,
+                    epoch=epoch, slab=len(items), rows=0, batches=emitted,
+                    pad_spec=pad_spec, migration=migration,
                 )
             )
             yield chunk
@@ -705,8 +958,8 @@ class ParquetBatcher:
             emitted += 1
             self._record_cursor(
                 StreamCursor(
-                    epoch=epoch, slab=len(slabs), rows=0, batches=emitted,
-                    pad_spec=pad_spec,
+                    epoch=epoch, slab=len(items), rows=0, batches=emitted,
+                    pad_spec=pad_spec, migration=migration,
                 )
             )
             yield chunk

@@ -1574,7 +1574,11 @@ class Trainer:
                     # preemption contract extended to out-of-core runs):
                     # restore_cursor SEEKS to the exact mid-epoch state
                     # instead of re-reading and discarding skip_steps batches
-                    pending_stream_cursor = meta.get("stream_cursor")
+                    # (multi-host: each rank reads ITS per-process sidecar —
+                    # the shared one only carries process 0's cursor)
+                    pending_stream_cursor = checkpoint_manager.process_metadata(
+                        latest
+                    ).get("stream_cursor") or meta.get("stream_cursor")
                 elif "epoch" in meta:
                     start_epoch = int(meta["epoch"]) + 1
                 else:
@@ -1863,21 +1867,27 @@ class Trainer:
             extra: Dict[str, Any] = {"preempted": True} if preempted else {}
             if self._lr_scale != 1.0:  # recovery backoff survives the resume
                 extra["lr_scale"] = self._lr_scale
+            process_extra = None
             if cursor_source is not None:
                 # the streaming batcher's exact position after n_steps batches
                 # rides the sidecar, so resume SEEKS instead of rescanning;
                 # cursors are recorded at produce time, so a prefetch/device-
                 # feed stage reading ahead cannot outrun this lookup
                 try:
-                    extra["stream_cursor"] = cursor_source.cursor_for(
-                        n_steps
-                    ).to_metadata()
+                    cursor_meta = cursor_source.cursor_for(n_steps).to_metadata()
                 except KeyError as exc:
                     logger.warning(
                         "stream cursor unavailable at step %d (%s); resume "
                         "will fall back to fast-forwarding the stream",
                         n_steps, exc,
                     )
+                else:
+                    extra["stream_cursor"] = cursor_meta
+                    if jax.process_count() > 1:
+                        # the shared sidecar has one writer (process 0), but
+                        # every process streams its OWN disjoint shard: each
+                        # rank's cursor rides its private per-process sidecar
+                        process_extra = {"stream_cursor": cursor_meta}
             with span("checkpoint"):
                 checkpoint_manager.save(
                     int(state.step),
@@ -1889,6 +1899,7 @@ class Trainer:
                         "step_in_epoch": n_steps,
                         **extra,
                     },
+                    process_metadata=process_extra,
                 )
             emit("on_checkpoint", step=int(state.step), epoch=epoch,
                  mid_epoch=True, step_in_epoch=n_steps, **extra)

@@ -8,7 +8,16 @@ its own short-lived thread, so a slow scraper never blocks the next one):
   under the registry lock (no torn lines, counters monotone across scrapes);
 * ``GET /snapshot`` — the full registry as JSON, including histogram quantile
   estimates (the artifact CI uploads);
-* ``GET /healthz``  — liveness probe (``ok``).
+* ``GET /healthz``  — liveness probe. Plain ``ok`` by default (the shape
+  existing probes assert on); with ``?format=json`` or an
+  ``Accept: application/json`` header it returns the structured health
+  document a remote fleet monitor needs to drive ``ReplicaHealth`` from a
+  pure scrape — the live bit, lane depth vs the configured bound, breaker
+  state and the windowed error-rate inputs — produced by the
+  ``health_source`` callable (e.g. ``ScoringService.heartbeat``). Without a
+  source the JSON document is just ``{"live": true}``; a raising source
+  answers 503 with ``{"live": false, "error": ...}`` rather than hiding the
+  failure behind a happy 200.
 
 Failure posture: a metrics endpoint must never take down what it observes.
 A busy port (or any bind error) logs one warning and degrades the exporter
@@ -28,7 +37,7 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Callable, Dict, Optional
 
 from .metrics import MetricsRegistry
 
@@ -52,7 +61,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
         try:
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             if path in ("/metrics", "/"):
                 body = self.server.registry.render_prometheus().encode()
                 self._respond(200, PROMETHEUS_CONTENT_TYPE, body)
@@ -62,11 +71,30 @@ class _Handler(BaseHTTPRequestHandler):
                 ).encode()
                 self._respond(200, "application/json", body)
             elif path == "/healthz":
-                self._respond(200, "text/plain", b"ok\n")
+                wants_json = "format=json" in query or "application/json" in (
+                    self.headers.get("Accept") or ""
+                )
+                if wants_json:
+                    self._respond_health_json()
+                else:
+                    self._respond(200, "text/plain", b"ok\n")
             else:
                 self._respond(404, "text/plain", b"not found\n")
         except (BrokenPipeError, ConnectionResetError):
             pass  # the scraper hung up mid-response; nothing to salvage
+
+    def _respond_health_json(self) -> None:
+        source = self.server.health_source
+        try:
+            health: Dict[str, Any] = {"live": True}
+            if source is not None:
+                health = dict(source())
+        except Exception as exc:  # noqa: BLE001 — a broken source IS the signal
+            body = json.dumps({"live": False, "error": repr(exc)}).encode()
+            self._respond(503, "application/json", body)
+            return
+        body = json.dumps(health, default=str).encode()
+        self._respond(200, "application/json", body)
 
     def log_message(self, format: str, *args) -> None:  # noqa: A002
         pass  # scrape-cadence request lines must not spam the run's stderr
@@ -79,6 +107,7 @@ class _Server(ThreadingHTTPServer):
     # another LISTENING server holds still fails)
     allow_reuse_address = True
     registry: MetricsRegistry
+    health_source: Optional[Callable[[], Dict[str, Any]]]
 
 
 class MetricsExporter:
@@ -96,10 +125,12 @@ class MetricsExporter:
         registry: MetricsRegistry,
         port: int = 9100,
         host: str = "127.0.0.1",
+        health_source: Optional[Callable[[], Dict[str, Any]]] = None,
     ) -> None:
         self.registry = registry
         self.requested_port = int(port)
         self.host = host
+        self.health_source = health_source
         self._server: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -129,6 +160,7 @@ class MetricsExporter:
             )
             return self
         server.registry = self.registry
+        server.health_source = self.health_source
         self._server = server
         self._thread = threading.Thread(
             target=server.serve_forever,

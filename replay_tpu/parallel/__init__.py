@@ -1,4 +1,5 @@
 from .distributed import initialize_distributed, replicas_info
+from .launch import LaunchError, WorkerResult, clean_cpu_env, free_port, launch_workers
 from .introspect import (
     collective_bytes,
     collective_inventory,
@@ -20,12 +21,17 @@ from .sharding import (
 
 __all__ = [
     "LOGICAL_AXES",
+    "LaunchError",
     "ShardingRuleWarning",
     "ShardingRules",
+    "WorkerResult",
+    "clean_cpu_env",
     "collective_bytes",
     "collective_inventory",
+    "free_port",
     "full_attention_reference",
     "initialize_distributed",
+    "launch_workers",
     "logical_axes",
     "logical_axes_tree",
     "params_shardings",

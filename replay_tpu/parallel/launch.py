@@ -1,0 +1,217 @@
+"""Process-real worker launching for multi-host training on one machine.
+
+The rest of :mod:`replay_tpu.parallel` assumes ``jax.distributed`` has been
+initialized; this module starts the actual OS processes. One launcher call
+starts N python workers, each a real ``jax.distributed`` rank (gloo CPU
+collectives under tests; the same worker scripts run unchanged on TPU pods
+where the runtime provides the coordinator), and supervises them to
+completion:
+
+* **Coordinator handshake (no fixed ports):** the launcher binds an ephemeral
+  port for the jax.distributed coordinator and publishes it to every worker
+  via the standard env vars ``initialize_distributed`` already resolves
+  (``REPLAY_TPU_COORDINATOR`` / ``REPLAY_TPU_NUM_PROCESSES`` /
+  ``REPLAY_TPU_PROCESS_ID``) — two launchers on one host can never collide.
+  The same address is also passed as argv for workers that predate the env
+  contract.
+
+* **Peer-death supervision:** collectives hang forever when a peer dies —
+  a SIGKILLed rank leaves every survivor blocked inside gloo with no error.
+  The launcher polls; once any worker exits (cleanly or by signal), the
+  remaining workers get ``grace_s`` to finish on their own, then are
+  SIGKILLed and reported with ``reaped=True``. A chaos test therefore always
+  gets its processes back: the victim's real ``-SIGKILL`` returncode AND the
+  survivors' reaped state, never a hung pytest.
+
+* **No pipe deadlocks:** worker stdout/stderr spool to temp files (a worker
+  logging megabytes can never fill a pipe and block mid-collective).
+
+``launch_workers`` is the harness behind ``tests/parallel/test_multiprocess``
+and the multi-process leg of ``__graft_entry__.dryrun_multichip``;
+``clean_cpu_env`` builds the sanitized per-worker environment (no TPU-relay
+sitecustomize, forced CPU platform, N virtual devices per process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger("replay_tpu")
+
+__all__ = ["WorkerResult", "LaunchError", "free_port", "clean_cpu_env", "launch_workers"]
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An ephemeral port chosen by the OS — callers bind-and-release, then
+    hand the number to a child that binds it for real. The tiny race this
+    leaves is why every consumer here also tolerates a failed bind loudly."""
+    with socket.socket() as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
+
+
+def clean_cpu_env(
+    local_devices: int = 4,
+    repo_root: Optional[str] = None,
+    extra: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """A sanitized environment for a CPU worker process: the TPU-relay
+    sitecustomize stripped (its PJRT registration serializes on the device
+    grant and can block for minutes), the platform forced to CPU with
+    ``local_devices`` virtual devices, and gloo selected for CPU collectives.
+    """
+    root = str(repo_root) if repo_root is not None else str(Path.cwd())
+    env = {
+        **{k: v for k, v in os.environ.items() if ".axon_site" not in v},
+        "PYTHONPATH": root,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={local_devices}",
+        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+        "REPLAY_TPU_CLEAN_REEXEC": "1",
+    }
+    env.update(extra or {})
+    return env
+
+
+@dataclasses.dataclass
+class WorkerResult:
+    """One worker's outcome: its rank, how it exited, and what it printed."""
+
+    rank: int
+    returncode: Optional[int]
+    stdout: str
+    stderr: str
+    reaped: bool = False  # launcher had to SIGKILL it after a peer died/hung
+
+    @property
+    def killed_by(self) -> Optional[int]:
+        """The signal number that killed the worker, or ``None``."""
+        if self.returncode is not None and self.returncode < 0:
+            return -self.returncode
+        return None
+
+
+class LaunchError(RuntimeError):
+    """Raised (``check=True``) when any worker exits nonzero or is reaped."""
+
+
+def launch_workers(
+    script: str,
+    num_processes: int,
+    args_for: Optional[Callable[[int], Sequence[str]]] = None,
+    env: Optional[Dict[str, str]] = None,
+    timeout: float = 300.0,
+    grace_s: float = 20.0,
+    check: bool = True,
+    pass_rank_argv: bool = True,
+    python: str = sys.executable,
+) -> List[WorkerResult]:
+    """Run ``num_processes`` copies of ``script`` as one distributed job.
+
+    Each worker gets the coordinator handshake via env
+    (``REPLAY_TPU_COORDINATOR``/``REPLAY_TPU_NUM_PROCESSES``/
+    ``REPLAY_TPU_PROCESS_ID``) and — with ``pass_rank_argv`` — as leading
+    argv ``<rank> <host:port>``, followed by ``args_for(rank)``.
+
+    Supervision: after the first worker exit, survivors get ``grace_s``
+    seconds (a peer's death wedges gloo collectives — waiting longer only
+    hangs the caller), then are SIGKILLed with ``reaped=True``. ``timeout``
+    bounds the whole job the same way. With ``check=True`` any nonzero or
+    reaped worker raises :class:`LaunchError` carrying the stderr tails;
+    chaos callers pass ``check=False`` and assert on the results directly.
+    """
+    if num_processes < 1:
+        msg = f"num_processes must be >= 1, got {num_processes}"
+        raise ValueError(msg)
+    coordinator = f"127.0.0.1:{free_port()}"
+    base_env = dict(env if env is not None else os.environ)
+    spools = []
+    workers: List[subprocess.Popen] = []
+    try:
+        for rank in range(num_processes):
+            worker_env = {
+                **base_env,
+                "REPLAY_TPU_COORDINATOR": coordinator,
+                "REPLAY_TPU_NUM_PROCESSES": str(num_processes),
+                "REPLAY_TPU_PROCESS_ID": str(rank),
+            }
+            argv = [python, str(script)]
+            if pass_rank_argv:
+                argv += [str(rank), coordinator]
+            argv += [str(a) for a in (args_for(rank) if args_for else ())]
+            out = tempfile.TemporaryFile()
+            err = tempfile.TemporaryFile()
+            spools.append((out, err))
+            workers.append(
+                subprocess.Popen(argv, env=worker_env, stdout=out, stderr=err)
+            )
+
+        reaped = [False] * num_processes
+        deadline = time.monotonic() + timeout
+        first_exit_at: Optional[float] = None
+        while any(w.poll() is None for w in workers):
+            now = time.monotonic()
+            exited = [w for w in workers if w.poll() is not None]
+            if exited and first_exit_at is None:
+                first_exit_at = now
+            hung_past_grace = first_exit_at is not None and now - first_exit_at > grace_s
+            if now > deadline or hung_past_grace:
+                reason = "timeout" if now > deadline else (
+                    f"peer exited {grace_s:.0f}s ago; collectives are wedged"
+                )
+                for rank, worker in enumerate(workers):
+                    if worker.poll() is None:
+                        logger.warning(
+                            "launch_workers: reaping rank %d (%s)", rank, reason
+                        )
+                        worker.send_signal(signal.SIGKILL)
+                        reaped[rank] = True
+                for worker in workers:
+                    worker.wait(timeout=30)
+                break
+            time.sleep(0.1)
+
+        results = []
+        for rank, (worker, (out, err)) in enumerate(zip(workers, spools)):
+            worker.wait(timeout=30)
+            out.seek(0)
+            err.seek(0)
+            results.append(
+                WorkerResult(
+                    rank=rank,
+                    returncode=worker.returncode,
+                    stdout=out.read().decode(errors="replace"),
+                    stderr=err.read().decode(errors="replace"),
+                    reaped=reaped[rank],
+                )
+            )
+    finally:
+        for worker in workers:  # never leak a live worker past the call
+            if worker.poll() is None:
+                worker.kill()
+                worker.wait(timeout=30)
+        for out, err in spools:
+            out.close()
+            err.close()
+
+    if check:
+        bad = [r for r in results if r.returncode != 0 or r.reaped]
+        if bad:
+            details = "\n".join(
+                f"rank {r.rank}: returncode={r.returncode} reaped={r.reaped}\n"
+                f"{r.stderr[-2000:]}"
+                for r in bad
+            )
+            msg = f"{len(bad)}/{num_processes} workers failed:\n{details}"
+            raise LaunchError(msg)
+    return results

@@ -61,6 +61,7 @@ from .promote import (
     in_canary_slice,
 )
 from .quant import QuantizedTable, quantization_error, quantize_embeddings
+from .remote import RemoteReplica, ReplicaServer, ReplicaServerProcess
 from .request import ScoreRequest, ScoreResponse, make_window
 from .router import REPLICA_HEALTH, BackoffPolicy, HashRing, ReplicaHealth
 from .service import ScoringService
@@ -81,8 +82,11 @@ __all__ = [
     "ParamGeneration",
     "ParamStore",
     "PromotionController",
+    "RemoteReplica",
     "ReplicaHandle",
     "ReplicaHealth",
+    "ReplicaServer",
+    "ReplicaServerProcess",
     "RequestShed",
     "ScoreRequest",
     "ScoreResponse",

@@ -243,8 +243,13 @@ class ScoringService:
                     slo_rules, self.metrics_registry, emit=self._route_event
                 )
             if metrics_port is not None:
+                # the structured /healthz (format=json) serves the heartbeat
+                # document, so a REMOTE fleet monitor can drive ReplicaHealth
+                # from a pure scrape of this port (serve.remote)
                 self.metrics_exporter = MetricsExporter(
-                    self.metrics_registry, port=metrics_port
+                    self.metrics_registry,
+                    port=metrics_port,
+                    health_source=self.heartbeat,
                 )
 
     # -- lifecycle ---------------------------------------------------------- #

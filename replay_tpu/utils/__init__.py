@@ -11,7 +11,7 @@ from .model_handler import (
 from .distributions import item_distribution
 from .time import get_item_recency, smoothe_time
 from .checkpoint import CheckpointManager, load_metadata, restore_pytree, save_pytree
-from .faults import NaNInjector, SignalAtStep, inject_nan, truncate_file
+from .faults import KillAtStep, NaNInjector, SignalAtStep, inject_nan, truncate_file
 from .profiling import StepTimer, trace
 from .session import State, get_default_mesh, setup_logging
 from .types import (
@@ -46,6 +46,7 @@ __all__ = [
     "TORCH_AVAILABLE",
     "CheckpointManager",
     "DataFrameLike",
+    "KillAtStep",
     "NaNInjector",
     "SignalAtStep",
     "inject_nan",

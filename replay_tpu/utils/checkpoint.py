@@ -196,10 +196,17 @@ class CheckpointManager:
     def _step_path(self, step: int) -> Path:
         return self.directory / f"step_{step}"
 
+    def _proc_meta_path(self, step: int, process_index: int) -> Path:
+        # its own subdirectory: all_steps() globs step_*.json in the root, and
+        # per-process sidecars must never be mistaken for commit markers
+        return self.directory / "proc_meta" / f"step_{step}.proc{process_index}.json"
+
     def _delete_step(self, step: int) -> None:
         self._step_path(step).with_suffix(".npz").unlink(missing_ok=True)
         self._step_path(step).with_suffix(".json").unlink(missing_ok=True)
         shutil.rmtree(self.directory / f"step_{step}.orbax", ignore_errors=True)
+        for proc_file in (self.directory / "proc_meta").glob(f"step_{step}.proc*.json"):
+            proc_file.unlink(missing_ok=True)
 
     def all_steps(self) -> List[int]:
         # the JSON sidecar exists for every backend
@@ -208,6 +215,23 @@ class CheckpointManager:
     def metadata(self, step: int) -> dict:
         """The JSON metadata saved alongside checkpoint ``step``."""
         return load_metadata(str(self._step_path(step)))
+
+    def process_metadata(self, step: int, process_index: Optional[int] = None) -> dict:
+        """THIS process's private sidecar for checkpoint ``step`` (``{}`` when
+        absent or unreadable — the caller falls back to the shared metadata).
+
+        The shared ``step_<n>.json`` sidecar has exactly one writer (process
+        0), so anything per-process — a streaming batcher's cursor above all —
+        needs its own file. Each process writes its own atomically in
+        :meth:`save` (before the commit marker, so a committed step always has
+        its process sidecars) and reads its own back on resume.
+        """
+        if process_index is None:
+            process_index = jax.process_index()
+        try:
+            return json.loads(self._proc_meta_path(step, process_index).read_text())
+        except (OSError, ValueError):
+            return {}
 
     # -- integrity --------------------------------------------------------- #
     def _payload_ok(self, step: int) -> bool:
@@ -256,7 +280,16 @@ class CheckpointManager:
         state: Any,
         history: Optional[List[Dict[str, float]]] = None,
         metadata: Optional[dict] = None,
+        process_metadata: Optional[dict] = None,
     ) -> None:
+        if process_metadata is not None:
+            # per-process sidecar FIRST: the shared sidecar is the commit
+            # marker and must land after everything a resume will read
+            path = self._proc_meta_path(step, jax.process_index())
+            path.parent.mkdir(parents=True, exist_ok=True)
+            _atomic_replace(
+                path, lambda fh: fh.write(json.dumps(process_metadata).encode())
+            )
         save_pytree(
             str(self._step_path(step)), state, {"step": step, **(metadata or {})},
             backend=self.backend,

@@ -12,6 +12,11 @@ harness so every recovery path in ``tests/nn/test_fault_tolerance.py`` and
 * :class:`SignalAtStep` raises a real SIGTERM/SIGINT at a chosen batch index
   (exercises :class:`~replay_tpu.nn.train.PreemptionHandler` end-to-end,
   through the actual OS signal machinery);
+* :class:`KillAtStep` SIGKILLs a whole worker PROCESS at a chosen batch index
+  (or, via :meth:`KillAtStep.fire`, at an arbitrary moment) — the hard-kill
+  injector the process-real chaos legs share: no handler runs, no cleanup
+  happens, recovery must come entirely from on-disk atomicity
+  (checkpoint + cursor sidecar) or peer-side failover;
 * :func:`truncate_file` chops a checkpoint payload as a crash mid-write would
   (exercises ``CheckpointManager``'s skip-and-report integrity scan);
 * :class:`EngineErrorAt` makes a wrapped callable (e.g.
@@ -124,6 +129,46 @@ class SignalAtStep:
             if self.position == self.at_step and not self.raised:
                 self.raised = True
                 signal.raise_signal(self.sig)
+            self.position += 1
+            yield batch
+
+
+class KillAtStep:
+    """SIGKILL a process just before yielding batch ``at_step``.
+
+    The uncatchable upgrade of :class:`SignalAtStep`: SIGKILL never reaches a
+    handler, so a wrapped training stream dies mid-epoch exactly as a
+    preempted/OOM-killed worker would — whatever survives is what the atomic
+    checkpoint + cursor sidecar design actually guarantees. By default the
+    injector kills ITS OWN process (a worker wraps its own stream); ``pid``
+    retargets it at another process, and :meth:`fire` sends the kill
+    immediately — the fleet chaos path (``bench_fleet.py``) uses it to SIGKILL
+    a replica server process mid-traffic:
+
+    >>> # training worker: dies fetching global batch 4, no cleanup runs
+    >>> # trainer.fit(lambda epoch: KillAtStep(4).wrap(batches(epoch)), ...)
+    >>> # fleet chaos: hard-kill a replica server process
+    >>> # KillAtStep(pid=server.pid).fire()
+    """
+
+    def __init__(
+        self, at_step: int = 0, pid: Optional[int] = None, sig: int = signal.SIGKILL
+    ) -> None:
+        self.at_step = int(at_step)
+        self.pid = pid
+        self.sig = sig
+        self.position = 0  # global batch index across wrap() calls
+        self.fired = False
+
+    def fire(self) -> None:
+        """Send the kill now. Does not return when targeting ``os.getpid()``."""
+        self.fired = True
+        os.kill(self.pid if self.pid is not None else os.getpid(), self.sig)
+
+    def wrap(self, batches: Iterable[Dict[str, Any]]) -> Iterator[Dict[str, Any]]:
+        for batch in batches:
+            if self.position == self.at_step and not self.fired:
+                self.fire()
             self.position += 1
             yield batch
 

@@ -51,6 +51,61 @@ def test_metrics_and_snapshot_endpoints(served_registry):
     assert status == 200 and body == "ok\n"
 
 
+def test_healthz_json_negotiation(served_registry):
+    """Structured health: ``?format=json`` or an ``Accept: application/json``
+    header gets the health document; the plain-text probe shape survives."""
+    registry, _ = served_registry
+    heartbeat = {
+        "live": True, "queued": 2, "max_depth": 64,
+        "breaker_state": "closed", "requests": 10, "errors": 1,
+        "error_rate": 0.1,
+    }
+    exporter = MetricsExporter(registry, port=0, health_source=lambda: heartbeat)
+    exporter.start()
+    try:
+        # default stays byte-identical for existing probes
+        status, body = _get(f"{exporter.url}/healthz")
+        assert status == 200 and body == "ok\n"
+        status, body = _get(f"{exporter.url}/healthz?format=json")
+        assert status == 200
+        assert json.loads(body) == heartbeat
+        request = urllib.request.Request(
+            f"{exporter.url}/healthz", headers={"Accept": "application/json"}
+        )
+        with urllib.request.urlopen(request, timeout=10.0) as response:
+            assert json.loads(response.read().decode()) == heartbeat
+    finally:
+        exporter.close()
+
+
+def test_healthz_json_without_source_is_live(served_registry):
+    _, exporter = served_registry
+    status, body = _get(f"{exporter.url}/healthz?format=json")
+    assert status == 200
+    assert json.loads(body) == {"live": True}
+
+
+def test_healthz_json_raising_source_is_503():
+    """A broken heartbeat is the signal — 503 + the error, never a happy 200."""
+    def broken():
+        raise RuntimeError("engine wedged")
+
+    exporter = MetricsExporter(MetricsRegistry(), port=0, health_source=broken)
+    exporter.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(f"{exporter.url}/healthz?format=json")
+        assert err.value.code == 503
+        payload = json.loads(err.value.read().decode())
+        assert payload["live"] is False
+        assert "engine wedged" in payload["error"]
+        # the plain probe still reports process liveness
+        status, body = _get(f"{exporter.url}/healthz")
+        assert status == 200 and body == "ok\n"
+    finally:
+        exporter.close()
+
+
 def test_unknown_path_is_404(served_registry):
     _, exporter = served_registry
     with pytest.raises(urllib.error.HTTPError) as err:

@@ -1,0 +1,155 @@
+"""Worker for the process-real streaming-fit tests: one rank of a 2-process
+job running the 3-axis-mesh (DP×TP×SP) scan-chunked fit over the disjoint
+row-group streaming reader, with hard-kill chaos and elastic resume.
+
+Each rank streams ITS shard of a shared parquet file (``Partitioning`` over
+``ReplicasInfo(2, rank)`` — the same plan the single-process tests prove
+exactly-once), feeds a ring-attention SasRec on a ``(data=2, model=2,
+seq=2)`` global mesh with vocab-sharded embeddings, and checkpoints through
+a shared ``CheckpointManager`` (orbax under multi-host) with per-process
+cursor sidecars.
+
+Phases (argv: ``rank coordinator out_path parquet_path ckpt_dir phase
+kill_at``):
+
+* ``full``   — 2 epochs uninterrupted; the reference trajectory.
+* ``kill``   — same fit, but the rank whose ``kill_at >= 0`` SIGKILLs its own
+  process (``KillAtStep.fire``) after that many train-step events: no
+  handler, no cleanup — recovery must come entirely from what is on disk.
+* ``resume`` — ``fit(resume=True)`` on the killed run's checkpoint dir; the
+  parent asserts the post-restore (step, loss) pairs match the ``full`` run
+  EXACTLY (same f32 CPU programs -> bitwise-equal trajectory).
+
+The coordinator handshake arrives via env (``REPLAY_TPU_COORDINATOR`` etc.,
+published by ``replay_tpu.parallel.launch``); the argv coordinator is
+accepted for symmetry with the older workers but not needed.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+NUM_ITEMS = 31  # 32-row table divides the model axis
+SEQ_LEN = 9  # next-token shift -> [B, 8] inputs; 8 % seq_parallel(2) == 0
+LOCAL_BATCH = 4  # x2 processes = global 8, divisible by the data axis
+EPOCHS = 2
+CHECKPOINT_EVERY = 3
+STREAM_SEED = 3
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    out_path = sys.argv[3]
+    parquet_path = sys.argv[4]
+    ckpt_dir = sys.argv[5]
+    phase = sys.argv[6]  # "full" | "kill" | "resume"
+    kill_at = int(sys.argv[7])  # SIGKILL self after this many step events; -1 = never
+
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer jax may configure this via env instead
+
+    from replay_tpu.parallel import initialize_distributed
+
+    layout = initialize_distributed()  # resolved from the launcher's env handshake
+    assert layout["num_processes"] == 2, layout
+    assert layout["process_id"] == rank, (layout, rank)
+
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import (
+        ParquetBatcher,
+        Partitioning,
+        ReplicasInfo,
+        TensorFeatureInfo,
+        TensorSchema,
+        TransformedBatches,
+    )
+    from replay_tpu.nn import OptimizerFactory, Trainer, make_mesh
+    from replay_tpu.nn.loss import CE
+    from replay_tpu.nn.sequential.sasrec import SasRec
+    from replay_tpu.nn.transform import Compose
+    from replay_tpu.nn.transform.template import make_default_sasrec_transforms
+    from replay_tpu.utils import CheckpointManager, KillAtStep
+
+    schema = TensorSchema(
+        TensorFeatureInfo(
+            "item_id", FeatureType.CATEGORICAL, is_seq=True,
+            feature_hint=FeatureHint.ITEM_ID, cardinality=NUM_ITEMS,
+            embedding_dim=8,
+        )
+    )
+    model = SasRec(
+        schema=schema, embedding_dim=8, num_blocks=1, num_heads=1,
+        max_sequence_length=SEQ_LEN,
+    ).clone(use_flash="ring")
+    trainer = Trainer(
+        model=model,
+        loss=CE(),
+        optimizer=OptimizerFactory(name="sgd", learning_rate=0.1),
+        mesh=make_mesh(model_parallel=2, seq_parallel=2),  # (data=2, model=2, seq=2)
+        shard_vocab=True,
+        seed=0,
+    )
+
+    batcher = ParquetBatcher(
+        parquet_path, batch_size=LOCAL_BATCH, shuffle=True, seed=STREAM_SEED,
+        shard="row_groups",
+        metadata={"item_id": {"shape": SEQ_LEN, "padding": 0}},
+        partitioning=Partitioning(ReplicasInfo(2, rank), shuffle=True, seed=STREAM_SEED),
+    )
+    pipeline = Compose(make_default_sasrec_transforms(schema)["train"])
+
+    events = []
+
+    class _Sink:
+        def log_event(self, event):
+            if event.event == "on_train_step":
+                events.append([int(event.step), float(event.payload["loss"])])
+
+    sinks = [_Sink()]
+    if kill_at >= 0:
+        injector = KillAtStep()
+
+        class _KillSink:
+            seen = 0
+
+            def log_event(self, event):
+                if event.event == "on_train_step":
+                    type(self).seen += 1
+                    if type(self).seen >= kill_at:
+                        injector.fire()  # real SIGKILL: does not return
+
+        sinks.append(_KillSink())
+
+    manager = CheckpointManager(ckpt_dir)
+    state = trainer.fit(
+        TransformedBatches(batcher, pipeline),
+        epochs=EPOCHS,
+        scan_chunk=2,
+        log_every=0,
+        loggers=sinks,
+        checkpoint_manager=manager,
+        checkpoint_every=CHECKPOINT_EVERY,
+        resume=(phase == "resume"),
+    )
+
+    with open(out_path, "w") as fh:
+        json.dump(
+            {
+                "rank": rank,
+                "phase": phase,
+                "final_step": int(np.asarray(state.step)),
+                "events": events,
+                "valid_steps": manager.valid_steps(),
+            },
+            fh,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -6,49 +6,34 @@ local_data + psum over the global mesh), not the single-process mesh emulation
 the rest of tests/parallel uses."""
 
 import json
-import os
-import socket
-import subprocess
-import sys
 from pathlib import Path
 
 import numpy as np
 import pytest
 
+from replay_tpu.parallel.launch import clean_cpu_env, launch_workers
+
+# each test spawns real jax.distributed worker processes (fresh interpreter +
+# compile per worker, ~1 min apiece): excluded from the default tier via
+# `-m 'not slow'`; the CI `multiproc_smoke` job and the full `-m jax` tier
+# run this file explicitly
+pytestmark = pytest.mark.slow
+
 REPO_ROOT = Path(__file__).resolve().parents[2]
 
 
-def _free_port() -> int:
-    with socket.socket() as sock:
-        sock.bind(("127.0.0.1", 0))
-        return sock.getsockname()[1]
-
-
 def _clean_two_proc_env() -> dict:
-    return {
-        **{k: v for k, v in os.environ.items() if ".axon_site" not in v},
-        "PYTHONPATH": str(REPO_ROOT),
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
-        "REPLAY_TPU_CLEAN_REEXEC": "1",
-    }
+    return clean_cpu_env(local_devices=4, repo_root=REPO_ROOT)
 
 
 def _run_two_workers(script: str, extra_args, env) -> None:
-    port = _free_port()
-    coordinator = f"127.0.0.1:{port}"
-    workers = [
-        subprocess.Popen(
-            [sys.executable, str(REPO_ROOT / "tests/parallel" / script),
-             str(rank), coordinator, *extra_args(rank)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        )
-        for rank in range(2)
-    ]
-    outputs = [w.communicate(timeout=300) for w in workers]
-    for worker, (stdout, stderr) in zip(workers, outputs):
-        assert worker.returncode == 0, stderr.decode()[-2000:]
+    launch_workers(
+        str(REPO_ROOT / "tests/parallel" / script),
+        num_processes=2,
+        args_for=extra_args,
+        env=env,
+        timeout=300.0,
+    )
 
 
 @pytest.mark.jax
@@ -118,6 +103,154 @@ def test_two_process_dp_matches_single_process(tmp_path):
     )
     for key, value in reference_metrics.items():
         assert results[0]["metrics"][key] == pytest.approx(value, rel=1e-5), key
+
+
+STREAM_ROWS = 60
+
+
+def _write_stream_parquet(path) -> None:
+    import pandas as pd
+
+    from replay_tpu.data import FeatureHint, FeatureType
+    from replay_tpu.data.nn import (
+        SequentialDataset,
+        TensorFeatureInfo,
+        TensorSchema,
+        write_sequence_parquet,
+    )
+
+    rng = np.random.default_rng(11)
+    frame = pd.DataFrame({
+        "query_id": np.arange(STREAM_ROWS),
+        "item_id": [
+            rng.integers(1, 31, rng.integers(2, 9)).astype(np.int64)
+            for _ in range(STREAM_ROWS)
+        ],
+    })
+    schema = TensorSchema(
+        TensorFeatureInfo("item_id", FeatureType.CATEGORICAL, is_seq=True,
+                          feature_hint=FeatureHint.ITEM_ID, cardinality=31,
+                          embedding_dim=8)
+    )
+    write_sequence_parquet(
+        str(path), SequentialDataset(schema, "query_id", "item_id", frame),
+        rows_per_chunk=8,
+    )
+
+
+def _stream_worker_args(tmp_path, parquet, ckpt_dir, phase, kill_ranks=()):
+    def args(rank):
+        kill_at = 13 if rank in kill_ranks else -1
+        return [
+            str(tmp_path / f"{phase}_rank{rank}.json"), str(parquet),
+            str(ckpt_dir), phase, str(kill_at),
+        ]
+    return args
+
+
+def _replayed_coverage(parquet, cursor, rank):
+    """(consumed_ids, remaining_ids) for ``rank``'s shard at ``cursor`` —
+    replayed on a fresh reader with the identical plan fingerprint."""
+    from replay_tpu.data.nn import ParquetBatcher, Partitioning, ReplicasInfo
+
+    def batcher():
+        return ParquetBatcher(
+            str(parquet), batch_size=4, shuffle=True, seed=3, shard="row_groups",
+            metadata={"item_id": {"shape": 9, "padding": 0}},
+            partitioning=Partitioning(ReplicasInfo(2, rank), shuffle=True, seed=3),
+        )
+
+    full = batcher()
+    full.set_epoch(int(cursor["epoch"]))
+    consumed = []
+    for batch in list(full)[: int(cursor["batches"])]:
+        consumed.extend(batch["query_id"][batch["valid"]].tolist())
+    resumed = batcher()
+    resumed.set_epoch(int(cursor["epoch"]))
+    resumed.restore_cursor(cursor)
+    remaining = []
+    for batch in resumed:
+        remaining.extend(batch["query_id"][batch["valid"]].tolist())
+    return consumed, remaining
+
+
+@pytest.mark.jax
+def test_stream_fit_sigkill_resume_bitwise(tmp_path):
+    """The process-real headline: a 2-process DP×TP×SP scan-chunked fit over
+    the disjoint row-group streaming reader, SIGKILLed mid-epoch on one rank,
+    resumes from the atomic checkpoint + per-process cursor sidecars onto the
+    EXACT trajectory of the uninterrupted run — and the cursor sidecars prove
+    exactly-once coverage of the interrupted epoch."""
+    from replay_tpu.utils.checkpoint import CheckpointManager
+
+    parquet = tmp_path / "stream.parquet"
+    _write_stream_parquet(parquet)
+    env = _clean_two_proc_env()
+    worker = str(REPO_ROOT / "tests/parallel/mp_stream_worker.py")
+
+    # 1) the uninterrupted reference trajectory
+    full_ckpt = tmp_path / "ckpt_full"
+    launch_workers(
+        worker, 2, _stream_worker_args(tmp_path, parquet, full_ckpt, "full"),
+        env=env, timeout=420.0, grace_s=90.0,
+    )
+    full = [json.loads((tmp_path / f"full_rank{r}.json").read_text()) for r in range(2)]
+    assert full[0]["events"] == full[1]["events"]  # psum-replicated: identical
+    assert full[0]["events"], "reference run emitted no steps"
+
+    # 2) hard-kill one rank mid-epoch: a REAL SIGKILL, peers reaped by the
+    # launcher once the collectives wedge
+    kill_ckpt = tmp_path / "ckpt_kill"
+    results = launch_workers(
+        worker, 2,
+        _stream_worker_args(tmp_path, parquet, kill_ckpt, "kill", kill_ranks=(1,)),
+        env=env, timeout=420.0, grace_s=20.0, check=False,
+    )
+    import signal
+
+    assert results[1].returncode == -signal.SIGKILL, results[1].stderr[-1000:]
+    assert results[1].killed_by == signal.SIGKILL
+    # the survivor cannot finish the epoch without its peer — either the
+    # launcher reaped it out of the wedged collective or jax.distributed
+    # surfaced the lost peer as an error; it must NOT have exited cleanly
+    assert results[0].reaped or results[0].returncode != 0
+
+    # 3) what the kill left behind: a valid mid-epoch checkpoint with one
+    # cursor sidecar PER PROCESS, and exactly-once coverage when replayed
+    manager = CheckpointManager(str(kill_ckpt))
+    latest = manager.latest_step()
+    assert latest is not None, "no valid checkpoint survived the kill"
+    meta = manager.metadata(latest)
+    assert meta.get("mid_epoch"), meta
+    all_ids = []
+    for rank in range(2):
+        proc_meta = manager.process_metadata(latest, process_index=rank)
+        cursor = proc_meta.get("stream_cursor")
+        assert cursor is not None, f"rank {rank} has no cursor sidecar"
+        assert int(cursor["batches"]) == int(meta["step_in_epoch"])
+        consumed, remaining = _replayed_coverage(parquet, cursor, rank)
+        ids = consumed + remaining
+        assert len(ids) == len(set(ids)), f"rank {rank} re-emits a consumed row"
+        all_ids.extend(ids)
+    assert sorted(all_ids) == list(range(STREAM_ROWS))
+
+    # 4) fresh processes resume from the sidecars: bit-for-bit the same
+    # (step, loss) trajectory as the uninterrupted run, to the same end
+    launch_workers(
+        worker, 2, _stream_worker_args(tmp_path, parquet, kill_ckpt, "resume"),
+        env=env, timeout=420.0, grace_s=90.0,
+    )
+    resume = [
+        json.loads((tmp_path / f"resume_rank{r}.json").read_text()) for r in range(2)
+    ]
+    assert resume[0]["events"] == resume[1]["events"]
+    assert resume[0]["events"], "resumed run emitted no steps"
+    reference = dict(map(tuple, full[0]["events"]))
+    for step, loss in resume[0]["events"]:
+        assert reference[step] == loss, (  # EXACT float equality: bitwise resume
+            f"step {step}: resumed loss {loss!r} != reference {reference[step]!r}"
+        )
+    assert resume[0]["final_step"] == full[0]["final_step"]
 
 
 @pytest.mark.jax

@@ -8,6 +8,7 @@ import pytest
 import jax
 
 from replay_tpu.data.nn import ParquetBatcher, Partitioning, ReplicasInfo
+from replay_tpu.data.nn.parquet import StreamCursor
 
 N_ROWS = 103
 GROUP_SIZE = 8  # 13 row groups: more groups than the 8 replicas
@@ -34,16 +35,25 @@ def grouped_parquet(tmp_path_factory):
     return path
 
 
-def replica_batches(path, replica, num_replicas, epoch):
-    batcher = ParquetBatcher(
+def _batcher_for(path, replica, num_replicas, **kwargs):
+    return ParquetBatcher(
         path, batch_size=BATCH, shuffle=True, seed=5, shard="row_groups",
         metadata={"item_id": {"shape": 5, "padding": 50}},
         partitioning=Partitioning(
             ReplicasInfo(num_replicas, replica), shuffle=True, seed=5
         ),
+        **kwargs,
     )
+
+
+def _batches_for(path, replica, num_replicas, epoch, **kwargs):
+    batcher = _batcher_for(path, replica, num_replicas, **kwargs)
     batcher.set_epoch(epoch)
     return list(batcher)
+
+
+def replica_batches(path, replica, num_replicas, epoch):
+    return _batches_for(path, replica, num_replicas, epoch)
 
 
 class TestEightProcessSharding:
@@ -100,24 +110,13 @@ class TestEightProcessSharding:
         checkpoints ITS cursor)."""
         for replica in (0, 3, 7):
             full = replica_batches(grouped_parquet, replica, self.NUM, 1)
-            part = Partitioning(ReplicasInfo(self.NUM, replica), shuffle=True, seed=5)
-            producer = ParquetBatcher(
-                grouped_parquet, batch_size=BATCH, shuffle=True, seed=5,
-                shard="row_groups",
-                metadata={"item_id": {"shape": 5, "padding": 50}},
-                partitioning=part,
-            )
+            producer = _batcher_for(grouped_parquet, replica, self.NUM)
             producer.set_epoch(1)
             iterator = iter(producer)
             next(iterator)
             next(iterator)
             cursor = producer.cursor_for(2).to_metadata()
-            resumed = ParquetBatcher(
-                grouped_parquet, batch_size=BATCH, shuffle=True, seed=5,
-                shard="row_groups",
-                metadata={"item_id": {"shape": 5, "padding": 50}},
-                partitioning=part,
-            )
+            resumed = _batcher_for(grouped_parquet, replica, self.NUM)
             resumed.set_epoch(1)
             resumed.restore_cursor(cursor)
             rest = list(resumed)
@@ -125,3 +124,168 @@ class TestEightProcessSharding:
             for a, b in zip(full[2:], rest):
                 for key in a:
                     np.testing.assert_array_equal(a[key], b[key])
+
+
+class TestElasticRehash:
+    """``StreamCursor.rehash``: the sanctioned mid-epoch migration of a
+    row-group plan onto a DIFFERENT replica count (elastic resume) — the
+    refusal the plan fingerprint used to raise, turned into an exactly-once
+    supported path."""
+
+    def test_rehash_migrates_onto_more_replicas_exactly_once(self, grouped_parquet):
+        """The elastic-resume headline: the SAME mid-epoch position that the
+        fingerprint check refuses under a changed replica count migrates
+        cleanly through ``StreamCursor.rehash`` — consumed rows never
+        re-emitted, unseen rows all assigned, exactly once across the new
+        layout."""
+        old_n, new_n, epoch, ordinal = 2, 3, 0, 5
+        consumed = []
+        cursor = None
+        for replica in range(old_n):
+            batches = _batches_for(grouped_parquet, replica, old_n, epoch)
+            for batch in batches[:ordinal]:
+                consumed.extend(batch["query_id"][batch["valid"]].tolist())
+            if replica == 0:
+                producer = _batcher_for(grouped_parquet, replica, old_n)
+                producer.set_epoch(epoch)
+                list(producer)
+                cursor = producer.cursor_for(ordinal)
+
+        migrated = cursor.rehash(new_n)
+        remaining = []
+        for replica in range(new_n):
+            resumed = _batcher_for(grouped_parquet, replica, new_n)
+            resumed.set_epoch(epoch)
+            resumed.restore_cursor(migrated.to_metadata())
+            for batch in list(resumed):
+                remaining.extend(batch["query_id"][batch["valid"]].tolist())
+
+        assert len(consumed) == len(set(consumed))
+        assert len(remaining) == len(set(remaining))
+        assert not set(consumed) & set(remaining), "a consumed row was re-emitted"
+        assert sorted(consumed + remaining) == list(range(N_ROWS))
+
+    def test_rehash_equalizes_step_counts_on_the_new_layout(self, grouped_parquet):
+        """The collective invariant survives migration: every NEW replica
+        emits the same batch count, continuing from the migration ordinal."""
+        old_n, new_n, epoch, ordinal = 2, 3, 1, 4
+        producer = _batcher_for(grouped_parquet, 0, old_n)
+        producer.set_epoch(epoch)
+        list(producer)
+        migrated = producer.cursor_for(ordinal).rehash(new_n)
+        counts = {}
+        for replica in range(new_n):
+            resumed = _batcher_for(grouped_parquet, replica, new_n)
+            resumed.set_epoch(epoch)
+            resumed.restore_cursor(migrated)
+            counts[replica] = len(list(resumed))
+        assert len(set(counts.values())) == 1, counts
+
+    def test_migration_coverage_audit_is_exact(self, grouped_parquet):
+        old_n, new_n, epoch, ordinal = 2, 3, 0, 5
+        producer = _batcher_for(grouped_parquet, 0, old_n)
+        producer.set_epoch(epoch)
+        list(producer)
+        migrated = producer.cursor_for(ordinal).rehash(new_n)
+        auditor = _batcher_for(grouped_parquet, 0, new_n)
+        audit = auditor.migration_coverage(migrated)
+        assert audit["total_rows"] == N_ROWS
+        assert audit["consumed_rows"] + audit["assigned_rows"] == N_ROWS
+        # at ordinal 5 no old replica had exhausted its ~51-row share yet
+        assert audit["consumed_rows"] == old_n * ordinal * BATCH
+        assert (
+            sum(audit["assigned_rows_per_replica"].values())
+            == audit["assigned_rows"]
+        )
+        assert audit["new_replicas"] == new_n
+
+    def test_raw_cursor_still_refused_under_changed_layout(self, grouped_parquet):
+        """rehash is the ONLY sanctioned migration: restoring an un-rehashed
+        cursor under a different replica count keeps failing loudly (and the
+        refusal now names the supported path)."""
+        producer = _batcher_for(grouped_parquet, 0, 2)
+        producer.set_epoch(0)
+        list(producer)
+        cursor = producer.cursor_for(3)
+        stranger = _batcher_for(grouped_parquet, 0, 3)
+        stranger.set_epoch(0)
+        with pytest.raises(ValueError, match="rehash"):
+            stranger.restore_cursor(cursor.to_metadata())
+
+    def test_rehash_refuses_chaining_and_wrong_targets(self, grouped_parquet):
+        producer = _batcher_for(grouped_parquet, 0, 2)
+        producer.set_epoch(0)
+        list(producer)
+        migrated = producer.cursor_for(2).rehash(3)
+        with pytest.raises(ValueError, match="rehash"):
+            migrated.rehash(4)  # rehash-of-rehash: finish the epoch first
+        with pytest.raises(ValueError):
+            StreamCursor(epoch=0, slab=0, rows=0, batches=2).rehash(3)  # no plan
+        # a rehashed cursor only restores on the layout it targets
+        wrong = _batcher_for(grouped_parquet, 0, 4)
+        wrong.set_epoch(0)
+        with pytest.raises(ValueError, match="replica"):
+            wrong.restore_cursor(migrated)
+
+    def test_mid_migration_cursor_resumes_within_the_migrated_plan(
+        self, grouped_parquet
+    ):
+        """Cursors recorded DURING a migrated epoch are themselves resumable:
+        a new-layout replica that is preempted mid-migration seeks back to its
+        position in the migration work list bit-for-bit."""
+        old_n, new_n, epoch, ordinal = 2, 3, 0, 4
+        producer = _batcher_for(grouped_parquet, 0, old_n)
+        producer.set_epoch(epoch)
+        list(producer)
+        migrated = producer.cursor_for(ordinal).rehash(new_n)
+
+        replica = 1
+        first = _batcher_for(grouped_parquet, replica, new_n)
+        first.set_epoch(epoch)
+        first.restore_cursor(migrated)
+        full = list(first)
+        assert full, "migrated share should emit at least one batch"
+
+        again = _batcher_for(grouped_parquet, replica, new_n)
+        again.set_epoch(epoch)
+        again.restore_cursor(migrated)
+        iterator = iter(again)
+        next(iterator)
+        mid = again.cursor_for(ordinal + 1)
+        assert mid.migration is not None
+
+        resumed = _batcher_for(grouped_parquet, replica, new_n)
+        resumed.set_epoch(epoch)
+        resumed.restore_cursor(mid.to_metadata())
+        rest = list(resumed)
+        assert len(rest) == len(full) - 1
+        for a, b in zip(full[1:], rest):
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+
+    def test_rehash_with_memory_budget_sub_slabs(self, grouped_parquet):
+        """Migration replays the old plan's sub-slab split too: with a byte
+        budget forcing multi-slab groups, coverage stays exactly-once."""
+        old_n, new_n, epoch, ordinal = 2, 3, 0, 3
+        kwargs = {"memory_budget_bytes": 256}
+        consumed = []
+        cursor = None
+        for replica in range(old_n):
+            batcher = _batcher_for(grouped_parquet, replica, old_n, **kwargs)
+            batcher.set_epoch(epoch)
+            batches = list(batcher)
+            for batch in batches[:ordinal]:
+                consumed.extend(batch["query_id"][batch["valid"]].tolist())
+            if replica == 0:
+                cursor = batcher.cursor_for(ordinal)
+        migrated = cursor.rehash(new_n)
+        remaining = []
+        for replica in range(new_n):
+            resumed = _batcher_for(grouped_parquet, replica, new_n, **kwargs)
+            resumed.set_epoch(epoch)
+            resumed.restore_cursor(migrated)
+            for batch in list(resumed):
+                remaining.extend(batch["query_id"][batch["valid"]].tolist())
+        assert sorted(consumed + remaining) == list(range(N_ROWS))
+        assert not set(consumed) & set(remaining)
+

@@ -1,0 +1,196 @@
+"""The socket-boundary fleet: real replica PROCESSES behind real HTTP.
+
+Every other serve test crosses at most a thread boundary. Here each
+``ScoringService`` runs in its own OS process behind ``ReplicaServer``
+(spawned via the portfile handshake — port 0, nothing hardcoded), the fleet
+router drives :class:`~replay_tpu.serve.RemoteReplica` clients through the
+SAME duck-typed surface, health comes off a pure ``/healthz`` scrape, and
+chaos is a true ``SIGKILL`` of a server process — no atexit, no close path,
+just a dead socket. The claims: taxonomy refusals survive the wire with
+their hints, a killed replica's traffic fails over with zero hung futures,
+heartbeat misses declare it dead, and a respawned server (fresh ephemeral
+port) is picked up without rebuilding the fleet.
+"""
+
+import signal
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from replay_tpu.parallel import clean_cpu_env
+from replay_tpu.serve import (
+    RemoteReplica,
+    ReplicaServerProcess,
+    ServeError,
+    ServiceClosed,
+    ServingFleet,
+)
+from replay_tpu.serve.request import SERVED_FROM
+from replay_tpu.utils import KillAtStep
+
+# spawns real jax server processes (engine compiles at startup): jax tier,
+# not smoke — the CI multiproc_smoke job runs this file explicitly
+pytestmark = pytest.mark.jax
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+NUM_ITEMS = 32
+SEQ_LEN = 8
+REPLICAS = 3
+
+
+@pytest.fixture(scope="module")
+def servers():
+    env = clean_cpu_env(local_devices=1, repo_root=REPO_ROOT)
+    procs = [
+        ReplicaServerProcess(
+            env=env,
+            args=[
+                "--num-items", str(NUM_ITEMS),
+                "--seq-len", str(SEQ_LEN),
+                "--embedding-dim", "8",
+                "--num-blocks", "1",
+            ],
+        )
+        for _ in range(REPLICAS)
+    ]
+    try:
+        for proc in procs:  # concurrent startup: the compiles overlap
+            proc.spawn(wait=False)
+        for proc in procs:
+            proc.wait_ready()
+        yield procs
+    finally:
+        for proc in procs:
+            proc.terminate()
+
+
+def _history_for(user: int):
+    rng = np.random.default_rng(1000 + user)
+    return rng.integers(0, NUM_ITEMS, size=int(rng.integers(3, SEQ_LEN))).tolist()
+
+
+class TestRemoteReplica:
+    def test_score_roundtrip_over_the_socket(self, servers):
+        replica = RemoteReplica(servers[0]).start()
+        try:
+            cold = replica.score(1, history=_history_for(1), timeout=60)
+            assert cold.scores.shape == (NUM_ITEMS,)
+            assert cold.served_from in SERVED_FROM
+            assert np.isfinite(cold.scores).all()
+            # second touch: the SERVER-side cache answered (state lives in
+            # the replica process, not the client)
+            hit = replica.score(1, timeout=60)
+            assert hit.served_from == "hit"
+            np.testing.assert_array_equal(hit.scores, cold.scores)
+        finally:
+            replica.close()
+
+    def test_heartbeat_is_a_pure_scrape(self, servers):
+        replica = RemoteReplica(servers[0]).start()
+        try:
+            heartbeat = replica.heartbeat()
+            assert heartbeat["live"] is True
+            # the gauges the fleet monitor windows: all off the wire
+            for key in ("queued", "max_depth", "breaker_state", "requests", "errors"):
+                assert key in heartbeat
+            stats = replica.stats()
+            assert stats["requests"] >= 0
+            assert stats["mode"] == "full"
+        finally:
+            replica.close()
+
+    def test_taxonomy_refusals_survive_the_wire(self, servers):
+        replica = RemoteReplica(servers[0]).start()
+        try:
+            # an interaction that cannot land on a cold cache refuses with
+            # the re-anchor KeyError — 404 on the wire, KeyError again here
+            with pytest.raises(KeyError, match="history="):
+                replica.score(987654, new_items=[3], timeout=60)
+        finally:
+            replica.close()
+
+    def test_transport_death_is_service_closed(self):
+        # nothing listens here: connection refused must surface as the
+        # retryable ServiceClosed, and heartbeat must raise (a monitor miss)
+        ghost = RemoteReplica("http://127.0.0.1:1").start()
+        try:
+            with pytest.raises(ServiceClosed, match="unreachable"):
+                ghost.score(1, timeout=5)
+            with pytest.raises(Exception):
+                ghost.heartbeat()
+        finally:
+            ghost.close()
+
+    def test_closed_client_fails_fast(self, servers):
+        replica = RemoteReplica(servers[0]).start()
+        replica.close()
+        with pytest.raises(ServiceClosed):
+            replica.submit(1).result(timeout=5)
+
+
+class TestSocketFleetChaos:
+    def test_fleet_survives_a_sigkilled_replica(self, servers):
+        replicas = {f"r{i}": RemoteReplica(proc) for i, proc in enumerate(servers)}
+        fleet = ServingFleet(
+            replicas,
+            hedge_ms=0,  # failover via retry only: deterministic accounting
+            heartbeat_interval_s=None,  # poll() driven — no wall-clock races
+            heartbeat_misses=2,
+        )
+        victim = "r1"
+        with fleet:
+            fleet.poll()
+            assert set(fleet.health().values()) == {"healthy"}
+
+            # seed users across the ring; remember one homed on the victim
+            users = list(range(40))
+            for user in users:
+                response = fleet.score(user, history=_history_for(user), timeout=60)
+                assert response.replica in replicas
+            probe = next(u for u in users if fleet.ring.route(u) == victim)
+
+            # the hard kill: no handler, no close path, a dead socket
+            KillAtStep(pid=servers[1].pid).fire()
+            assert servers[1].proc.wait(timeout=10) == -signal.SIGKILL
+
+            # an idempotent request homed on the corpse: its ServiceClosed
+            # refusal is retried downstream — bounded failover gap, answered
+            kill_at = time.monotonic()
+            rerouted = fleet.score(probe, timeout=30)
+            gap_s = time.monotonic() - kill_at
+            assert rerouted.replica != victim
+            assert gap_s < 30.0
+
+            # heartbeat scrapes now fail: two polls declare it dead
+            fleet.poll()
+            fleet.poll()
+            assert fleet.health()[victim] == "dead"
+
+            # zero hung requests under post-kill traffic; failures (if any)
+            # are taxonomy refusals, never raw transport garbage
+            futures = [fleet.submit(user) for user in users]
+            deadline = time.monotonic() + 60.0
+            for future in futures:
+                remaining = max(deadline - time.monotonic(), 0.1)
+                try:
+                    answer = future.result(timeout=remaining)
+                    assert answer.replica != victim
+                except (ServeError, KeyError):
+                    pass  # the documented refusal taxonomy
+            assert all(future.done() for future in futures)
+
+            # revival on a FRESH ephemeral port: the RemoteReplica follows
+            # the process object's portfile — no fleet rebuild
+            old_address = replicas[victim].address
+            servers[1].respawn()
+            assert replicas[victim].address != old_address
+            fleet.poll()
+            assert fleet.health()[victim] == "healthy"
+
+            # the probe user's state died with the process: its home answers
+            # again, riding the cold-miss fallback rung rather than erroring
+            revived = fleet.score(probe, timeout=30)
+            assert revived.replica == victim
+            assert revived.served_by == "fallback"

@@ -1,0 +1,69 @@
+"""Worker for the SIGKILL-mid-save checkpoint atomicity test.
+
+Phase "baseline" writes checkpoint step 1 and exits cleanly. The kill phases
+then attempt step 2 but die by real SIGKILL at a chosen point inside
+``save_pytree`` — the patched ``_atomic_replace`` pins WHERE in the write
+sequence the kill lands (the kill itself is the genuine uncatchable signal,
+the patch only makes its timing deterministic):
+
+* ``mid_payload``  — dies while the ``.npz`` payload bytes are still going to
+  the ``.tmp`` sibling: the visible directory must show a stray tmp, never a
+  torn ``step_2.npz``;
+* ``pre_sidecar``  — dies after the payload was atomically published but
+  before the JSON commit marker: ``step_2.npz`` exists, ``step_2.json`` does
+  not, and the manager must treat the step as never-saved.
+
+The parent test (tests/utils/test_checkpoint.py) asserts ``valid_steps``
+skips the partial step and that step 1 restores bit-identically afterwards.
+"""
+
+import os
+import signal
+import sys
+
+import numpy as np
+
+import replay_tpu.utils.checkpoint as ck
+from replay_tpu.utils.checkpoint import CheckpointManager
+
+
+def make_tree(step: int) -> dict:
+    rng = np.random.default_rng(7)
+    return {
+        "w": rng.normal(size=(64, 16)).astype(np.float32),
+        "b": rng.normal(size=(16,)).astype(np.float32),
+        "step": np.int64(step),
+    }
+
+
+def main() -> None:
+    ckpt_dir, phase = sys.argv[1], sys.argv[2]
+    manager = CheckpointManager(ckpt_dir, max_to_keep=10)
+    if phase == "baseline":
+        manager.save(1, make_tree(1))
+        assert manager.latest_step() == 1
+        return
+
+    original = ck._atomic_replace
+
+    def killing_replace(path, write):
+        if phase == "mid_payload" and path.suffix == ".npz":
+            # some payload bytes reached the tmp sibling, then the OS kill —
+            # exactly the on-disk state a preemption mid-write leaves behind
+            with open(path.with_name(path.name + ".tmp"), "wb") as fh:
+                fh.write(b"\x00" * 128)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.kill(os.getpid(), signal.SIGKILL)
+        if phase == "pre_sidecar" and path.name.startswith("step_") and path.suffix == ".json":
+            # payload published, commit marker not yet written
+            os.kill(os.getpid(), signal.SIGKILL)
+        original(path, write)
+
+    ck._atomic_replace = killing_replace
+    manager.save(2, make_tree(2))
+    raise AssertionError(f"phase {phase} survived save(2)")  # pragma: no cover
+
+
+if __name__ == "__main__":
+    main()

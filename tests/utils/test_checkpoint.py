@@ -1,5 +1,7 @@
 """Checkpointing: pytree round-trip, retention, kill-and-resume loss-curve parity."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -110,6 +112,81 @@ def test_manager_retention_and_history(tmp_path):
     assert manager.history()[-1]["epoch"] == 3
     with pytest.raises(FileNotFoundError):
         CheckpointManager(str(tmp_path / "empty")).restore({"w": np.zeros(3)})
+
+
+@pytest.mark.jax
+def test_sigkill_mid_save_never_corrupts_the_manager(tmp_path):
+    """Hard-kill atomicity: a writer SIGKILLed inside ``save_pytree`` — while
+    payload bytes are in flight, or after the payload but before the JSON
+    commit marker — leaves the directory in a state where ``valid_steps``
+    skips the partial step and the PRIOR step restores bit-identically."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    worker = Path(__file__).with_name("ckpt_kill_worker.py")
+    ckpt_dir = tmp_path / "ckpt"
+
+    def run(phase):
+        return subprocess.run(
+            [sys.executable, str(worker), str(ckpt_dir), phase],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": str(worker.parents[2])},
+        )
+
+    assert run("baseline").returncode == 0, "baseline save failed"
+    step1_npz = (ckpt_dir / "step_1.npz").read_bytes()
+    step1_json = (ckpt_dir / "step_1.json").read_bytes()
+
+    import signal as _signal
+
+    for phase in ("mid_payload", "pre_sidecar"):
+        proc = run(phase)
+        assert proc.returncode == -_signal.SIGKILL, (phase, proc.stderr[-500:])
+        manager = CheckpointManager(str(ckpt_dir), max_to_keep=10)
+        assert manager.valid_steps() == [1], phase
+        assert manager.latest_step() == 1, phase
+        # the partial step never becomes a visible, torn checkpoint
+        if phase == "mid_payload":
+            assert (ckpt_dir / "step_2.npz.tmp").exists()
+            assert not (ckpt_dir / "step_2.npz").exists()
+        else:
+            assert (ckpt_dir / "step_2.npz").exists()  # payload published...
+            assert not (ckpt_dir / "step_2.json").exists()  # ...never committed
+        # the prior step's files are byte-identical and restore exactly
+        assert (ckpt_dir / "step_1.npz").read_bytes() == step1_npz, phase
+        assert (ckpt_dir / "step_1.json").read_bytes() == step1_json, phase
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("ckpt_kill_worker", worker)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        expected = module.make_tree(1)
+        restored = manager.restore(
+            {k: np.zeros_like(v) for k, v in expected.items()}, step=1
+        )
+        for key in expected:
+            np.testing.assert_array_equal(restored[key], expected[key])
+        # cleanup for the next phase: kill the stray step-2 leftovers
+        for leftover in ckpt_dir.glob("step_2*"):
+            leftover.unlink()
+
+
+@pytest.mark.jax
+def test_process_metadata_sidecar_roundtrip_and_rotation(tmp_path):
+    """Per-process sidecars: written atomically by each rank, read back by
+    the same rank, and rotated away with their step."""
+    manager = CheckpointManager(str(tmp_path / "run"), max_to_keep=1)
+    tree = {"w": jnp.ones(3)}
+    cursor = {"stream_cursor": {"epoch": 0, "slab": 2, "rows": 8, "batches": 5}}
+    manager.save(1, tree, process_metadata=cursor)
+    assert manager.process_metadata(1) == cursor
+    assert manager.process_metadata(1, process_index=7) == {}  # another rank's
+    assert manager.process_metadata(99) == {}  # absent step
+    manager.save(2, tree, process_metadata={"stream_cursor": {"batches": 9}})
+    assert manager.all_steps() == [2]  # step 1 rotated out...
+    assert manager.process_metadata(1) == {}  # ...with its process sidecar
+    assert manager.process_metadata(2)["stream_cursor"]["batches"] == 9
 
 
 @pytest.mark.jax

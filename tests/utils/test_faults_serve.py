@@ -5,6 +5,9 @@ trainer's fault-tolerance suite; these cover the callable injectors the serve
 chaos harness wraps around ``ScoringEngine`` methods.
 """
 
+import signal
+import subprocess
+import sys
 import time
 
 import pytest
@@ -12,6 +15,7 @@ import pytest
 from replay_tpu.utils.faults import (
     EngineErrorAt,
     InjectedFault,
+    KillAtStep,
     LatencySpike,
     wrap_method,
 )
@@ -66,6 +70,55 @@ class TestLatencySpike:
         assert slow >= 0.08
         assert fast < slow
         assert spike.injected_at == [1]
+
+
+class TestKillAtStep:
+    def test_wrap_sigkills_own_process_at_the_step(self, tmp_path):
+        """The hard-kill contract: the child dies with SIGKILL mid-stream,
+        no cleanup runs, and exactly ``at_step`` batches made it out."""
+        progress = tmp_path / "progress.txt"
+        script = (
+            "import atexit, sys\n"
+            "from replay_tpu.utils.faults import KillAtStep\n"
+            "atexit.register(lambda: sys.stderr.write('CLEANUP RAN\\n'))\n"
+            "with open(sys.argv[1], 'w') as fh:\n"
+            "    for batch in KillAtStep(at_step=3).wrap(iter(range(10))):\n"
+            "        fh.write(f'{batch}\\n')\n"
+            "        fh.flush()\n"
+            "sys.stderr.write('SURVIVED\\n')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script, str(progress)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == -signal.SIGKILL
+        assert "SURVIVED" not in proc.stderr
+        assert "CLEANUP RAN" not in proc.stderr  # SIGKILL: no handlers, no atexit
+        assert progress.read_text().split() == ["0", "1", "2"]
+
+    def test_fire_kills_a_target_pid(self):
+        """The fleet-chaos mode: retarget an arbitrary replica process."""
+        victim = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        try:
+            injector = KillAtStep(pid=victim.pid)
+            injector.fire()
+            assert victim.wait(timeout=30) == -signal.SIGKILL
+            assert injector.fired
+        finally:
+            if victim.poll() is None:
+                victim.kill()
+                victim.wait()
+
+    def test_fires_at_most_once(self):
+        """A SIGTERM-tolerant double-iteration must not re-kill: ``fired``
+        latches (mirrors SignalAtStep.raised)."""
+        sent = []
+        injector = KillAtStep(at_step=1, pid=99999999, sig=signal.SIGKILL)
+        injector.fire = lambda: (sent.append(1), setattr(injector, "fired", True))
+        assert list(injector.wrap(iter(range(4)))) == [0, 1, 2, 3]
+        assert sent == [1]
 
 
 class TestWrapMethod:
