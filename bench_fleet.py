@@ -504,6 +504,7 @@ def _run_socket_chaos(seconds: float):
 
     repo_root = os.path.dirname(os.path.abspath(__file__))
     env = clean_cpu_env(local_devices=1, repo_root=repo_root)
+    os.makedirs(RUN_DIR, exist_ok=True)
     spawn_start = time.perf_counter()
     servers = [
         ReplicaServerProcess(
@@ -512,8 +513,11 @@ def _run_socket_chaos(seconds: float):
                 "--num-items", "64", "--seq-len", "12",
                 "--embedding-dim", "8", "--num-blocks", "1",
             ],
+            # each server records into its own flight ring: the SIGKILLed
+            # one's last serve events are read back below (obs.blackbox)
+            flight_path=os.path.join(RUN_DIR, f"flight.s{i}.ring"),
         )
-        for _ in range(SOCKET_REPLICAS)
+        for i in range(SOCKET_REPLICAS)
     ]
     try:
         for server in servers:  # engines compile concurrently
@@ -560,6 +564,22 @@ def _run_socket_chaos(seconds: float):
             kill_at = time.perf_counter()
             KillAtStep(pid=victim_server.pid).fire()
             sigkill_rc = victim_server.proc.wait(timeout=10)
+
+            # harvest the black box NOW, before respawn() reopens the same
+            # ring and continues it — this read is the dead incarnation's
+            # post-mortem: last recorded seqno, recovered records, torn tail
+            from replay_tpu.obs.blackbox import read_flight
+
+            try:
+                flight = read_flight(victim_server.flight_path)
+                flight_last_seqno = flight.last_seqno
+                flight_recovered = flight.recovered
+                flight_torn_tail = flight.torn_tail
+            except (OSError, ValueError) as exc:
+                print(f"flight ring unreadable after SIGKILL: {exc!r}")
+                flight_last_seqno = None
+                flight_recovered = 0
+                flight_torn_tail = None
 
             failover_gap_ms = None
             failover_replica = None
@@ -613,6 +633,11 @@ def _run_socket_chaos(seconds: float):
             "taxonomy_only": errors_by_kind.get("error", 0) == 0,
             "p99_ms": record.get("p99_ms"),
             "spawn_seconds": round(spawn_seconds, 2),
+            # the dead server's flight ring, read back post-SIGKILL: proof
+            # the black box survives a kill -9 with its records intact
+            "flight_last_seqno": flight_last_seqno,
+            "flight_records_recovered": flight_recovered,
+            "torn_tail": flight_torn_tail,
         }
     finally:
         for server in servers:

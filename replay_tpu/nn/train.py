@@ -1337,6 +1337,7 @@ class Trainer:
         trace_path: Optional[str] = None,
         metrics_port: Optional[int] = None,
         slo_rules: Optional[Sequence[Any]] = None,
+        flight_path: Optional[str] = None,
     ) -> TrainState:
         """Train for ``epochs`` passes; validates after each epoch when
         ``val_batches`` is given, appending to :attr:`history`. A dict of
@@ -1488,6 +1489,19 @@ class Trainer:
         :attr:`metrics_registry`. Multi-host fits stamp every event with this
         process's ``process_index`` so ``obs.report`` can merge per-process
         shards and compute cross-host skew.
+
+        Black box (docs/observability.md "The black box and post-mortems"):
+        ``flight_path`` attaches a
+        :class:`~replay_tpu.obs.BlackboxLogger` — the same event stream,
+        recorded into an mmap-backed flight ring whose last N records survive
+        SIGKILL (``obs.report --postmortem`` reads what a dead fit was doing).
+        Defaults from the ``REPLAY_TPU_FLIGHT_PATH`` env var, which
+        ``launch_workers(run_dir=...)`` sets per rank — a worker script needs
+        no change to be flight-recorded. Implies per-step events, like any
+        explicit sink. On preemption (SIGTERM/SIGINT) the tracer is flushed
+        to ``trace_path`` at the ``on_preemption`` boundary — before the
+        shutdown-window checkpoint save — so the span tree survives even if
+        the save itself dies.
         """
         if checkpoint_manager is not None and not self.history:
             # resume: prior epoch records survive the restart (metric-history
@@ -1667,8 +1681,41 @@ class Trainer:
             explicit_loggers.append(metrics_logger)
             if metrics_port is not None:
                 self.metrics_exporter = MetricsExporter(
-                    metrics_logger.registry, port=metrics_port
+                    metrics_logger.registry,
+                    port=metrics_port,
+                    # the identity block /snapshot and /healthz carry, so a
+                    # federation scrape can label this fit's series
+                    identity={"process_index": jax.process_index()},
                 ).start()
+        # -- the black box (obs.blackbox): SIGKILL-surviving flight ring ----- #
+        # attaching the sink IS the instrumentation: the same event stream
+        # every other sink sees, stored as O(1) in-place mmap ring writes.
+        # launch_workers(run_dir=...) hands workers their ring path via env,
+        # so a fit inside a launched worker is flight-recorded with no
+        # worker-script change.
+        flight_path = flight_path or os.environ.get("REPLAY_TPU_FLIGHT_PATH")
+        flight_logger = None
+        if flight_path:
+            from replay_tpu.obs.blackbox import BlackboxLogger
+
+            try:
+                flight_logger = BlackboxLogger(
+                    flight_path,
+                    meta={
+                        "role": "fit",
+                        "pid": os.getpid(),
+                        "process_index": jax.process_index(),
+                    },
+                )
+            except OSError as exc:
+                # same posture as the exporter: the black box must never take
+                # down the run it records
+                logger.warning(
+                    "flight recorder: cannot open %s (%s); fit runs unrecorded",
+                    flight_path, exc,
+                )
+            else:
+                explicit_loggers.append(flight_logger)
         sinks: List[RunLogger] = list(explicit_loggers)
         if log_every:
             # events already arrive at log_every cadence when no explicit
@@ -1750,6 +1797,10 @@ class Trainer:
             if self.metrics_exporter is not None:
                 self.metrics_exporter.close()
                 self.metrics_exporter = None
+            if flight_logger is not None:
+                # one msync so the ring survives machine death up to here;
+                # SIGKILL-durability never depended on this close running
+                flight_logger.close()
 
         # multi-host: stamp every event with this process's index so per-
         # process events.jsonl shards merge into ONE cross-host report
@@ -1763,6 +1814,19 @@ class Trainer:
                 run_logger.log_event(
                     TrainerEvent(event=name, step=step, epoch=epoch, payload=payload)
                 )
+            if name == "on_preemption" and tracing and trace_path is not None:
+                # flush the span tree NOW — the preemption paths emit this
+                # BEFORE the shutdown-window checkpoint save, so even a save
+                # that raises or a scheduler that stops waiting cannot lose
+                # the trace of the run being evicted (on_fit_end re-saves
+                # over this with the complete tree when it does run)
+                try:
+                    trace.save(trace_path)
+                except OSError as exc:
+                    logger.warning(
+                        "trace.json not written to %s at preemption: %s",
+                        trace_path, exc,
+                    )
             if name == "on_fit_end":
                 # every non-raising fit exit path ends in exactly one
                 # on_fit_end; the raising paths call finish_trace themselves
@@ -2479,11 +2543,15 @@ class Trainer:
                                     boundary_saved = True
                                 if preemption is not None and preemption.requested:
                                     # chunk-boundary preemption exit (same
-                                    # contract as the per-step path)
-                                    if checkpoint_manager is not None and not boundary_saved:
-                                        save_mid_epoch(preempted=True)
+                                    # contract as the per-step path); the
+                                    # event — and the trace flush it carries —
+                                    # lands BEFORE the shutdown-window save,
+                                    # so a save that dies cannot take the
+                                    # span tree with it
                                     emit("on_preemption", step=int(state.step),
                                          epoch=epoch, signal=preemption.signal_name)
+                                    if checkpoint_manager is not None and not boundary_saved:
+                                        save_mid_epoch(preempted=True)
                                     logger.warning(
                                         "preemption: checkpoint saved at step %d; "
                                         "exiting fit",
@@ -2553,10 +2621,10 @@ class Trainer:
                         # landed on this same step already recorded the
                         # position — don't serialize the state twice in the
                         # shutdown window.
-                        if checkpoint_manager is not None and not boundary_saved:
-                            save_mid_epoch(preempted=True)
                         emit("on_preemption", step=int(state.step), epoch=epoch,
                              signal=preemption.signal_name)
+                        if checkpoint_manager is not None and not boundary_saved:
+                            save_mid_epoch(preempted=True)
                         logger.warning(
                             "preemption: checkpoint saved at step %d; exiting fit",
                             int(state.step),

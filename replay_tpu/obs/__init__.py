@@ -22,10 +22,18 @@ registry (counters/gauges/histograms) bridged from the same event stream,
 :mod:`.exporter` serves it as a scrapeable Prometheus ``/metrics`` endpoint
 (+ ``/snapshot`` JSON), and :mod:`.slo` evaluates declarative threshold rules
 at step/batch cadence, emitting ``on_slo_violation`` through the same sinks.
+The POST-MORTEM half: :mod:`.blackbox` is the SIGKILL-proof flight recorder
+(an mmap ring every sink family bridges into; ``read_flight`` tolerates the
+torn final record), ``obs.report --postmortem`` reconstructs a dead fleet's
+last-known-activity timelines from rings + event shards + checkpoint
+sidecars, and :mod:`.federate` merges N per-process ``/snapshot`` exporters
+into ONE fleet-level ``/metrics``.
 Beyond-parity — SURVEY.md §5.
 """
 
+from .blackbox import BlackboxLogger, FlightLog, FlightRecorder, read_flight
 from .collectors import CompileTracker, MemoryMonitor, StepTelemetry
+from .federate import FleetFederator, federate_snapshots, scrape_snapshot
 from .health import HealthConfig, HealthWatcher, flatten_health, health_metrics
 from .events import (
     ConsoleLogger,
@@ -68,8 +76,12 @@ from .trace import (
 )
 
 __all__ = [
+    "BlackboxLogger",
     "CompileTracker",
     "ConsoleLogger",
+    "FleetFederator",
+    "FlightLog",
+    "FlightRecorder",
     "GOODPUT_SPANS",
     "HealthConfig",
     "HealthWatcher",
@@ -96,6 +108,7 @@ __all__ = [
     "attribute_capture",
     "classify",
     "cost_analysis",
+    "federate_snapshots",
     "flatten_health",
     "flops_per_step",
     "goodput_breakdown",
@@ -108,7 +121,9 @@ __all__ = [
     "peak_bandwidth",
     "peak_tflops",
     "program_costs",
+    "read_flight",
     "scope_of",
+    "scrape_snapshot",
     "tail_attribution",
     "traced_iterator",
 ]

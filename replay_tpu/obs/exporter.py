@@ -7,7 +7,9 @@ its own short-lived thread, so a slow scraper never blocks the next one):
 * ``GET /metrics``  — Prometheus text exposition format, rendered in one pass
   under the registry lock (no torn lines, counters monotone across scrapes);
 * ``GET /snapshot`` — the full registry as JSON, including histogram quantile
-  estimates (the artifact CI uploads);
+  estimates (the artifact CI uploads), plus a reserved ``__identity__`` block
+  (``process_index``/``pid``/``start_unix``) so a federation scraper
+  (``obs.federate``) or a post-mortem can label and age every scrape;
 * ``GET /healthz``  — liveness probe. Plain ``ok`` by default (the shape
   existing probes assert on); with ``?format=json`` or an
   ``Accept: application/json`` header it returns the structured health
@@ -35,7 +37,9 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
 
@@ -66,9 +70,12 @@ class _Handler(BaseHTTPRequestHandler):
                 body = self.server.registry.render_prometheus().encode()
                 self._respond(200, PROMETHEUS_CONTENT_TYPE, body)
             elif path == "/snapshot":
-                body = json.dumps(
-                    self.server.registry.snapshot(), indent=2, default=str
-                ).encode()
+                snapshot = self.server.registry.snapshot()
+                # identity rides under a reserved non-metric key so the
+                # federation scraper and the post-mortem report can label and
+                # age every scrape without a second round trip
+                snapshot["__identity__"] = self.server.identity
+                body = json.dumps(snapshot, indent=2, default=str).encode()
                 self._respond(200, "application/json", body)
             elif path == "/healthz":
                 wants_json = "format=json" in query or "application/json" in (
@@ -90,9 +97,13 @@ class _Handler(BaseHTTPRequestHandler):
             if source is not None:
                 health = dict(source())
         except Exception as exc:  # noqa: BLE001 — a broken source IS the signal
-            body = json.dumps({"live": False, "error": repr(exc)}).encode()
+            body = json.dumps(
+                {"live": False, "error": repr(exc), **self.server.identity}
+            ).encode()
             self._respond(503, "application/json", body)
             return
+        for key, value in self.server.identity.items():
+            health.setdefault(key, value)  # the source's own fields win
         body = json.dumps(health, default=str).encode()
         self._respond(200, "application/json", body)
 
@@ -108,6 +119,7 @@ class _Server(ThreadingHTTPServer):
     allow_reuse_address = True
     registry: MetricsRegistry
     health_source: Optional[Callable[[], Dict[str, Any]]]
+    identity: Dict[str, Any]
 
 
 class MetricsExporter:
@@ -126,11 +138,22 @@ class MetricsExporter:
         port: int = 9100,
         host: str = "127.0.0.1",
         health_source: Optional[Callable[[], Dict[str, Any]]] = None,
+        identity: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.registry = registry
         self.requested_port = int(port)
         self.host = host
         self.health_source = health_source
+        # who answers this port: the identity block /snapshot and /healthz
+        # carry so a federation scraper (or a post-mortem) can label every
+        # scrape with the process it came from and age it by start time
+        self.identity: Dict[str, Any] = {
+            "process_index": int(os.environ.get("REPLAY_TPU_PROCESS_ID", 0) or 0),
+            "pid": os.getpid(),
+            "start_unix": time.time(),
+        }
+        if identity:
+            self.identity.update(identity)
         self._server: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -161,6 +184,7 @@ class MetricsExporter:
             return self
         server.registry = self.registry
         server.health_source = self.health_source
+        server.identity = self.identity
         self._server = server
         self._thread = threading.Thread(
             target=server.serve_forever,
@@ -171,6 +195,14 @@ class MetricsExporter:
         self._thread.start()
         logger.info("metrics exporter serving on %s", self.url)
         return self
+
+    def set_registry(self, registry: MetricsRegistry) -> None:
+        """Swap the served registry atomically (the federation scraper builds
+        a fresh merged registry per pass). In-flight requests finish against
+        whichever registry they resolved — both are internally consistent."""
+        self.registry = registry
+        if self._server is not None:
+            self._server.registry = registry
 
     def close(self) -> None:
         server, thread = self._server, self._thread

@@ -2034,7 +2034,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="emit the summary as JSON instead of text"
     )
+    parser.add_argument(
+        "--postmortem",
+        action="store_true",
+        help="reconstruct per-process last-known-activity timelines from "
+        "flight rings + event shards + worker meta + checkpoint sidecars "
+        "(obs.postmortem); writes <run_dir>/postmortem.json. Torn rings and "
+        "damaged shards are reported, never fatal.",
+    )
     args = parser.parse_args(argv)
+
+    if args.postmortem:
+        from .postmortem import build_postmortem, render_postmortem
+
+        try:
+            post = build_postmortem(args.run)
+        except (OSError, ValueError) as exc:
+            print(f"report: cannot post-mortem {args.run}: {exc}", file=sys.stderr)
+            return 1
+        out_path = os.path.join(args.run, "postmortem.json")
+        with open(out_path, "w") as fh:
+            json.dump(post, fh, indent=2, default=str)
+            fh.write("\n")
+        if args.json:
+            print(json.dumps(post, indent=2, default=str))
+        else:
+            print(render_postmortem(post))
+            print(f"  written: {out_path}")
+        return 0
 
     try:
         summary = summarize_run(args.run)

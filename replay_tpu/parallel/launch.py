@@ -35,6 +35,7 @@ sitecustomize, forced CPU platform, N virtual devices per process).
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import signal
@@ -85,13 +86,21 @@ def clean_cpu_env(
 
 @dataclasses.dataclass
 class WorkerResult:
-    """One worker's outcome: its rank, how it exited, and what it printed."""
+    """One worker's outcome: its rank, how it exited, and what it printed.
+
+    With ``launch_workers(run_dir=...)``, ``artifacts_dir`` names the rank's
+    persisted forensic directory (``<run_dir>/workers/rank<i>/``: full
+    stdout/stderr spools + ``meta.json`` on abnormal exit, and the worker's
+    flight ring if it recorded one) and ``flight_path`` the ring path the
+    worker was handed — ``None`` without a run_dir."""
 
     rank: int
     returncode: Optional[int]
     stdout: str
     stderr: str
     reaped: bool = False  # launcher had to SIGKILL it after a peer died/hung
+    artifacts_dir: Optional[str] = None
+    flight_path: Optional[str] = None
 
     @property
     def killed_by(self) -> Optional[int]:
@@ -115,6 +124,7 @@ def launch_workers(
     check: bool = True,
     pass_rank_argv: bool = True,
     python: str = sys.executable,
+    run_dir: Optional[str] = None,
 ) -> List[WorkerResult]:
     """Run ``num_processes`` copies of ``script`` as one distributed job.
 
@@ -129,12 +139,27 @@ def launch_workers(
     bounds the whole job the same way. With ``check=True`` any nonzero or
     reaped worker raises :class:`LaunchError` carrying the stderr tails;
     chaos callers pass ``check=False`` and assert on the results directly.
+
+    ``run_dir`` turns the launch forensic: every rank is handed a flight-ring
+    path (``REPLAY_TPU_FLIGHT_PATH`` → ``<run_dir>/workers/rank<i>/
+    flight.ring``, which ``Trainer.fit`` picks up with no worker change —
+    the worker's last records survive its SIGKILL in the ring), and on
+    abnormal exit (nonzero, signaled, or reaped) the rank's FULL stdout/
+    stderr spools plus a ``meta.json`` (returncode, ``killed_by``, reaped)
+    are persisted next to it — the artifacts CI uploads and
+    ``obs.report --postmortem`` merges. A :class:`LaunchError` then names
+    the persisted paths instead of only quoting stderr tails.
     """
     if num_processes < 1:
         msg = f"num_processes must be >= 1, got {num_processes}"
         raise ValueError(msg)
     coordinator = f"127.0.0.1:{free_port()}"
     base_env = dict(env if env is not None else os.environ)
+    rank_dirs: List[Optional[Path]] = [None] * num_processes
+    if run_dir is not None:
+        for rank in range(num_processes):
+            rank_dirs[rank] = Path(run_dir) / "workers" / f"rank{rank}"
+            rank_dirs[rank].mkdir(parents=True, exist_ok=True)
     spools = []
     workers: List[subprocess.Popen] = []
     try:
@@ -145,6 +170,10 @@ def launch_workers(
                 "REPLAY_TPU_NUM_PROCESSES": str(num_processes),
                 "REPLAY_TPU_PROCESS_ID": str(rank),
             }
+            if rank_dirs[rank] is not None:
+                worker_env["REPLAY_TPU_FLIGHT_PATH"] = str(
+                    rank_dirs[rank] / "flight.ring"
+                )
             argv = [python, str(script)]
             if pass_rank_argv:
                 argv += [str(rank), coordinator]
@@ -186,15 +215,21 @@ def launch_workers(
             worker.wait(timeout=30)
             out.seek(0)
             err.seek(0)
-            results.append(
-                WorkerResult(
-                    rank=rank,
-                    returncode=worker.returncode,
-                    stdout=out.read().decode(errors="replace"),
-                    stderr=err.read().decode(errors="replace"),
-                    reaped=reaped[rank],
-                )
+            rank_dir = rank_dirs[rank]
+            result = WorkerResult(
+                rank=rank,
+                returncode=worker.returncode,
+                stdout=out.read().decode(errors="replace"),
+                stderr=err.read().decode(errors="replace"),
+                reaped=reaped[rank],
             )
+            if rank_dir is not None:
+                result.flight_path = str(rank_dir / "flight.ring")
+                if result.returncode != 0 or result.reaped:
+                    result.artifacts_dir = str(
+                        _persist_worker_artifacts(rank_dir, result)
+                    )
+            results.append(result)
     finally:
         for worker in workers:  # never leak a live worker past the call
             if worker.poll() is None:
@@ -208,10 +243,30 @@ def launch_workers(
         bad = [r for r in results if r.returncode != 0 or r.reaped]
         if bad:
             details = "\n".join(
-                f"rank {r.rank}: returncode={r.returncode} reaped={r.reaped}\n"
-                f"{r.stderr[-2000:]}"
+                f"rank {r.rank}: returncode={r.returncode} reaped={r.reaped}"
+                + (f" artifacts={r.artifacts_dir}" if r.artifacts_dir else "")
+                + f"\n{r.stderr[-2000:]}"
                 for r in bad
             )
             msg = f"{len(bad)}/{num_processes} workers failed:\n{details}"
             raise LaunchError(msg)
     return results
+
+
+def _persist_worker_artifacts(rank_dir: Path, result: WorkerResult) -> Path:
+    """Write a dead worker's full spools + exit metadata into its rank dir.
+
+    The in-memory :class:`WorkerResult` dies with the test process; CI (and
+    ``obs.report --postmortem``) need the evidence on disk next to the flight
+    ring. Full spools — the 2000-char stderr tail in :class:`LaunchError` is
+    for humans reading an exception, not for forensics."""
+    (rank_dir / "stdout.log").write_text(result.stdout, errors="replace")
+    (rank_dir / "stderr.log").write_text(result.stderr, errors="replace")
+    meta = {
+        "rank": result.rank,
+        "returncode": result.returncode,
+        "killed_by": result.killed_by,
+        "reaped": result.reaped,
+    }
+    (rank_dir / "meta.json").write_text(json.dumps(meta, indent=2) + "\n")
+    return rank_dir

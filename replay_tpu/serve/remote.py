@@ -294,6 +294,15 @@ class ReplicaServer:
             with open(tmp, "w") as fh:
                 fh.write(self.address)
             os.replace(tmp, self.portfile)
+            exporter = getattr(self.service, "metrics_exporter", None)
+            if exporter is not None and exporter.url is not None:
+                # the ephemeral metrics port, published the same atomic way,
+                # so a federation scraper (obs.federate) can find every
+                # replica's /snapshot without a fixed-port convention
+                tmp = f"{self.portfile}.metrics.tmp"
+                with open(tmp, "w") as fh:
+                    fh.write(exporter.url)
+                os.replace(tmp, f"{self.portfile}.metrics")
         logger.info("replica server on %s", self.address)
         return self
 
@@ -504,9 +513,16 @@ class ReplicaServerProcess:
         args: Sequence[str] = (),
         python: str = sys.executable,
         startup_timeout_s: float = 120.0,
+        flight_path: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ) -> None:
         self._env = dict(env) if env is not None else dict(os.environ)
         self._args = [str(a) for a in args]
+        if flight_path is not None:
+            self._args += ["--flight-path", str(flight_path)]
+        if metrics_port is not None:
+            self._args += ["--metrics-port", str(metrics_port)]
+        self.flight_path = flight_path
         self._python = python
         self._startup_timeout_s = float(startup_timeout_s)
         self._dir = tempfile.mkdtemp(prefix="replica_server_")
@@ -522,6 +538,16 @@ class ReplicaServerProcess:
     def address(self) -> str:
         with open(self.portfile) as fh:
             return fh.read().strip()
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        """The replica's published metrics exporter URL (``--metrics-port``),
+        or ``None`` before the server wrote ``<portfile>.metrics``."""
+        try:
+            with open(f"{self.portfile}.metrics") as fh:
+                return fh.read().strip()
+        except OSError:
+            return None
 
     def spawn(self, wait: bool = True) -> "ReplicaServerProcess":
         """Start the server process. ``wait=False`` returns immediately so N
@@ -603,6 +629,8 @@ def _build_demo_service(
     num_blocks: int,
     cache_capacity: int,
     max_wait_ms: float,
+    flight_path: Optional[str] = None,
+    metrics_port: Optional[int] = None,
 ):
     """The tiny deterministic SasRec service every demo replica runs: seed 0
     everywhere, so N independently-spawned servers hold IDENTICAL params and
@@ -646,6 +674,8 @@ def _build_demo_service(
         cache_capacity=cache_capacity,
         cold_miss="fallback",
         fallback=fallback,
+        flight_path=flight_path,
+        metrics_port=metrics_port,
     )
 
 
@@ -661,6 +691,19 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--num-blocks", type=int, default=1)
     parser.add_argument("--cache", type=int, default=512)
     parser.add_argument("--max-wait-ms", type=float, default=2.0)
+    parser.add_argument(
+        "--flight-path",
+        default=None,
+        help="record serve events into a SIGKILL-proof flight ring here "
+        "(obs.blackbox); defaults to $REPLAY_TPU_FLIGHT_PATH",
+    )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve /metrics + /snapshot on this port (0 = ephemeral, "
+        "published to <portfile>.metrics for federation scrapers)",
+    )
     args = parser.parse_args(argv)
 
     service = _build_demo_service(
@@ -670,6 +713,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         num_blocks=args.num_blocks,
         cache_capacity=args.cache,
         max_wait_ms=args.max_wait_ms,
+        flight_path=args.flight_path,
+        metrics_port=args.metrics_port,
     )
     ReplicaServer(service, port=args.port, portfile=args.portfile).serve_forever()
 

@@ -53,6 +53,8 @@ gates on.
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -74,6 +76,8 @@ from .futures import mark_running, safe_fail, safe_set_result
 from .pipeline import CandidatePipeline
 from .promote import ROLES, ParamStore, in_canary_slice
 from .request import PendingRequest, ScoreRequest, ScoreResponse, make_window
+
+logger = logging.getLogger("replay_tpu")
 
 
 class ScoringService:
@@ -111,6 +115,11 @@ class ScoringService:
         keeps the degradation visible). ``new_items`` requests error in BOTH
         modes — an interaction that cannot land must never be masked by a
         success response.
+    :param flight_path: record every serve event into a SIGKILL-proof mmap
+        flight ring (:mod:`replay_tpu.obs.blackbox`) at this path. Defaults
+        to ``$REPLAY_TPU_FLIGHT_PATH`` when set. A SIGKILLed replica's last
+        batches, sheds and breaker flips stay readable via ``read_flight``
+        — the evidence ``obs.report --postmortem`` reconstructs.
     """
 
     def __init__(
@@ -136,6 +145,7 @@ class ScoringService:
         slo_rules: Optional[Sequence[Any]] = None,
         param_store: Optional[ParamStore] = None,
         cold_miss: str = "error",
+        flight_path: Optional[str] = None,
     ) -> None:
         if retrieval is not None and candidates is not None:
             msg = "retrieval mode and a fixed candidate slate are mutually exclusive"
@@ -251,6 +261,23 @@ class ScoringService:
                     port=metrics_port,
                     health_source=self.heartbeat,
                 )
+        # flight recorder (obs.blackbox): same attach-the-sink pattern — the
+        # _emit fan-out carries every serve event into the SIGKILL-proof ring
+        self._blackbox = None
+        flight_path = flight_path or os.environ.get("REPLAY_TPU_FLIGHT_PATH")
+        if flight_path:
+            from replay_tpu.obs.blackbox import BlackboxLogger
+
+            try:
+                self._blackbox = BlackboxLogger(
+                    flight_path,
+                    meta={"role": "serve", "pid": os.getpid(), "mode": self.mode},
+                )
+            except OSError as exc:
+                logger.warning(
+                    "flight recorder: cannot open %s (%s); service runs unrecorded",
+                    flight_path, exc,
+                )
 
     # -- lifecycle ---------------------------------------------------------- #
     def start(self) -> "ScoringService":
@@ -298,6 +325,10 @@ class ScoringService:
             spans=SERVE_GOODPUT_SPANS,
         )
         self._emit("on_serve_end", payload)
+        if self._blackbox is not None:
+            # one msync after the terminal event — durability against machine
+            # loss; SIGKILL durability never depended on this close landing
+            self._blackbox.close()
         if self.metrics_exporter is not None:
             # after the terminal event: the final gauges (hit rate, shed
             # rate) land in the registry before the endpoint disappears, and
@@ -1334,15 +1365,22 @@ class ScoringService:
 
     # -- accounting --------------------------------------------------------- #
     def _route_event(self, event: TrainerEvent) -> None:
-        """Fan one event out to the metrics bridge and the user sink (the
-        SLO watchdog's emit target too, so violations land in both)."""
+        """Fan one event out to the metrics bridge, the flight ring and the
+        user sink (the SLO watchdog's emit target too, so violations land in
+        all of them)."""
         if self._metrics_logger is not None:
             self._metrics_logger.log_event(event)
+        if self._blackbox is not None:
+            self._blackbox.log_event(event)
         if self.logger is not None:
             self.logger.log_event(event)
 
     def _emit(self, event: str, payload: Dict[str, Any]) -> None:
-        if self._metrics_logger is None and self.logger is None:
+        if (
+            self._metrics_logger is None
+            and self._blackbox is None
+            and self.logger is None
+        ):
             return
         self._route_event(TrainerEvent(event=event, payload=payload))
 

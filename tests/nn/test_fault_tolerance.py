@@ -344,6 +344,45 @@ def test_sigterm_mid_epoch_then_resume_is_bit_for_bit(tmp_path):
 
 
 @pytest.mark.jax
+def test_preemption_saves_trace_and_flight_ring(tmp_path, monkeypatch):
+    """A preempted traced fit must not lose its span tree: ``trace.json`` is
+    flushed eagerly at the ``on_preemption`` emission — BEFORE the shutdown-
+    window checkpoint save, so even a save that dies cannot take the trace
+    with it — and the flight ring (``REPLAY_TPU_FLIGHT_PATH``) holds the
+    preemption as its final records."""
+    from replay_tpu.obs.blackbox import read_flight
+    from replay_tpu.obs.report import load_trace_events
+
+    trace_path = str(tmp_path / "trace.json")
+    ring_path = str(tmp_path / "flight.ring")
+    monkeypatch.setenv("REPLAY_TPU_FLIGHT_PATH", ring_path)
+
+    sig = SignalAtStep(2)
+    trainer = make_trainer()
+    manager = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=100)
+    state = trainer.fit(
+        lambda epoch: sig.wrap([make_batch(epoch * 100 + i) for i in range(5)]),
+        epochs=2, checkpoint_manager=manager,
+        tracer=True, trace_path=trace_path,
+    )
+    assert sig.raised and int(state.step) < 10  # preempted well short of 2 epochs
+
+    # the trace survived the preemption with real spans in it
+    events = load_trace_events(trace_path)
+    assert any(event["name"] == "train_step" for event in events)
+
+    # the ring's story ends with the preemption sequence, readable post-exit
+    log = read_flight(ring_path)
+    assert not log.torn_tail
+    names = [r["event"] for r in log.records]
+    assert "on_preemption" in names
+    preempt = next(r for r in log.records if r["event"] == "on_preemption")
+    assert preempt["signal"] == "SIGTERM"
+    assert names[-1] == "on_fit_end"
+    assert log.records[-1]["preempted"] is True
+
+
+@pytest.mark.jax
 def test_lr_backoff_survives_preemption_and_resume(tmp_path):
     """A run that rolled back (LR scale 0.5) and is then preempted must resume
     at the backed-off rate, not rerun the divergence at full LR."""

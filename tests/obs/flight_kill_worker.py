@@ -1,0 +1,43 @@
+"""Worker for the real-SIGKILL flight-recorder round trip.
+
+Writes ``count`` records into the ring at ``sys.argv[1]``, then — without
+any flush, close, or atexit — delivers ``SIGKILL`` to itself. The parent
+test (tests/obs/test_blackbox.py) reads the ring back and must recover every
+record: the whole point of the page-cache durability story.
+
+The module is loaded straight from ``replay_tpu/obs/blackbox.py`` by file
+path (stdlib-only), so the subprocess never pays a jax import.
+"""
+
+import importlib.util
+import os
+import signal
+import sys
+from pathlib import Path
+
+_BLACKBOX = Path(__file__).resolve().parents[2] / "replay_tpu" / "obs" / "blackbox.py"
+
+
+def load_blackbox():
+    spec = importlib.util.spec_from_file_location("blackbox", _BLACKBOX)
+    module = importlib.util.module_from_spec(spec)
+    # dataclasses resolves the defining module through sys.modules
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def main() -> None:
+    ring_path, count = sys.argv[1], int(sys.argv[2])
+    blackbox = load_blackbox()
+    recorder = blackbox.FlightRecorder(ring_path, capacity=64)
+    for step in range(count):
+        recorder.record({"event": "on_train_step", "step": step, "loss": 0.5 - step / 100.0})
+    # no flush, no close: the dirty pages in the OS page cache are all the
+    # durability a SIGKILL leaves — and all the recorder needs
+    os.kill(os.getpid(), signal.SIGKILL)
+    raise AssertionError("survived SIGKILL")  # pragma: no cover
+
+
+if __name__ == "__main__":
+    main()

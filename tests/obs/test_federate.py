@@ -1,0 +1,159 @@
+"""Federation merge semantics: exact sums, labeled gauges, lossless buckets.
+
+Core tier: every claim in :mod:`replay_tpu.obs.federate`'s module doc gets a
+direct check against real :class:`MetricsRegistry` snapshots — counters sum
+EXACTLY, gauges keep one labeled series per process, histograms bucket-merge
+with zero loss (count/sum/min/max/overflow and re-estimated quantiles), and
+mismatched bucket ladders raise :class:`FederationError` naming the metric.
+The HTTP path runs against two real in-process exporters on ephemeral ports;
+the two-real-OS-process variant lives in tests/serve/test_remote.py.
+"""
+
+import urllib.request
+
+import pytest
+
+from replay_tpu.obs.exporter import MetricsExporter
+from replay_tpu.obs.federate import (
+    FederationError,
+    FleetFederator,
+    federate_snapshots,
+    parse_metric_key,
+    scrape_snapshot,
+)
+from replay_tpu.obs.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.core
+
+
+def _registry(requests: int, latencies, process_index: int) -> dict:
+    registry = MetricsRegistry()
+    for _ in range(requests):
+        registry.inc("replay_serve_requests_total")
+    registry.inc("replay_serve_shed_total", 3.0)
+    registry.set("replay_serve_qps", 10.0 * (process_index + 1))
+    for value in latencies:
+        registry.observe("replay_serve_queue_wait_ms", value, buckets=(1.0, 5.0, 25.0))
+    snapshot = registry.snapshot()
+    snapshot["__identity__"] = {"process_index": process_index, "pid": 1000 + process_index}
+    return snapshot
+
+
+def test_parse_metric_key_roundtrip():
+    assert parse_metric_key("plain_name") == ("plain_name", {})
+    name, labels = parse_metric_key('replay_serve_qps{process="2",host="a"}')
+    assert name == "replay_serve_qps"
+    assert labels == {"process": "2", "host": "a"}
+
+
+def test_counters_sum_exactly():
+    merged = federate_snapshots([
+        _registry(7, [], 0), _registry(11, [], 1), _registry(23, [], 2),
+    ])
+    snapshot = merged.snapshot()
+    assert snapshot["replay_serve_requests_total"]["value"] == 41.0
+    assert snapshot["replay_serve_shed_total"]["value"] == 9.0
+
+
+def test_gauges_keep_one_labeled_series_per_process():
+    merged = federate_snapshots([_registry(1, [], 0), _registry(1, [], 4)])
+    snapshot = merged.snapshot()
+    # no unlabeled collapsed series: last-write-wins scalars never add
+    assert "replay_serve_qps" not in snapshot
+    assert snapshot['replay_serve_qps{process="0"}']["value"] == 10.0
+    assert snapshot['replay_serve_qps{process="4"}']["value"] == 50.0
+
+
+def test_histograms_bucket_merge_losslessly():
+    a = [0.5, 0.7, 3.0, 100.0]
+    b = [0.9, 4.0, 20.0, 30.0, 200.0]
+    merged = federate_snapshots([_registry(1, a, 0), _registry(1, b, 1)])
+
+    # ground truth: one registry observing the union of both streams
+    union = MetricsRegistry()
+    for value in a + b:
+        union.observe("replay_serve_queue_wait_ms", value, buckets=(1.0, 5.0, 25.0))
+    got = merged.snapshot()["replay_serve_queue_wait_ms"]
+    want = union.snapshot()["replay_serve_queue_wait_ms"]
+    for field in ("count", "sum", "min", "max", "buckets", "overflow"):
+        assert got[field] == want[field], field
+    # quantiles re-estimated over MERGED counts equal the union's estimates —
+    # never an average of per-process percentiles
+    assert got["quantiles"] == want["quantiles"]
+
+
+def test_mismatched_bucket_ladders_raise_naming_the_metric():
+    one = MetricsRegistry()
+    one.observe("replay_serve_queue_wait_ms", 1.0, buckets=(1.0, 5.0))
+    other = MetricsRegistry()
+    other.observe("replay_serve_queue_wait_ms", 1.0, buckets=(2.0, 10.0))
+    with pytest.raises(FederationError, match="replay_serve_queue_wait_ms"):
+        federate_snapshots([one.snapshot(), other.snapshot()])
+
+
+def test_process_label_falls_back_to_scrape_order():
+    bare = _registry(1, [], 0)
+    del bare["__identity__"]
+    merged = federate_snapshots([bare, bare])
+    snapshot = merged.snapshot()
+    assert 'replay_serve_qps{process="0"}' in snapshot
+    assert 'replay_serve_qps{process="1"}' in snapshot
+
+
+def test_federator_scrapes_real_exporters_and_serves_the_merge():
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    reg_a.inc("replay_serve_requests_total", 5.0)
+    reg_b.inc("replay_serve_requests_total", 8.0)
+    exp_a = MetricsExporter(reg_a, port=0, identity={"process_index": 0}).start()
+    exp_b = MetricsExporter(reg_b, port=0, identity={"process_index": 1}).start()
+    try:
+        fed = FleetFederator([exp_a.url, exp_b.url], port=0)
+        with fed:
+            scrape = fed.scrape()
+            assert scrape.reachable == 2 and not scrape.errors
+            assert {m["process_index"] for m in scrape.members} == {0, 1}
+            merged = scrape.registry.snapshot()
+            assert merged["replay_serve_requests_total"]["value"] == 13.0
+            assert merged["replay_federation_reachable"]["value"] == 2.0
+            # the federated /metrics endpoint serves the merged registry
+            with urllib.request.urlopen(f"{fed.exporter.url}/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert "replay_serve_requests_total 13" in text
+            assert "replay_federation_members 2" in text
+    finally:
+        exp_a.close()
+        exp_b.close()
+
+
+def test_dead_member_degrades_to_the_reachable_subset():
+    registry = MetricsRegistry()
+    registry.inc("replay_serve_requests_total", 4.0)
+    exporter = MetricsExporter(registry, port=0, identity={"process_index": 0}).start()
+    try:
+        fed = FleetFederator([exporter.url, "http://127.0.0.1:1"], port=0, timeout_s=2.0)
+        scrape = fed.scrape()
+        assert scrape.reachable == 1
+        assert "http://127.0.0.1:1" in scrape.errors
+        merged = scrape.registry.snapshot()
+        assert merged["replay_serve_requests_total"]["value"] == 4.0
+        assert merged["replay_federation_members"]["value"] == 2.0
+        assert merged["replay_federation_reachable"]["value"] == 1.0
+        assert merged['replay_federation_errors_total{target="http://127.0.0.1:1"}'][
+            "value"
+        ] == 1.0
+        fed.close()
+    finally:
+        exporter.close()
+
+
+def test_scrape_snapshot_carries_the_identity_block():
+    registry = MetricsRegistry()
+    registry.inc("anything_total")
+    exporter = MetricsExporter(registry, port=0, identity={"process_index": 7}).start()
+    try:
+        snapshot = scrape_snapshot(exporter.url)
+        assert snapshot["__identity__"]["process_index"] == 7
+        assert snapshot["__identity__"]["pid"] > 0
+        assert snapshot["__identity__"]["start_unix"] > 0
+    finally:
+        exporter.close()

@@ -1,0 +1,167 @@
+"""Post-mortem reconstruction over a synthetic dead-fleet run directory.
+
+Core tier: builds the exact artifact layout a SIGKILL chaos run leaves
+behind — a survivor's events shard, a dead rank's torn shard + flight ring +
+``meta.json`` death declaration, a preempted checkpoint sidecar — and
+asserts :func:`build_postmortem` merges it into per-process last-known-
+activity timelines without ever raising for the damage it exists to explain.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from replay_tpu.obs import report
+from replay_tpu.obs.blackbox import FlightRecorder
+from replay_tpu.obs.postmortem import (
+    _load_events_tolerant,
+    build_postmortem,
+    discover_rings,
+    render_postmortem,
+)
+
+pytestmark = pytest.mark.core
+
+
+def _dead_fleet_run(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    now = time.time()
+    # rank 0 survived: clean shard, no ring damage
+    with open(run / "events.jsonl", "w") as fh:
+        for step in range(6):
+            fh.write(json.dumps(
+                {"event": "on_train_step", "step": step, "time": now + step}
+            ) + "\n")
+    # rank 1 died: shard torn mid-line, ring written up to step 4, SIGKILL meta
+    with open(run / "events.p1.jsonl", "w") as fh:
+        for step in range(4):
+            fh.write(json.dumps(
+                {"event": "on_train_step", "step": step, "time": now + step}
+            ) + "\n")
+        fh.write('{"event": "on_train_st')  # the torn line a dying write leaves
+    rank1 = run / "workers" / "rank1"
+    rank1.mkdir(parents=True)
+    with FlightRecorder(str(rank1 / "flight.ring"), capacity=32) as rec:
+        rec.record({"event": "flight_open", "role": "fit", "process_index": 1})
+        for step in range(5):
+            rec.record({"event": "on_train_step", "step": step}, when=now + step)
+    with open(rank1 / "meta.json", "w") as fh:
+        json.dump({"rank": 1, "returncode": -9, "killed_by": 9, "reaped": False}, fh)
+    # the last checkpoint that durably landed (a preempted mid-epoch save)
+    with open(run / "step_3.json", "w") as fh:
+        json.dump({"epoch": 0, "mid_epoch": True, "preempted": True}, fh)
+    return str(run)
+
+
+def test_postmortem_merges_all_four_evidence_kinds(tmp_path):
+    run = _dead_fleet_run(tmp_path)
+    post = build_postmortem(run)
+
+    rank1 = post["processes"]["rank1"]
+    assert rank1["dead"] is True
+    assert rank1["flight_records_recovered"] == 6  # flight_open + 5 steps
+    assert rank1["last_flight_record"]["event"] == "on_train_step"
+    assert rank1["last_flight_record"]["step"] == 4
+    assert rank1["death"]["killed_by"] == 9
+    assert rank1["shard_torn_lines"] == 1
+    # the named gap: final flight record -> death declaration
+    assert rank1["gap_s"] >= 0.0
+
+    rank0 = post["processes"]["rank0"]
+    assert rank0["dead"] is False
+    assert rank0["last_shard_event"]["step"] == 5
+
+    assert post["checkpoints"][-1]["step"] == 3
+    assert post["checkpoints"][-1]["preempted"] is True
+    assert post["unreadable_rings"] == 0
+
+
+def test_postmortem_render_names_the_dead_and_the_gap(tmp_path):
+    post = build_postmortem(_dead_fleet_run(tmp_path))
+    text = render_postmortem(post)
+    assert "rank1: DEAD" in text
+    assert "rank0: survived" in text
+    assert "signal 9" in text
+    assert "unaccounted gap" in text
+    assert "last checkpoint: step 3 (preempted save)" in text
+
+
+def test_postmortem_cli_writes_postmortem_json_and_exits_zero(tmp_path, capsys):
+    run = _dead_fleet_run(tmp_path)
+    assert report.main([run, "--postmortem"]) == 0
+    out = capsys.readouterr().out
+    assert "rank1: DEAD" in out
+    with open(os.path.join(run, "postmortem.json")) as fh:
+        post = json.load(fh)
+    assert post["processes"]["rank1"]["dead"] is True
+
+
+def test_torn_and_unreadable_rings_are_reported_never_fatal(tmp_path):
+    run = tmp_path / "run"
+    (run / "workers" / "rank0").mkdir(parents=True)
+    ring = run / "workers" / "rank0" / "flight.ring"
+    with FlightRecorder(str(ring), capacity=8) as rec:
+        for step in range(3):
+            rec.record({"event": "on_train_step", "step": step})
+    # tear the final record mid-store and truncate the file: double damage
+    raw = bytearray(ring.read_bytes())
+    raw[-200:] = b""
+    raw[len(raw) - 40 :] = b"\xff" * 40
+    ring.write_bytes(bytes(raw))
+    # plus a ring that is not a ring at all
+    (run / "flight.bogus.ring").write_bytes(b"junk" * 64)
+
+    post = build_postmortem(str(run))  # never raises for damaged evidence
+    assert post["unreadable_rings"] == 1
+    readable = [r for r in post["rings"] if r.get("readable")]
+    assert len(readable) == 1
+    assert readable[0]["torn_tail"] is True
+    assert post["torn_tails"] == 1
+    assert render_postmortem(post)  # and it still renders
+
+
+def test_tolerant_loader_counts_damage_instead_of_raising(tmp_path):
+    shard = tmp_path / "events.jsonl"
+    shard.write_text(
+        '{"event": "a"}\n'
+        "not json at all\n"
+        '{"event": "b"}\n'
+        "[1, 2, 3]\n"
+        '{"event": "c"'  # torn final line
+    )
+    records, skipped = _load_events_tolerant(str(shard))
+    assert [r["event"] for r in records] == ["a", "b"]
+    assert skipped == 3
+    # the strict report loader refuses the same shard — the split is the point
+    with pytest.raises(ValueError, match="invalid JSON"):
+        report.load_events(str(shard))
+
+
+def test_discover_rings_orders_root_then_ranks(tmp_path):
+    run = tmp_path / "run"
+    (run / "workers" / "rank0").mkdir(parents=True)
+    (run / "workers" / "rank1").mkdir(parents=True)
+    for path in (
+        run / "flight.s0.ring",
+        run / "flight.s1.ring",
+        run / "workers" / "rank0" / "flight.ring",
+        run / "workers" / "rank1" / "flight.ring",
+    ):
+        with FlightRecorder(str(path), capacity=4) as rec:
+            rec.record({"event": "on_serve_start"})
+    rings = discover_rings(str(run))
+    names = [os.path.relpath(r, str(run)) for r in rings]
+    assert names == [
+        "flight.s0.ring",
+        "flight.s1.ring",
+        os.path.join("workers", "rank0", "flight.ring"),
+        os.path.join("workers", "rank1", "flight.ring"),
+    ]
+
+
+def test_missing_run_dir_is_the_only_fatal_input(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        build_postmortem(str(tmp_path / "nope"))

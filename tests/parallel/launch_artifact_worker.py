@@ -1,0 +1,48 @@
+"""Worker for the launcher-forensics tests (tests/parallel/test_launch_artifacts.py).
+
+Stdlib-only (no jax import, no collectives): argv is ``<rank> <coordinator>
+<behavior>``. Every rank records a few events into the flight ring the
+launcher handed it via ``REPLAY_TPU_FLIGHT_PATH`` (loading ``blackbox.py``
+by file path, the same trick as tests/obs/flight_kill_worker.py), then:
+
+* ``ok``      — prints a line and exits 0;
+* ``fail``    — prints to both spools and exits 3;
+* ``sigkill`` — dies by real ``kill -9`` mid-run, no flush, no close.
+"""
+
+import importlib.util
+import os
+import signal
+import sys
+from pathlib import Path
+
+_BLACKBOX = Path(__file__).resolve().parents[2] / "replay_tpu" / "obs" / "blackbox.py"
+
+
+def main() -> None:
+    rank, _coordinator, behavior = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    spec = importlib.util.spec_from_file_location("blackbox", _BLACKBOX)
+    blackbox = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = blackbox
+    spec.loader.exec_module(blackbox)
+
+    ring_path = os.environ.get(blackbox.FLIGHT_PATH_ENV)
+    recorder = None
+    if ring_path:
+        recorder = blackbox.FlightRecorder(ring_path, capacity=32)
+        for step in range(4):
+            recorder.record({"event": "on_train_step", "step": step, "rank": int(rank)})
+
+    print(f"rank {rank} stdout line", flush=True)
+    if behavior == "fail":
+        print(f"rank {rank} exploding", file=sys.stderr, flush=True)
+        sys.exit(3)
+    if behavior == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if recorder is not None:
+        recorder.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Launcher forensics: ring hand-off, spool persistence, LaunchError paths.
+
+Explicitly core tier — the launcher is pure subprocess supervision and the
+worker (launch_artifact_worker.py) is stdlib-only, so none of this touches
+jax. The claims: ``launch_workers(run_dir=...)`` hands every rank a flight
+ring via ``REPLAY_TPU_FLIGHT_PATH``; a rank that dies abnormally (nonzero
+exit or real SIGKILL) leaves its FULL stdout/stderr spools and a
+``meta.json`` with the authoritative ``killed_by`` in
+``<run_dir>/workers/rank<i>/``; a SIGKILLed rank's ring reads back with its
+records intact; and ``LaunchError`` names the persisted artifact paths.
+"""
+
+import json
+import signal
+from pathlib import Path
+
+import pytest
+
+from replay_tpu.obs.blackbox import read_flight
+from replay_tpu.parallel.launch import LaunchError, launch_workers
+
+pytestmark = pytest.mark.core
+
+WORKER = str(Path(__file__).with_name("launch_artifact_worker.py"))
+
+
+def _launch(run_dir, behaviors, **kwargs):
+    return launch_workers(
+        WORKER,
+        num_processes=len(behaviors),
+        args_for=lambda rank: [behaviors[rank]],
+        run_dir=str(run_dir),
+        grace_s=10.0,
+        timeout=60.0,
+        **kwargs,
+    )
+
+
+def test_clean_workers_leave_rings_but_no_failure_artifacts(tmp_path):
+    results = _launch(tmp_path, ["ok", "ok"])
+    for result in results:
+        assert result.returncode == 0 and not result.reaped
+        assert result.artifacts_dir is None  # nothing abnormal to persist
+        log = read_flight(result.flight_path)  # the hand-off worked end to end
+        assert log.recovered == 4
+        assert not (Path(tmp_path) / "workers" / f"rank{result.rank}" / "meta.json").exists()
+
+
+def test_abnormal_exit_persists_full_spools_and_meta(tmp_path):
+    results = _launch(tmp_path, ["ok", "fail"], check=False)
+    ok, bad = results
+    assert ok.returncode == 0 and ok.artifacts_dir is None
+    assert bad.returncode == 3
+    artifacts = Path(bad.artifacts_dir)
+    assert artifacts == Path(tmp_path) / "workers" / "rank1"
+    assert (artifacts / "stdout.log").read_text() == bad.stdout
+    assert "rank 1 stdout line" in bad.stdout
+    assert "rank 1 exploding" in (artifacts / "stderr.log").read_text()
+    meta = json.loads((artifacts / "meta.json").read_text())
+    assert meta == {"rank": 1, "returncode": 3, "killed_by": None, "reaped": False}
+
+
+def test_sigkilled_rank_leaves_a_readable_ring_and_its_signal_on_record(tmp_path):
+    results = _launch(tmp_path, ["ok", "sigkill"], check=False)
+    victim = results[1]
+    assert victim.returncode == -signal.SIGKILL
+    assert victim.killed_by == signal.SIGKILL
+    meta = json.loads((Path(victim.artifacts_dir) / "meta.json").read_text())
+    assert meta["killed_by"] == signal.SIGKILL
+    # the black box harvest: records written before kill -9, read after it
+    log = read_flight(victim.flight_path)
+    assert log.recovered == 4
+    assert [r["rank"] for r in log.records] == [1, 1, 1, 1]
+
+
+def test_launch_error_names_the_persisted_artifact_paths(tmp_path):
+    with pytest.raises(LaunchError) as excinfo:
+        _launch(tmp_path, ["ok", "fail"])
+    message = str(excinfo.value)
+    expected = str(Path(tmp_path) / "workers" / "rank1")
+    assert f"artifacts={expected}" in message
+    assert "rank 1 exploding" in message  # the stderr tail still rides along
+
+
+def test_without_run_dir_nothing_changes(tmp_path):
+    results = launch_workers(
+        WORKER,
+        num_processes=1,
+        args_for=lambda rank: ["ok"],
+        grace_s=10.0,
+        timeout=60.0,
+    )
+    assert results[0].returncode == 0
+    assert results[0].flight_path is None
+    assert results[0].artifacts_dir is None
+    assert not (Path(tmp_path) / "workers").exists()

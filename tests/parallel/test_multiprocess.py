@@ -6,6 +6,7 @@ local_data + psum over the global mesh), not the single-process mesh emulation
 the rest of tests/parallel uses."""
 
 import json
+import os
 from pathlib import Path
 
 import numpy as np
@@ -199,12 +200,15 @@ def test_stream_fit_sigkill_resume_bitwise(tmp_path):
     assert full[0]["events"], "reference run emitted no steps"
 
     # 2) hard-kill one rank mid-epoch: a REAL SIGKILL, peers reaped by the
-    # launcher once the collectives wedge
+    # launcher once the collectives wedge. run_dir turns the launch forensic:
+    # every rank records into a flight ring, the dead ones leave spools+meta
+    # (CI points REPLAY_TPU_MP_RUN_DIR here to upload the evidence)
     kill_ckpt = tmp_path / "ckpt_kill"
+    run_dir = os.environ.get("REPLAY_TPU_MP_RUN_DIR") or str(tmp_path / "kill_run")
     results = launch_workers(
         worker, 2,
         _stream_worker_args(tmp_path, parquet, kill_ckpt, "kill", kill_ranks=(1,)),
-        env=env, timeout=420.0, grace_s=20.0, check=False,
+        env=env, timeout=420.0, grace_s=20.0, check=False, run_dir=run_dir,
     )
     import signal
 
@@ -214,6 +218,19 @@ def test_stream_fit_sigkill_resume_bitwise(tmp_path):
     # launcher reaped it out of the wedged collective or jax.distributed
     # surfaced the lost peer as an error; it must NOT have exited cleanly
     assert results[0].reaped or results[0].returncode != 0
+
+    # the black box harvest: the SIGKILLed rank's ring reads back with the
+    # fit's last events (the env hand-off needed NO worker change), and its
+    # death is on record next to it for obs.report --postmortem
+    from replay_tpu.obs.blackbox import read_flight
+
+    flight = read_flight(results[1].flight_path)
+    assert flight.recovered > 0, "the killed rank's ring recovered nothing"
+    ring_events = [r["event"] for r in flight.records]
+    assert "on_train_step" in ring_events
+    assert "on_fit_end" not in ring_events  # SIGKILL: the fit never closed
+    meta_path = Path(results[1].artifacts_dir) / "meta.json"
+    assert json.loads(meta_path.read_text())["killed_by"] == signal.SIGKILL
 
     # 3) what the kill left behind: a valid mid-epoch checkpoint with one
     # cursor sidecar PER PROCESS, and exactly-once coverage when replayed

@@ -130,6 +130,131 @@ class TestRemoteReplica:
             replica.submit(1).result(timeout=5)
 
 
+@pytest.fixture(scope="module")
+def blackbox_servers(tmp_path_factory):
+    """Two replica processes, each with a flight ring and a live exporter on
+    an ephemeral port (published through ``<portfile>.metrics``)."""
+    base = tmp_path_factory.mktemp("blackbox_fleet")
+    env = clean_cpu_env(local_devices=1, repo_root=REPO_ROOT)
+    procs = [
+        ReplicaServerProcess(
+            env=env,
+            args=[
+                "--num-items", str(NUM_ITEMS),
+                "--seq-len", str(SEQ_LEN),
+                "--embedding-dim", "8",
+                "--num-blocks", "1",
+            ],
+            flight_path=str(base / f"flight.s{i}.ring"),
+            metrics_port=0,
+        )
+        for i in range(2)
+    ]
+    try:
+        for proc in procs:
+            proc.spawn(wait=False)
+        for proc in procs:
+            proc.wait_ready()
+        yield procs
+    finally:
+        for proc in procs:
+            proc.terminate()
+
+
+class TestBlackboxAndFederation:
+    def test_federated_metrics_over_two_real_processes(self, blackbox_servers):
+        """The acceptance claim: one federated registry over two real OS
+        processes — counters equal the sum EXACTLY (reconciled against each
+        service's own ``stats()``), histograms bucket-merge losslessly,
+        gauges carry per-process labels."""
+        from replay_tpu.obs.federate import federate_snapshots, scrape_snapshot
+
+        replicas = [RemoteReplica(proc).start() for proc in blackbox_servers]
+        try:
+            for index, replica in enumerate(replicas):
+                for user in range(5 + index * 2):  # 5 and 7: unequal on purpose
+                    replica.score(
+                        10_000 * (index + 1) + user,
+                        history=_history_for(user), timeout=60,
+                    )
+            stats = [replica.stats() for replica in replicas]
+            snapshots = [
+                scrape_snapshot(proc.metrics_url) for proc in blackbox_servers
+            ]
+            merged = federate_snapshots(snapshots).snapshot()
+
+            # counters: federated total == exact sum of per-member counters
+            # == the services' own request accounting
+            member_rows = [
+                s["replay_serve_rows_total"]["value"] for s in snapshots
+            ]
+            assert merged["replay_serve_rows_total"]["value"] == sum(member_rows)
+            assert sum(member_rows) == stats[0]["requests"] + stats[1]["requests"]
+
+            # histograms: bucket-merged losslessly across the processes
+            fills = [s["replay_serve_batch_fill"] for s in snapshots]
+            federated_fill = merged["replay_serve_batch_fill"]
+            assert federated_fill["count"] == sum(f["count"] for f in fills)
+            assert federated_fill["sum"] == pytest.approx(
+                sum(f["sum"] for f in fills)
+            )
+            for bound in fills[0]["buckets"]:
+                assert federated_fill["buckets"][bound] == sum(
+                    f["buckets"][bound] for f in fills
+                )
+
+            # gauges: one labeled series per process, labeled by the identity
+            # block each exporter published (REPLAY_TPU_PROCESS_ID defaults)
+            processes = {
+                str(s["__identity__"]["process_index"]) for s in snapshots
+            }
+            for process in processes:
+                assert f'replay_serve_up{{process="{process}"}}' in merged
+        finally:
+            for replica in replicas:
+                replica.close()
+
+    def test_sigkilled_server_leaves_a_readable_flight_ring(self, blackbox_servers):
+        """kill -9 a replica server mid-service: its flight ring must read
+        back with the serve events recorded before death — no exception, no
+        corrupt records — and a respawn resumes the SAME ring after the dead
+        incarnation's last seqno."""
+        from replay_tpu.obs.blackbox import read_flight
+
+        victim = blackbox_servers[1]
+        replica = RemoteReplica(victim).start()
+        try:
+            for user in range(6):
+                replica.score(user, history=_history_for(user), timeout=60)
+        finally:
+            replica.close()
+
+        KillAtStep(pid=victim.pid).fire()
+        assert victim.proc.wait(timeout=10) == -signal.SIGKILL
+
+        log = read_flight(victim.flight_path)
+        dead_seqno = log.last_seqno
+        assert log.recovered > 0
+        events = [r["event"] for r in log.records]
+        assert events[0] == "flight_open"
+        assert "on_serve_start" in events
+        assert "on_serve_batch" in events
+        # the ring never reaches on_serve_end: SIGKILL means no close path
+        assert "on_serve_end" not in events
+
+        # revival reopens the same ring and continues AFTER the corpse's
+        # records — the respawned incarnation appends, never clobbers
+        victim.respawn()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            resumed = read_flight(victim.flight_path)
+            if resumed.last_seqno > dead_seqno:
+                break
+            time.sleep(0.2)
+        assert resumed.last_seqno > dead_seqno
+        assert resumed.records[: len(log.records)] == log.records
+
+
 class TestSocketFleetChaos:
     def test_fleet_survives_a_sigkilled_replica(self, servers):
         replicas = {f"r{i}": RemoteReplica(proc) for i, proc in enumerate(servers)}
