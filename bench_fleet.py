@@ -10,7 +10,13 @@ JSON line in bench.py's sidecar format::
      "p50_ms": ..., "p99_ms": ..., "replicas": N, "reroutes": ...,
      "cache_hit_locality": ..., "single_replica_qps": ...,
      "chaos": {..., "failover_gap_ms": ...}, "drain_swap": {...},
-     "sharded_retrieval": {...}, "backend": ...}
+     "sharded_retrieval": {...}, "quality": {...}, "backend": ...}
+
+Every replica also carries an ``obs.QualityMonitor`` over one shared
+popularity descriptor (the same synthetic log the fallback ranks by), so
+``fleet.stats()`` aggregates the fleet-wide quality plane — join-weighted
+online hitrate, total prequential joins, the per-replica drift state — into
+the record's ``quality`` block.
 
 Phases (every replica's programs are AOT-compiled at construction — the
 timed phases never trace):
@@ -788,7 +794,13 @@ def main() -> None:
     from replay_tpu.data import FeatureHint, FeatureType
     from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
     from replay_tpu.nn.sequential.sasrec import SasRec
-    from replay_tpu.obs import JsonlLogger, Tracer, merge_traces
+    from replay_tpu.obs import (
+        JsonlLogger,
+        PopularityDescriptor,
+        QualityMonitor,
+        Tracer,
+        merge_traces,
+    )
     from replay_tpu.serve import FallbackScorer, ScoringService, ServingFleet
 
     rng = np.random.default_rng(0)
@@ -825,7 +837,15 @@ def main() -> None:
     # serving phases' latencies
     sharded_retrieval = _run_sharded_retrieval()
 
-    def build_service(logger=None, tracer=None):
+    # the quality plane, fleet-wide: one monitor per replica over ONE shared
+    # popularity descriptor (the same synthetic log the fallback ranks by) —
+    # fleet.stats() aggregates the join-weighted online hitrate and the
+    # per-replica drift state into its "quality" block
+    quality_descriptor = PopularityDescriptor.from_train(
+        {0: popularity.tolist()}, num_items=NUM_ITEMS
+    )
+
+    def build_service(logger=None, tracer=None, quality=None):
         return ScoringService(
             model,
             params,
@@ -836,6 +856,7 @@ def main() -> None:
             tracer=tracer,
             cold_miss="fallback",
             fallback=FallbackScorer(fallback.item_scores),
+            quality=quality,
         )
 
     fleet_logger = JsonlLogger(RUN_DIR, mode="w")
@@ -852,7 +873,11 @@ def main() -> None:
     router_tracer = Tracer(enabled=True)
     replica_tracers = {f"r{i}": Tracer(enabled=True) for i in range(REPLICAS)}
     services = {
-        f"r{i}": build_service(logger=replica_loggers[i], tracer=replica_tracers[f"r{i}"])
+        f"r{i}": build_service(
+            logger=replica_loggers[i],
+            tracer=replica_tracers[f"r{i}"],
+            quality=QualityMonitor(quality_descriptor),
+        )
         for i in range(REPLICAS)
     }
     baseline_service = build_service()
@@ -979,6 +1004,9 @@ def main() -> None:
             else None
         ),
         "per_replica": per_replica,
+        # the fleet-wide quality aggregation (fleet.stats): total prequential
+        # joins, join-weighted online hitrate, max drift PSI across replicas
+        "quality": final_stats.get("quality"),
         # slowest answered requests with their trace ids (the exemplar store
         # riding the fleet latency histogram): the JSON record's link into
         # the merged trace.json alongside it

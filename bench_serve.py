@@ -46,7 +46,20 @@ construction, so the timed phases never trace):
   trip the circuit breaker (degraded traffic rides the cache_only/fallback
   ladder, tagged in ``served_by``), a latency spike exercises the client-
   abandon drop, a deadline storm exercises expiry-at-batch-build, and the
-  breaker must re-close after recovery. The row asserts zero hung futures.
+  breaker must re-close after recovery. The row asserts zero hung futures;
+* **drift** (``REPLAY_TPU_SERVE_DRIFT_REQUESTS > 0``, default on) — the
+  quality plane's injected preference shift (``obs.quality``): a
+  ``QualityMonitor`` rides the whole run (every phase's served slates feed
+  its windowed coverage/novelty/surprisal gauges and the online prequential
+  hitrate/NDCG from ``new_items`` labels), then the phase sends
+  ``DRIFT_REQUESTS`` steady advances (uniform labels — the distribution the
+  PSI reference froze on) followed by ``DRIFT_REQUESTS`` advances whose
+  labels all land on the popularity HEAD. PSI on the incoming-label series
+  must cross ``DRIFT_THRESHOLD`` and trip the ``drift_psi`` SLO rule exactly
+  once (the watchdog's transition latch). The ``drift`` block records
+  psi before/after, the violation count and the online metrics;
+  ``obs.report --compare`` gates ``quality_online_hitrate`` higher-better
+  and ``quality_drift_psi`` lower-better (phase-matched).
 
 Request mix per returning user: mostly pure cache hits, a slice of one-step
 incremental advances, a trickle of cold full-history re-sends — the shape the
@@ -130,6 +143,21 @@ CHAOS = (
 # compared runs ran it, the PR-9 phase-matching rule)
 SWAPS = int(os.environ.get("REPLAY_TPU_SERVE_SWAPS", "0"))
 SWAP_GAP_MS = float(os.environ.get("REPLAY_TPU_SERVE_SWAP_GAP_MS", "200"))
+# quality/drift phase (obs.quality): DRIFT_REQUESTS steady advances (uniform
+# labels, the distribution the PSI reference froze on) then DRIFT_REQUESTS
+# advances whose labels all land on the popularity head — the injected
+# preference shift must push the incoming-label PSI past DRIFT_THRESHOLD and
+# trip the drift_psi SLO rule exactly once. 0 / --no-drift = phase off.
+# The threshold sits BETWEEN the bench's two PSI bands: small-window sampling
+# noise plus the shift's second-order echoes (served-slate score/popularity
+# drift) plateau near ~1.0, while the directly shifted incoming-label series
+# lands well above ~4 — and that series climbs monotonically during the
+# shift (the label window only gains head items), so the gauge crosses any
+# threshold in the gap exactly once and the for_steps=2 rule cannot re-fire.
+DRIFT_REQUESTS = int(os.environ.get("REPLAY_TPU_SERVE_DRIFT_REQUESTS", "256"))
+DRIFT_THRESHOLD = float(os.environ.get("REPLAY_TPU_SERVE_DRIFT_THRESHOLD", "1.5"))
+if "--no-drift" in sys.argv:
+    DRIFT_REQUESTS = 0
 # the live metrics plane rides every bench run: 0 = ephemeral port (the
 # default — collision-proof); -1 disables the metrics plane entirely (no
 # registry either, so the record omits its `metrics` reconciliation block —
@@ -615,6 +643,87 @@ def _run_chaos(service, histories, rng):
     }
 
 
+def _run_drift_phase(service, monitor, histories, num_items, users, rng):
+    """Injected preference shift (obs.quality): DRIFT_REQUESTS steady advances
+    whose labels stay uniform (the distribution the PSI reference froze on),
+    then DRIFT_REQUESTS advances whose labels ALL land on the popularity head
+    — "everyone suddenly watches the blockbusters". The incoming-label PSI
+    must cross DRIFT_THRESHOLD and the drift_psi SLO rule must fire exactly
+    once (the watchdog's transition latch; the phase runs last so PSI never
+    recovers and re-arms the rule)."""
+    registry = service.metrics_registry
+
+    def violations() -> float:
+        if registry is None:
+            return 0.0
+        return (
+            registry.value(
+                "replay_slo_violations_total", labels={"rule": "drift_psi"}
+            )
+            or 0.0
+        )
+
+    def advance(user: int, item: int):
+        histories[user].append(item)
+        return service.submit(user, new_items=[item])
+
+    violations_before = violations()
+
+    # phase A: steady traffic — uniform labels, same mix the load phases drew.
+    # Guarantees the drift reference is frozen before the shift starts even
+    # when the load phases were tiny (CI's quality_smoke knobs).
+    futures = [
+        advance(int(rng.integers(0, users)), int(rng.integers(0, num_items)))
+        for _ in range(DRIFT_REQUESTS)
+    ]
+    hung = _await_all(futures)
+    series_before = dict(monitor.snapshot().get("drift") or {})
+    psi_before = series_before.get("max")
+
+    # phase B: the shift — every incoming label lands on the popularity head
+    counts = np.bincount(
+        np.concatenate([np.asarray(h, np.int64) for h in histories.values()]),
+        minlength=num_items,
+    )
+    head_items = np.argsort(-counts)[: max(8, num_items // 64)]
+    futures = [
+        advance(
+            int(rng.integers(0, users)),
+            int(head_items[int(rng.integers(0, len(head_items)))]),
+        )
+        for _ in range(DRIFT_REQUESTS)
+    ]
+    hung += _await_all(futures)
+    # close the tail window so the final PSI reaches the registry and the
+    # watchdog evaluates it (flush emits through the service's own fan-out)
+    monitor.flush()
+    snap = monitor.snapshot()
+    psi_after = (snap.get("drift") or {}).get("max")
+    stable = (snap.get("roles") or {}).get("stable") or {}
+    return {
+        "requests": 2 * DRIFT_REQUESTS,
+        "shift_requests": DRIFT_REQUESTS,
+        "shift_fraction": 1.0,
+        "head_items": int(len(head_items)),
+        "threshold": DRIFT_THRESHOLD,
+        "psi_before": psi_before,
+        "psi_after": psi_after,
+        "psi_peak": (
+            psi_after
+            if psi_before is None
+            else (psi_before if psi_after is None else max(psi_before, psi_after))
+        ),
+        "series": dict(snap.get("drift") or {}),
+        "series_before": series_before,
+        "warnings": snap.get("drift_warnings", 0),
+        "slo_violations": int(violations() - violations_before),
+        "online_hitrate_cum": stable.get("online_hitrate_cum"),
+        "online_ndcg_cum": stable.get("online_ndcg_cum"),
+        "joins": stable.get("joins"),
+        "hung_requests": hung,
+    }
+
+
 def main() -> None:
     is_fallback = bool(os.environ.get("REPLAY_TPU_SERVE_FALLBACK"))
     if not is_fallback and not _backend_healthy(PROBE_TIMEOUT):
@@ -630,7 +739,13 @@ def main() -> None:
     from replay_tpu.data.nn import TensorFeatureInfo, TensorSchema
     from replay_tpu.models import MIPSIndex
     from replay_tpu.nn.sequential.sasrec import SasRec
-    from replay_tpu.obs import JsonlLogger, Tracer
+    from replay_tpu.obs import (
+        JsonlLogger,
+        PopularityDescriptor,
+        QualityMonitor,
+        SLORule,
+        Tracer,
+    )
     from replay_tpu.scenarios.two_stages import LogisticReranker
     from replay_tpu.serve import (
         CandidatePipeline,
@@ -696,6 +811,40 @@ def main() -> None:
         for u in range(USERS)
     }
 
+    # the quality plane rides the WHOLE run (every phase's served slates feed
+    # the windowed gauges and the prequential join), not just the drift phase;
+    # sizes derive from DRIFT_REQUESTS so the PSI reference freezes on the
+    # steady half of the drift phase at the latest and the shifted half fills
+    # the comparison window
+    quality_monitor = None
+    drift_rules = None
+    if DRIFT_REQUESTS > 0:
+        quality_monitor = QualityMonitor(
+            PopularityDescriptor.from_train(histories, num_items=NUM_ITEMS),
+            k=min(TOPK, NUM_ITEMS),
+            window=max(64, DRIFT_REQUESTS // 2),
+            emit_every=max(8, DRIFT_REQUESTS // 16),
+            drift_reference=DRIFT_REQUESTS,
+            drift_window=max(32, DRIFT_REQUESTS // 2),
+            drift_min_window=max(8, DRIFT_REQUESTS // 16),
+            drift_threshold=DRIFT_THRESHOLD,
+        )
+        # the SLO gates the DIRECTLY shifted series (incoming-label
+        # popularity): its comparison window only gains head items during the
+        # shift, so its PSI climbs monotonically and crosses the threshold
+        # exactly once — second-order echoes (served-slate score/popularity)
+        # can excurse transiently and would re-fire a max-based rule
+        drift_rules = [
+            SLORule(
+                "replay_drift_psi_series",
+                ">",
+                DRIFT_THRESHOLD,
+                for_steps=2,
+                labels={"series": "interactions"},
+                name="drift_psi",
+            )
+        ]
+
     tracer = Tracer()
     logger = JsonlLogger(RUN_DIR, mode="w")
     compile_start = time.perf_counter()
@@ -712,6 +861,8 @@ def main() -> None:
         trace_path=os.path.join(RUN_DIR, "trace.json"),
         max_queue_depth=MAX_DEPTH if MAX_DEPTH else None,
         metrics_port=METRICS_PORT if METRICS_PORT >= 0 else None,
+        quality=quality_monitor,
+        slo_rules=drift_rules,
         breaker=CircuitBreaker(
             failure_threshold=BREAKER_THRESHOLD,
             reset_timeout_s=BREAKER_RESET_MS / 1000.0,
@@ -826,6 +977,20 @@ def main() -> None:
         if CHAOS:
             chaos = _run_chaos(service, histories, np.random.default_rng(23))
 
+        # ---- drift: injected preference shift must trip the quality SLO --- #
+        # runs LAST so the shifted distribution stays in the comparison
+        # window through close — PSI never recovers, the rule fires once
+        drift = None
+        if quality_monitor is not None:
+            drift = _run_drift_phase(
+                service,
+                quality_monitor,
+                histories,
+                NUM_ITEMS,
+                USERS,
+                np.random.default_rng(31),
+            )
+
         stats = service.stats()
 
         # ---- live scrape: the endpoint must answer WHILE serving ---------- #
@@ -886,6 +1051,7 @@ def main() -> None:
         "hung_requests": (
             (overload["hung_requests"] if overload else 0)
             + (chaos["hung_requests"] if chaos else 0)
+            + (drift["hung_requests"] if drift else 0)
         ),
         "mode": mode,
         "backend": jax.default_backend(),
@@ -910,6 +1076,8 @@ def main() -> None:
         record["overload"] = overload
     if chaos is not None:
         record["chaos"] = chaos
+    if drift is not None:
+        record["drift"] = drift
     if SHAPE_OVERRIDE:
         record["shape_override"] = {
             "L": SEQ_LEN,

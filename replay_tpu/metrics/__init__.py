@@ -1,6 +1,17 @@
 from .ncis import NCISMetric, NCISPrecision
 from .base import Metric, MetricDuplicatesWarning
-from .beyond_accuracy import CategoricalDiversity, Coverage, Novelty, Surprisal, Unexpectedness
+from .beyond_accuracy import (
+    CategoricalDiversity,
+    Coverage,
+    Novelty,
+    Surprisal,
+    Unexpectedness,
+    coverage_of,
+    novelty_of_slate,
+    surprisal_of_slate,
+    surprisal_weights,
+    weighted_surprisal,
+)
 from .builder import MetricsBuilder, metrics_to_df
 from .descriptors import CalculationDescriptor, ConfidenceInterval, Mean, Median, PerUser
 from .offline_metrics import Experiment, OfflineMetrics
@@ -31,5 +42,10 @@ __all__ = [
     "RocAuc",
     "Surprisal",
     "Unexpectedness",
+    "coverage_of",
     "metrics_to_df",
+    "novelty_of_slate",
+    "surprisal_of_slate",
+    "surprisal_weights",
+    "weighted_surprisal",
 ]

@@ -2,15 +2,72 @@
 
 Capability parity with replay/metrics/{coverage,novelty,surprisal,unexpectedness,
 categorical_diversity}.py — identical math on the dict representation.
+
+The per-list math lives in the pure functions :func:`novelty_of_slate`,
+:func:`surprisal_weights` / :func:`surprisal_of_slate` and :func:`coverage_of`
+so the ONLINE quality monitor (`replay_tpu.obs.quality`) can score one served
+slate with exactly the offline formulas; the offline classes are thin wrappers
+over them (same floats, test-pinned in tests/metrics/test_quality_pure.py).
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, Iterable, List, Mapping, Sequence
 
 import numpy as np
 
 from .base import Metric, MetricsReturnType, _normalize
+
+
+def novelty_of_slate(slate: Sequence, seen: Iterable, k: int) -> float:
+    """Fraction of ``slate[:k]`` the user has NOT interacted with (``seen``).
+
+    An empty slate head is maximally novel (1.0) — the reference's empty-train/
+    empty-pred convention (replay/metrics/novelty.py).
+    """
+    head = list(slate[:k])
+    if not head:
+        return 1.0
+    return 1.0 - len(set(head) & set(seen)) / len(head)
+
+
+def surprisal_weights(train_dict: Mapping) -> Dict:
+    """Per-item normalized self-information from a ``{user: [item, ...]}`` log.
+
+    weight(item) = log2(n_users / n_consumers(item)) / log2(n_users); with a
+    single (or zero) user the normalizer is 1.0 (reference:
+    replay/metrics/surprisal.py:84-100). Items absent from the log weigh 1.0
+    at lookup time (:func:`surprisal_of_slate`).
+    """
+    n_users = len(train_dict)
+    consumers: dict = {}
+    for user, items in train_dict.items():
+        for item in items:
+            consumers.setdefault(item, set()).add(user)
+    log_n = np.log2(n_users) if n_users > 1 else 1.0
+    return {item: np.log2(n_users / len(users)) / log_n for item, users in consumers.items()}
+
+
+def weighted_surprisal(pred_weights: Sequence[float], k: int) -> float:
+    """Mean of the first-k per-item information weights, divided by k."""
+    return sum(pred_weights[:k]) / k
+
+
+def surprisal_of_slate(slate: Sequence, weights: Mapping, k: int) -> float:
+    """Surprisal of one slate against precomputed :func:`surprisal_weights`
+    (unseen items weigh 1.0; an empty slate scores 0.0)."""
+    if not slate:
+        return 0.0
+    return weighted_surprisal([weights.get(item, 1.0) for item in slate], k)
+
+
+def coverage_of(recommended: Iterable, train_items: Iterable) -> float:
+    """Fraction of the train catalog present in ``recommended`` (0.0 for an
+    empty catalog — the online monitor's safe degenerate)."""
+    catalog = set(train_items)
+    if not catalog:
+        return 0.0
+    return len(set(recommended) & catalog) / len(catalog)
 
 
 class Novelty(Metric):
@@ -27,7 +84,7 @@ class Novelty(Metric):
         if not train or not pred:
             return [1.0] * len(ks)
         seen = set(train)
-        return [1.0 - len(set(pred[:k]) & seen) / len(pred[:k]) for k in ks]
+        return [novelty_of_slate(pred, seen, k) for k in ks]
 
 
 class Surprisal(Metric):
@@ -41,13 +98,7 @@ class Surprisal(Metric):
         recs = self._recs_to_dict(recommendations)
         self._warn_duplicates(recs)
         train_dict = self._gt_to_dict(train)
-        n_users = len(train_dict)
-        consumers: dict = {}
-        for user, items in train_dict.items():
-            for item in items:
-                consumers.setdefault(item, set()).add(user)
-        log_n = np.log2(n_users) if n_users > 1 else 1.0
-        weights = {item: np.log2(n_users / len(users)) / log_n for item, users in consumers.items()}
+        weights = surprisal_weights(train_dict)
         rec_weights = {user: [weights.get(i, 1.0) for i in items] for user, items in recs.items()}
         return self._evaluate(recs, rec_weights)
 
@@ -55,7 +106,7 @@ class Surprisal(Metric):
     def _user_metric(ks: List[int], pred, pred_weights) -> List[float]:
         if not pred:
             return [0.0] * len(ks)
-        return [sum(pred_weights[:k]) / k for k in ks]
+        return [weighted_surprisal(pred_weights, k) for k in ks]
 
 
 class Coverage(Metric):
@@ -89,7 +140,7 @@ class Coverage(Metric):
             recommended = set()
             for items in recs.values():
                 recommended.update(items[:k])
-            out[f"{self.__name__}@{k}"] = _normalize(len(recommended & train_items) / len(train_items))
+            out[f"{self.__name__}@{k}"] = _normalize(coverage_of(recommended, train_items))
         return out
 
     @staticmethod

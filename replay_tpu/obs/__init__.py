@@ -45,6 +45,15 @@ from .events import (
 )
 from .exporter import MetricsExporter
 from .metrics import MetricsLogger, MetricsRegistry
+from .quality import (
+    QUALITY_SLOS,
+    DriftDetector,
+    PopularityDescriptor,
+    QualityMonitor,
+    canary_quality_rules,
+    population_stability_index,
+    prequential_scores,
+)
 from .slo import SLORule, SLOWatchdog
 from .mfu import (
     PEAK_BF16_TFLOPS,
@@ -79,6 +88,7 @@ __all__ = [
     "BlackboxLogger",
     "CompileTracker",
     "ConsoleLogger",
+    "DriftDetector",
     "FleetFederator",
     "FlightLog",
     "FlightRecorder",
@@ -97,6 +107,9 @@ __all__ = [
     "SLOWatchdog",
     "PEAK_BF16_TFLOPS",
     "PEAK_HBM_GBPS",
+    "PopularityDescriptor",
+    "QUALITY_SLOS",
+    "QualityMonitor",
     "RunLogger",
     "SERVE_GOODPUT_SPANS",
     "StepTelemetry",
@@ -106,6 +119,7 @@ __all__ = [
     "TrainerEvent",
     "analyze_program",
     "attribute_capture",
+    "canary_quality_rules",
     "classify",
     "cost_analysis",
     "federate_snapshots",
@@ -120,6 +134,8 @@ __all__ = [
     "of_ceiling",
     "peak_bandwidth",
     "peak_tflops",
+    "population_stability_index",
+    "prequential_scores",
     "program_costs",
     "read_flight",
     "scope_of",

@@ -41,6 +41,13 @@ with its own event family::
                                consecutive failures — one per transition)
       on_degrade*             (traffic rerouted down the degradation ladder:
                                to cache_only/fallback, reason; throttled)
+      on_quality_window*      (obs.quality: one per role per closed window —
+                               coverage, novelty, surprisal, popularity,
+                               intra-list diversity, score entropy/margin,
+                               online prequential hitrate/MRR/NDCG and the
+                               PSI drift state)
+      on_drift_warning*       (PSI crossed the drift threshold on some series;
+                               latched — one warning per excursion, throttled)
     on_serve_end              (request totals, cache hit rate, batch fill
                                ratio, queue-wait stats, shed/deadline-miss/
                                degradation totals, breaker stats, serve
@@ -504,6 +511,33 @@ class ConsoleLogger(RunLogger):
                 event.payload.get("generation"),
                 event.payload.get("restored_generation"),
                 ", ".join(event.payload.get("rules") or []) or "<manual>",
+            )
+        elif event.event == "on_quality_window":
+            drift = event.payload.get("drift") or {}
+            logger.info(
+                "quality[%s] @%s req: hitrate@%s %.4f (cum %.4f, %s joins), "
+                "coverage %.3f, novelty %.3f, surprisal %.3f, ild %.3f, "
+                "drift psi %.3f",
+                event.payload.get("role"),
+                event.payload.get("requests"),
+                event.payload.get("k"),
+                event.payload.get("online_hitrate") or 0.0,
+                event.payload.get("online_hitrate_cum") or 0.0,
+                event.payload.get("joins"),
+                event.payload.get("coverage") or 0.0,
+                event.payload.get("novelty") or 0.0,
+                event.payload.get("surprisal") or 0.0,
+                event.payload.get("ild") or 0.0,
+                (drift.get("max") if isinstance(drift, Mapping) else None) or 0.0,
+            )
+        elif event.event == "on_drift_warning":
+            logger.warning(
+                "DRIFT: psi %.3f on %s series crossed %.2f (max %.3f) — "
+                "serving distribution shifted",
+                event.payload.get("psi") or 0.0,
+                event.payload.get("series"),
+                event.payload.get("threshold") or 0.0,
+                event.payload.get("psi_max") or 0.0,
             )
         elif event.event == "on_epoch_end":
             logger.info("epoch %s: %s", event.epoch, event.payload.get("record"))

@@ -693,5 +693,44 @@ class MetricsLogger(RunLogger):
             self._gauge(
                 "replay_param_generation", payload.get("restored_generation")
             )
+        # the quality family (obs.quality): per-role windowed model-quality
+        # gauges, the online prequential counters and the PSI drift series —
+        # evaluate=True arms the drift/canary-quality SLO rules at window
+        # cadence, so the drift alarm fires through the normal watchdog
+        elif name == "on_quality_window":
+            labels = {"role": str(payload.get("role") or "stable")}
+            for key, metric in (
+                ("coverage", "replay_quality_coverage"),
+                ("novelty", "replay_quality_novelty"),
+                ("surprisal", "replay_quality_surprisal"),
+                ("popularity", "replay_quality_popularity"),
+                ("ild", "replay_quality_ild"),
+                ("score_entropy", "replay_quality_score_entropy"),
+                ("top1_margin", "replay_quality_top1_margin"),
+                ("online_hitrate", "replay_quality_online_hitrate"),
+                ("online_mrr", "replay_quality_online_mrr"),
+                ("online_ndcg", "replay_quality_online_ndcg"),
+                ("online_hitrate_cum", "replay_quality_online_hitrate_cum"),
+                ("online_mrr_cum", "replay_quality_online_mrr_cum"),
+                ("online_ndcg_cum", "replay_quality_online_ndcg_cum"),
+                ("joins", "replay_quality_joins"),
+                ("requests", "replay_quality_requests"),
+            ):
+                self._gauge(metric, payload.get(key), labels)
+            self.registry.inc("replay_quality_windows_total", labels=labels)
+            drift = payload.get("drift")
+            if isinstance(drift, Mapping):
+                for series, psi in drift.items():
+                    if series == "max":
+                        self._gauge("replay_drift_psi", psi)
+                    else:
+                        self._gauge(
+                            "replay_drift_psi_series", psi, {"series": str(series)}
+                        )
+            evaluate = True
+        elif name == "on_drift_warning":
+            self._count("replay_drift_warnings_total", payload.get("count") or 1.0)
+            self._gauge("replay_drift_psi", payload.get("psi_max"))
+            evaluate = True
         if evaluate and self.watchdog is not None:
             self.watchdog.evaluate(step=event.step)

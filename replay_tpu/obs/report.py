@@ -761,6 +761,77 @@ def summarize_events(
             serve["swap_recompiled"] = swap.get("recompiled_swaps")
     summary["serve"] = serve or None
 
+    # the quality plane (obs.quality): the last on_quality_window per role is
+    # the run's final windowed telemetry; drift warnings sum their coalesced
+    # counts; the bench drift-phase record (bench_serve.py) carries the
+    # injected-shift evidence the drift_psi --compare gate is phase-matched on
+    quality_windows = [e for e in events if e.get("event") == "on_quality_window"]
+    drift_warning_events = [
+        e for e in events if e.get("event") == "on_drift_warning"
+    ]
+    quality: Dict[str, Any] = {}
+    if quality_windows or drift_warning_events:
+        quality["windows"] = len(quality_windows)
+        quality["drift_warnings"] = sum(
+            int(e.get("count") or 1) for e in drift_warning_events
+        )
+        roles: Dict[str, Any] = {}
+        for e in quality_windows:
+            roles[str(e.get("role") or "stable")] = {
+                key: e.get(key)
+                for key in (
+                    "requests", "k", "joins", "coverage", "novelty",
+                    "surprisal", "popularity", "ild", "score_entropy",
+                    "top1_margin", "online_hitrate", "online_mrr",
+                    "online_ndcg", "online_hitrate_cum", "online_mrr_cum",
+                    "online_ndcg_cum",
+                )
+                if key in e
+            }
+        quality["roles"] = roles
+        # the stable slice's cumulative prequential metrics at the top level:
+        # what the higher-better online_hitrate gate reads
+        stable = roles.get("stable") or next(iter(roles.values()), {})
+        for key in (
+            "k", "joins", "online_hitrate_cum", "online_mrr_cum",
+            "online_ndcg_cum",
+        ):
+            if stable.get(key) is not None:
+                quality[key] = stable.get(key)
+        psi_values = [
+            value
+            for e in quality_windows
+            if isinstance(e.get("drift"), Mapping)
+            for value in (_finite(e["drift"].get("max")),)
+            if value is not None
+        ]
+        if psi_values:
+            quality["drift_psi"] = psi_values[-1]
+            quality["drift_psi_peak"] = max(psi_values)
+        if drift_warning_events:
+            quality["drift_series"] = sorted(
+                {
+                    str(e.get("series"))
+                    for e in drift_warning_events
+                    if e.get("series") is not None
+                }
+            )
+    if bench and "serve" in str(bench[-1].get("metric", "")):
+        drift_record = bench[-1].get("drift")
+        if isinstance(drift_record, Mapping):
+            # the injected preference-shift phase ran: psi/violations are
+            # meaningful and the lower-better drift_psi gate may apply
+            quality["drift_phase"] = True
+            for src, dst in (
+                ("slo_violations", "drift_slo_violations"),
+                ("warnings", "drift_phase_warnings"),
+                ("psi_peak", "drift_psi_peak"),
+                ("shift_fraction", "drift_shift_fraction"),
+            ):
+                if drift_record.get(src) is not None:
+                    quality[dst] = drift_record.get(src)
+    summary["quality"] = quality or None
+
     # the fleet summary (serve.fleet): router-level health/failover/hedge
     # events plus the bench_fleet.py record — per-replica serve totals come
     # from the merged per-replica event shards (each replica logs through
@@ -1378,6 +1449,53 @@ def render(summary: Mapping[str, Any]) -> str:
             if serve.get("swap_generations") is not None:
                 parts.append(f"{serve['swap_generations']} generation(s) observed")
             lines.append("  serving swap: " + " · ".join(parts))
+    quality = summary.get("quality")
+    if quality:
+        roles = quality.get("roles") or {}
+        for role in sorted(roles):
+            stats = roles[role]
+            parts = []
+            hitrate = _finite(stats.get("online_hitrate_cum"))
+            if hitrate is not None:
+                parts.append(
+                    f"online hitrate@{stats.get('k')} {hitrate:.4f}"
+                    + (
+                        f" ({stats['joins']} joins)"
+                        if stats.get("joins") is not None
+                        else ""
+                    )
+                )
+            ndcg = _finite(stats.get("online_ndcg_cum"))
+            if ndcg is not None:
+                parts.append(f"ndcg {ndcg:.4f}")
+            for label, key in (
+                ("coverage", "coverage"),
+                ("novelty", "novelty"),
+                ("surprisal", "surprisal"),
+                ("ild", "ild"),
+            ):
+                value = _finite(stats.get(key))
+                if value is not None:
+                    parts.append(f"{label} {value:.3f}")
+            lines.append(
+                f"  quality[{role}]: " + (" · ".join(parts) if parts else "no windows")
+            )
+        drift_parts = []
+        psi = _finite(quality.get("drift_psi"))
+        if psi is not None:
+            drift_parts.append(f"psi {psi:.3f}")
+        peak = _finite(quality.get("drift_psi_peak"))
+        if peak is not None:
+            drift_parts.append(f"peak {peak:.3f}")
+        drift_parts.append(f"{quality.get('drift_warnings', 0)} warning(s)")
+        if quality.get("drift_series"):
+            drift_parts.append("series " + ",".join(quality["drift_series"]))
+        if quality.get("drift_phase"):
+            drift_parts.append(
+                f"injected-shift phase: {quality.get('drift_slo_violations', 0)} "
+                "SLO violation(s)"
+            )
+        lines.append("  quality drift: " + " · ".join(drift_parts))
     fleet = summary.get("fleet")
     if fleet:
         parts = []
@@ -1546,6 +1664,9 @@ def compare_runs(
     higher-better always, and ``fleet_p99_ms`` / ``fleet_reroute_rate``
     lower-better only when the chaos phase matches on both sides (a kill's
     failover gap and reroutes must not fail against a no-chaos baseline).
+    Quality runs (obs.quality) gate ``quality_online_hitrate`` higher-better
+    with the same absolute 0.005 floor, and ``quality_drift_psi`` lower-better
+    only when the injected-shift phase matches on both sides.
     """
     if memory_threshold is None:
         memory_threshold = threshold
@@ -1923,6 +2044,47 @@ def compare_runs(
         base_loc = _finite(base_fleet.get("cache_hit_locality"))
         if cand_loc is not None and base_loc is not None:
             lines.append(f"  fleet_cache_hit_locality: {cand_loc:.3f} vs {base_loc:.3f}")
+    # quality gates (obs.quality): the ONLINE prequential hitrate is higher-
+    # better with an ABSOLUTE floor (same rule as the quant recall gates —
+    # online ranking quality sliding within a loose relative threshold is
+    # exactly what this gate exists to catch); drift PSI is lower-better but
+    # only between two runs that BOTH ran the injected-shift phase (the
+    # phase-matching rule: a drift run's psi peak is the injection's whole
+    # point and must not fail against a steady-traffic baseline)
+    cand_quality = candidate.get("quality") or {}
+    base_quality = baseline.get("quality") or {}
+    if cand_quality or base_quality:
+        cand_hr = _finite(cand_quality.get("online_hitrate_cum"))
+        base_hr = _finite(base_quality.get("online_hitrate_cum"))
+        if cand_hr is None or base_hr is None:
+            lines.append(
+                f"  quality_online_hitrate: candidate={_fmt(cand_hr, '{:.4f}')} "
+                f"baseline={_fmt(base_hr, '{:.4f}')} (not comparable)"
+            )
+        else:
+            lines.append(
+                f"  quality_online_hitrate: {cand_hr:.4f} vs {base_hr:.4f}"
+            )
+            if cand_hr < base_hr - 0.005:
+                regressions.append(
+                    f"quality_online_hitrate regressed "
+                    f"{base_hr:.4f} -> {cand_hr:.4f} (higher is better)"
+                )
+        cand_ndcg = _finite(cand_quality.get("online_ndcg_cum"))
+        base_ndcg = _finite(base_quality.get("online_ndcg_cum"))
+        if cand_ndcg is not None and base_ndcg is not None:
+            lines.append(
+                f"  quality_online_ndcg: {cand_ndcg:.4f} vs {base_ndcg:.4f}"
+            )
+        cand_psi = _finite(cand_quality.get("drift_psi_peak"))
+        base_psi = _finite(base_quality.get("drift_psi_peak"))
+        if cand_quality.get("drift_phase") and base_quality.get("drift_phase"):
+            check_lower_better("quality_drift_psi", cand_psi, base_psi, threshold)
+        else:
+            surface_rate(
+                "quality_drift_psi", cand_psi, base_psi,
+                "drift phase ran on one side only",
+            )
     # tail-attribution gate: a hop's SHARE of the p99 mix growing by more
     # than 10 points is a regression even when p99 itself is flat — where
     # the tail's time goes is its own contract (e.g. queue_wait swallowing

@@ -36,6 +36,12 @@ ANN-index stack (SURVEY §2.8), built from this repo's own pieces:
 open-loop OVERLOAD and ``--chaos`` fault-injection modes — and emits the
 QPS/latency/fill/hit-rate/shed-rate record ``obs.report`` renders and gates
 on. See docs/serving.md.
+
+Attach an :class:`~replay_tpu.obs.QualityMonitor` via ``ScoringService(
+quality=...)`` to watch the MODEL-quality plane of the same traffic (online
+prequential hitrate/NDCG, coverage/novelty/surprisal, PSI drift — docs/
+observability.md "The quality plane"); :func:`top_k_cut` is the shared
+ranked-cut contract over both :class:`ScoreResponse` shapes it relies on.
 """
 
 from .batcher import MicroBatcher
@@ -62,7 +68,7 @@ from .promote import (
 )
 from .quant import QuantizedTable, quantization_error, quantize_embeddings
 from .remote import RemoteReplica, ReplicaServer, ReplicaServerProcess
-from .request import ScoreRequest, ScoreResponse, make_window
+from .request import ScoreRequest, ScoreResponse, make_window, top_k_cut
 from .router import REPLICA_HEALTH, BackoffPolicy, HashRing, ReplicaHealth
 from .service import ScoringService
 
@@ -102,4 +108,5 @@ __all__ = [
     "make_window",
     "quantization_error",
     "quantize_embeddings",
+    "top_k_cut",
 ]

@@ -968,6 +968,40 @@ class ServingFleet:
                         "health_transitions": handle.health.transition_count,
                     }
                 )
+        # the quality plane, one level up (obs.quality): replicas that carry a
+        # QualityMonitor surface their pure-JSON snapshots, plus the fleet-
+        # level join-weighted online hitrate and worst drift PSI — the fleet
+        # analog of the single-service stats()["quality"] block. In-process
+        # replicas only: a remote replica's quality rides its own /snapshot
+        per_replica_quality: Dict[str, Any] = {}
+        for rid, handle in self.handles.items():
+            monitor = getattr(handle.service, "quality", None)
+            if monitor is None:
+                continue
+            try:
+                per_replica_quality[rid] = monitor.snapshot()
+            except Exception:  # noqa: BLE001 — telemetry must not fail stats
+                continue
+        if per_replica_quality:
+            joins = 0
+            hits = 0.0
+            psi_values = []
+            for snap in per_replica_quality.values():
+                stable = (snap.get("roles") or {}).get("stable") or {}
+                replica_joins = int(stable.get("joins") or 0)
+                hitrate = stable.get("online_hitrate_cum")
+                if replica_joins and hitrate is not None:
+                    joins += replica_joins
+                    hits += float(hitrate) * replica_joins
+                psi = (snap.get("drift") or {}).get("max")
+                if psi is not None:
+                    psi_values.append(float(psi))
+            out["quality"] = {
+                "per_replica": per_replica_quality,
+                "joins": joins,
+                "online_hitrate_cum": hits / joins if joins else None,
+                "drift_psi_max": max(psi_values) if psi_values else None,
+            }
         return out
 
     # -- helpers -------------------------------------------------------------- #

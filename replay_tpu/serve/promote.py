@@ -40,6 +40,17 @@ Events (``on_publish`` / ``on_swap`` / ``on_canary_start`` /
 normal sink fan-out, so ``events.jsonl``, the metrics registry and
 ``obs.report``'s "promotion" section all see the same record. See
 docs/robustness.md "Zero-downtime swaps and canary promotion".
+
+Quality-gated canaries (obs.quality): when the service carries a
+:class:`~replay_tpu.obs.QualityMonitor`, its candidate-slice gauges
+(``replay_quality_*{role="candidate"}``) land in the SAME registry this
+controller's watchdog reads — so passing
+:func:`~replay_tpu.obs.canary_quality_rules` (or hand-written
+:class:`~replay_tpu.obs.SLORule`\\ s over those labeled series) as ``rules=``
+makes a canary that serves fast-but-WORSE recommendations roll back exactly
+like an erroring one, with zero controller changes. The ``on_canary_eval``
+record then also carries the candidate's online quality window (``quality``
+key) as the decision's evidence trail.
 """
 
 from __future__ import annotations
@@ -330,8 +341,12 @@ class PromotionController:
         ``service.publish_candidate`` and swaps through ``service.promote`` /
         ``service.rollback`` so every move is atomic w.r.t. dispatch.
     :param rules: :class:`~replay_tpu.obs.SLORule` set over the
-        ``replay_canary_*`` gauges this controller maintains. Default: any
-        canary error rolls back (``replay_canary_error_rate > 0``).
+        ``replay_canary_*`` gauges this controller maintains — or over any
+        other series in the service's registry, e.g. the candidate-labeled
+        ``replay_quality_*`` gauges a :class:`~replay_tpu.obs.QualityMonitor`
+        maintains (:func:`~replay_tpu.obs.canary_quality_rules` builds that
+        set). Default: any canary error rolls back
+        (``replay_canary_error_rate > 0``).
     :param promote_after: consecutive clean evaluations (each with enough
         traffic) before the candidate is promoted.
     :param min_canary_requests: canary responses an evaluation window must
@@ -493,6 +508,20 @@ class PromotionController:
             "evals": self.evals,
             "breached_rules": breached,
         }
+        monitor = getattr(self.service, "quality", None)
+        if monitor is not None:
+            # the decision's quality evidence: the candidate slice's online
+            # window at evaluation time (what the quality rules just judged)
+            candidate = (monitor.snapshot().get("roles") or {}).get("candidate")
+            if candidate:
+                record["quality"] = {
+                    key: candidate.get(key)
+                    for key in (
+                        "joins", "online_hitrate_cum", "online_ndcg_cum",
+                        "coverage", "novelty", "popularity",
+                    )
+                    if candidate.get(key) is not None
+                }
         self._emit("on_canary_eval", dict(record))
         if action == "rollback":
             self._rollback(breached)

@@ -55,7 +55,12 @@ class ScoreResponse:
 
     ``item_ids`` is populated for ranked responses (retrieval mode and top-k
     cuts); for full-catalog scores it is ``None`` and ``scores[i]`` is item
-    ``i``'s score.
+    ``i``'s score. In BOTH representations :func:`top_k_cut` recovers the
+    ranked top-k ``(item_ids, scores)`` pair — the one contract every
+    downstream consumer (quality telemetry, bench clients) relies on, so a
+    response never needs to know which shape it was served in. ``item_ids``
+    order is NOT guaranteed sorted by score (the candidate-gather path keeps
+    request order); ``top_k_cut`` always re-ranks.
     """
 
     user_id: Hashable
@@ -143,6 +148,33 @@ class PendingRequest:
     # its timeline. None when the request arrived untraced — the default path
     # allocates nothing
     trace: Optional[dict] = None
+
+
+def top_k_cut(response: "ScoreResponse", k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """The ranked top-k ``(item_ids, scores)`` of a response, score-descending.
+
+    Works on BOTH response shapes: full-catalog (``item_ids is None`` —
+    ``argpartition`` picks the k best of ``scores`` without sorting the whole
+    catalog) and ranked/candidate responses (``item_ids`` present — re-ranked,
+    because the candidate-gather path returns scores in REQUEST order). Ties
+    break by original position (stable), and ``k`` is clamped to the available
+    items. This is the one shared cut used by the quality monitor and the
+    bench clients instead of private argsort copies.
+    """
+    scores = np.asarray(response.scores).reshape(-1)
+    if response.item_ids is None:
+        k = min(int(k), scores.shape[0])
+        if k <= 0:
+            return np.empty(0, np.int64), np.empty(0, scores.dtype)
+        part = np.argpartition(scores, scores.shape[0] - k)[scores.shape[0] - k :]
+        order = part[np.argsort(-scores[part], kind="stable")]
+        return order.astype(np.int64), scores[order]
+    item_ids = np.asarray(response.item_ids).reshape(-1)
+    k = min(int(k), item_ids.shape[0])
+    if k <= 0:
+        return np.empty(0, item_ids.dtype), np.empty(0, scores.dtype)
+    order = np.argsort(-scores[: item_ids.shape[0]], kind="stable")[:k]
+    return item_ids[order], scores[order]
 
 
 def make_window(

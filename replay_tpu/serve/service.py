@@ -146,6 +146,7 @@ class ScoringService:
         param_store: Optional[ParamStore] = None,
         cold_miss: str = "error",
         flight_path: Optional[str] = None,
+        quality: Optional[Any] = None,
     ) -> None:
         if retrieval is not None and candidates is not None:
             msg = "retrieval mode and a fixed candidate slate are mutually exclusive"
@@ -261,6 +262,13 @@ class ScoringService:
                     port=metrics_port,
                     health_source=self.heartbeat,
                 )
+        # quality plane (obs.quality): same zero-new-hooks pattern — the
+        # monitor watches resolved responses and emits on_quality_window /
+        # on_drift_warning back through THIS service's _emit fan-out, so its
+        # gauges ride the existing metrics bridge, exporter and SLO watchdog
+        self.quality = quality
+        if quality is not None:
+            quality.bind(self._emit, self._emit_throttled)
         # flight recorder (obs.blackbox): same attach-the-sink pattern — the
         # _emit fan-out carries every serve event into the SIGKILL-proof ring
         self._blackbox = None
@@ -312,6 +320,13 @@ class ScoringService:
             return
         self.batcher.stop()
         self._started = False
+        if self.quality is not None:
+            # partial windows land before the terminal event — the last
+            # quality gauges are in the registry when on_serve_end snapshots
+            try:
+                self.quality.flush()
+            except Exception:  # noqa: BLE001 — telemetry must not fail close
+                pass
         self._flush_throttled()
         payload = dict(self.stats())
         snapshot = self.tracer.snapshot()
@@ -886,6 +901,7 @@ class ScoringService:
     ) -> None:
         response = self._fallback_response(request)
         response.role = role
+        self._observe_quality(response, request)
         if self._safe_set_result(future, response):
             with self._count_lock:
                 # under _count_lock: += on the scorer attribute is a
@@ -1266,6 +1282,11 @@ class ScoringService:
                         if self._counts_for_role(role, item):
                             self._role_stats[role]["errors"] += 1
                 continue
+            # observe BEFORE resolving: a client that saw result() return is
+            # guaranteed its response was already counted by the quality
+            # monitor — the reconciliation contract the online/offline parity
+            # test (and the bench's join accounting) leans on
+            self._observe_quality(response, item.request)
             if not self._safe_set_result(item.future, response):
                 with self._count_lock:
                     self._cancelled += 1
@@ -1362,6 +1383,22 @@ class ScoringService:
     _mark_running = staticmethod(mark_running)
     _safe_fail = staticmethod(safe_fail)
     _safe_set_result = staticmethod(safe_set_result)
+
+    def _observe_quality(self, response: ScoreResponse, request: ScoreRequest) -> None:
+        """Feed one resolved response to the quality monitor. A broken monitor
+        detaches itself rather than poison the serving path: quality telemetry
+        is strictly best-effort."""
+        monitor = self.quality
+        if monitor is None:
+            return
+        try:
+            monitor.observe(response, request)
+        except Exception:  # noqa: BLE001
+            self.quality = None
+            logger.exception(
+                "quality monitor raised; detached — responses keep flowing "
+                "unobserved"
+            )
 
     # -- accounting --------------------------------------------------------- #
     def _route_event(self, event: TrainerEvent) -> None:
@@ -1506,4 +1543,8 @@ class ScoringService:
                 if canary is not None
                 else None
             ),
+            # the quality plane (obs.quality): pure-JSON monitor snapshot —
+            # per-role windowed telemetry + online prequential cumulatives +
+            # PSI drift state. None when no monitor is attached
+            "quality": self.quality.snapshot() if self.quality is not None else None,
         }

@@ -1,0 +1,85 @@
+"""The offline↔online seam (metrics/beyond_accuracy.py pure functions): the
+per-slate math the online quality monitor runs MUST be bitwise the math the
+offline wrapper classes aggregate — pinned against the reference's golden
+values and cross-checked wrapper-vs-pure on the same fixtures."""
+
+import pandas as pd
+import pytest
+
+from replay_tpu.metrics import Coverage, Novelty, PerUser, Surprisal
+from replay_tpu.metrics.beyond_accuracy import (
+    coverage_of,
+    novelty_of_slate,
+    surprisal_of_slate,
+    surprisal_weights,
+    weighted_surprisal,
+)
+
+RECS = pd.DataFrame(
+    [
+        (1, 3, 0.6), (1, 7, 0.5), (1, 10, 0.4), (1, 11, 0.3), (1, 2, 0.2),
+        (2, 5, 0.6), (2, 8, 0.5), (2, 11, 0.4), (2, 1, 0.3), (2, 3, 0.2),
+        (3, 4, 1.0), (3, 9, 0.5), (3, 2, 0.1),
+    ],
+    columns=["query_id", "item_id", "rating"],
+)
+TRAIN = pd.DataFrame(
+    [
+        (1, 5), (1, 6), (1, 8), (1, 9), (1, 2),
+        (2, 5), (2, 8), (2, 11), (2, 1), (2, 3),
+        (3, 4), (3, 9), (3, 2),
+    ],
+    columns=["query_id", "item_id"],
+)
+
+# the same fixtures as plain dicts (score-desc slates) — the representation
+# the online monitor sees
+SLATES = {1: [3, 7, 10, 11, 2], 2: [5, 8, 11, 1, 3], 3: [4, 9, 2]}
+TRAIN_DICT = {1: [5, 6, 8, 9, 2], 2: [5, 8, 11, 1, 3], 3: [4, 9, 2]}
+
+
+def test_novelty_pure_reproduces_the_golden_wrapper_value():
+    per_slate = [
+        novelty_of_slate(SLATES[user], set(TRAIN_DICT[user]), 2) for user in (1, 2, 3)
+    ]
+    assert sum(per_slate) / 3 == pytest.approx(0.3333333333333333)
+    assert Novelty(2)(RECS, TRAIN) == pytest.approx({"Novelty@2": sum(per_slate) / 3})
+    # per-user: the wrapper's values ARE the pure function's, user by user
+    per_user = Novelty(2, mode=PerUser())(RECS, TRAIN)["Novelty-PerUser@2"]
+    for user in (1, 2, 3):
+        assert per_user[user] == pytest.approx(
+            novelty_of_slate(SLATES[user], set(TRAIN_DICT[user]), 2)
+        )
+
+
+def test_surprisal_pure_reproduces_the_golden_wrapper_value():
+    weights = surprisal_weights(TRAIN_DICT)
+    per_slate = [surprisal_of_slate(SLATES[user], weights, 2) for user in (1, 2, 3)]
+    assert sum(per_slate) / 3 == pytest.approx(0.6845351232142715)
+    assert Surprisal(2)(RECS, TRAIN) == pytest.approx(
+        {"Surprisal@2": sum(per_slate) / 3}
+    )
+
+
+def test_surprisal_unseen_items_weigh_one():
+    weights = surprisal_weights(TRAIN_DICT)
+    assert 999 not in weights
+    assert surprisal_of_slate([999, 999], weights, 2) == pytest.approx(1.0)
+    assert weighted_surprisal([1.0, 1.0], 2) == pytest.approx(1.0)
+
+
+def test_coverage_pure_reproduces_the_golden_wrapper_value():
+    recommended = set()
+    for slate in SLATES.values():
+        recommended.update(slate[:2])
+    train_items = {item for items in TRAIN_DICT.values() for item in items}
+    assert coverage_of(recommended, train_items) == pytest.approx(0.5555555555555556)
+    assert Coverage(2)(RECS, TRAIN) == pytest.approx(
+        {"Coverage@2": coverage_of(recommended, train_items)}
+    )
+
+
+def test_pure_function_degenerates():
+    assert novelty_of_slate([], [1, 2], 3) == 1.0  # empty head = maximally novel
+    assert surprisal_of_slate([], {}, 3) == 0.0
+    assert coverage_of([1, 2], []) == 0.0

@@ -1,0 +1,249 @@
+"""The quality plane's pure core (obs.quality): PSI math, drift detection,
+the popularity descriptor artifact, the prequential per-slate formulas and
+the SLO cookbook — everything that runs jax-free. The monitor-through-service
+half (online/offline reconciliation, the drift SLO through the watchdog, the
+quality-gated canary) lives in tests/serve/test_quality_service.py.
+"""
+
+import math
+
+import pytest
+
+from replay_tpu.obs.quality import (
+    QUALITY_SLOS,
+    DriftDetector,
+    PopularityDescriptor,
+    QualityMonitor,
+    canary_quality_rules,
+    population_stability_index,
+    prequential_scores,
+)
+
+pytestmark = pytest.mark.core
+
+
+# ---------------------------------------------------------------------------
+# population stability index
+# ---------------------------------------------------------------------------
+
+
+class TestPSI:
+    def test_identical_distributions_are_stable(self):
+        values = [i / 100.0 for i in range(100)]
+        edges = [0.0, 0.25, 0.5, 0.75, 1.0]
+        assert population_stability_index(values, list(values), edges) == pytest.approx(
+            0.0, abs=1e-9
+        )
+
+    def test_shifted_distribution_scores_high(self):
+        edges = [0.0, 0.25, 0.5, 0.75, 1.0]
+        reference = [i / 100.0 for i in range(100)]
+        shifted = [0.9] * 100  # everything lands in the top bin
+        psi = population_stability_index(reference, shifted, edges)
+        assert psi > 1.0
+
+    def test_out_of_range_values_clamp_into_boundary_bins(self):
+        edges = [0.0, 0.5, 1.0]
+        reference = [0.25] * 50 + [0.75] * 50
+        # a distribution far outside the edges must land in the tails, not
+        # vanish — PSI sees the shift instead of reporting empty bins
+        psi = population_stability_index(reference, [100.0] * 50, edges)
+        assert psi > 0.5
+
+    def test_degenerate_inputs_are_zero(self):
+        assert population_stability_index([], [1.0], [0.0, 1.0]) == 0.0
+        assert population_stability_index([1.0], [], [0.0, 1.0]) == 0.0
+        assert population_stability_index([1.0], [1.0], [0.0]) == 0.0
+
+    def test_symmetry(self):
+        edges = [0.0, 0.25, 0.5, 0.75, 1.0]
+        a = [0.1] * 60 + [0.6] * 40
+        b = [0.1] * 20 + [0.6] * 80
+        assert population_stability_index(a, b, edges) == pytest.approx(
+            population_stability_index(b, a, edges)
+        )
+
+
+# ---------------------------------------------------------------------------
+# drift detector
+# ---------------------------------------------------------------------------
+
+
+class TestDriftDetector:
+    def test_not_ready_before_reference_and_min_window(self):
+        detector = DriftDetector(bins=4, reference_size=10, window=10, min_window=5)
+        for i in range(10):
+            assert detector.psi() is None
+            detector.observe(i / 10.0)
+        # reference frozen; the window is still empty
+        assert detector.psi() is None
+        for i in range(4):
+            detector.observe(i / 10.0)
+        assert detector.psi() is None  # 4 < min_window
+        detector.observe(0.5)
+        assert detector.psi() is not None
+
+    def test_same_distribution_stays_low_shift_detected(self):
+        detector = DriftDetector(bins=5, reference_size=50, window=25, min_window=25)
+        for i in range(50):
+            detector.observe((i % 10) / 10.0)
+        for i in range(25):
+            detector.observe((i % 10) / 10.0)
+        stable_psi = detector.psi()
+        assert stable_psi is not None and stable_psi < 0.25
+        for _ in range(25):  # the window slides fully onto the shifted regime
+            detector.observe(0.95)
+        assert detector.psi() > 1.0
+
+    def test_constant_reference_does_not_crash(self):
+        detector = DriftDetector(bins=4, reference_size=5, window=5, min_window=2)
+        for _ in range(5):
+            detector.observe(1.0)
+        detector.observe(2.0)
+        detector.observe(2.0)
+        assert detector.psi() > 0.0
+
+    def test_non_finite_observations_are_dropped(self):
+        detector = DriftDetector(bins=4, reference_size=4, window=4, min_window=2)
+        for value in (0.0, float("nan"), 1.0, float("inf"), 0.5, 0.25):
+            detector.observe(value)
+        assert detector.state()["reference"] == 4
+
+    def test_rejects_degenerate_bins(self):
+        with pytest.raises(ValueError):
+            DriftDetector(bins=1)
+
+
+# ---------------------------------------------------------------------------
+# popularity descriptor
+# ---------------------------------------------------------------------------
+
+
+TRAIN = {
+    "u0": [0, 1, 2],
+    "u1": [0, 1],
+    "u2": [0],
+    "u3": [3],
+}
+
+
+class TestPopularityDescriptor:
+    def test_matches_offline_surprisal_weights(self):
+        from replay_tpu.metrics.beyond_accuracy import surprisal_weights
+
+        descriptor = PopularityDescriptor.from_train(TRAIN, num_items=10)
+        offline = surprisal_weights(TRAIN)
+        for item, weight in offline.items():
+            assert descriptor.surprisal_weight(item) == pytest.approx(float(weight))
+        # unseen items weigh 1.0 in BOTH formulations
+        assert descriptor.surprisal_weight(9) == 1.0
+
+    def test_popularity_fractions_and_deciles(self):
+        descriptor = PopularityDescriptor.from_train(TRAIN, num_items=10)
+        assert descriptor.popularity(0) == pytest.approx(3 / 4)
+        assert descriptor.popularity(1) == pytest.approx(2 / 4)
+        assert descriptor.popularity(9) == 0.0
+        # item 0 is the head; an unseen item is tail by definition
+        assert descriptor.decile(0) == 0
+        assert descriptor.decile(9) == 9
+
+    def test_json_round_trip_is_exact(self):
+        descriptor = PopularityDescriptor.from_train(TRAIN, num_items=10)
+        clone = PopularityDescriptor.from_json(descriptor.to_json())
+        assert clone.consumers == descriptor.consumers
+        assert clone.n_users == descriptor.n_users
+        assert clone.num_items == descriptor.num_items
+        assert clone.train_items == descriptor.train_items
+        for item in range(10):
+            assert clone.surprisal_weight(item) == descriptor.surprisal_weight(item)
+            assert clone.popularity(item) == descriptor.popularity(item)
+            assert clone.decile(item) == descriptor.decile(item)
+
+
+# ---------------------------------------------------------------------------
+# prequential per-slate formulas (the metrics/ranking.py per-user math)
+# ---------------------------------------------------------------------------
+
+
+class TestPrequentialScores:
+    def test_hit_at_rank_three(self):
+        hit, rr, ndcg = prequential_scores([7, 8, 9, 10], [9], k=4)
+        assert hit == 1.0
+        assert rr == pytest.approx(1.0 / 3.0)
+        # one relevant item at rank 3 (0-based 2): dcg = 1/log2(4), idcg = 1
+        assert ndcg == pytest.approx((1.0 / math.log2(4.0)) / 1.0)
+
+    def test_miss_is_all_zero(self):
+        assert prequential_scores([1, 2, 3], [9], k=3) == (0.0, 0.0, 0.0)
+
+    def test_k_truncates_the_slate(self):
+        # the relevant item sits at rank 3 but k=2 cuts it off
+        assert prequential_scores([1, 2, 9], [9], k=2) == (0.0, 0.0, 0.0)
+
+    def test_idcg_truncates_ground_truth_at_k(self):
+        # 3 relevant items, k=2, both slate slots hit: NDCG must be 1.0
+        # (IDCG truncates the raw ground-truth length at k)
+        hit, rr, ndcg = prequential_scores([5, 6], [5, 6, 7], k=2)
+        assert (hit, rr) == (1.0, 1.0)
+        assert ndcg == pytest.approx(1.0)
+
+    def test_empty_inputs(self):
+        assert prequential_scores([], [1], k=3) == (0.0, 0.0, 0.0)
+        assert prequential_scores([1], [], k=3) == (0.0, 0.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# SLO cookbook
+# ---------------------------------------------------------------------------
+
+
+class TestQualityRules:
+    def test_cookbook_rules_are_well_formed(self):
+        names = [rule.label for rule in QUALITY_SLOS]
+        assert "drift_psi" in names
+        assert "canary_online_hitrate" in names
+        assert len(set(names)) == len(names)
+
+    def test_canary_rules_only_for_passed_thresholds(self):
+        assert canary_quality_rules() == ()
+        rules = canary_quality_rules(
+            min_online_hitrate=0.05, min_coverage=0.01, max_popularity=0.9
+        )
+        by_name = {rule.label: rule for rule in rules}
+        assert set(by_name) == {
+            "canary_online_hitrate",
+            "canary_coverage",
+            "canary_popularity_bias",
+        }
+        # every rule gates the CANDIDATE slice of the labeled gauges
+        for rule in rules:
+            assert rule.labels == {"role": "candidate"}
+        assert by_name["canary_popularity_bias"].op == ">"
+        assert by_name["canary_online_hitrate"].op == "<"
+
+    def test_alarmed_series_exclude_coverage(self):
+        # coverage PSI is one aggregate observation per emitted window —
+        # dashboard signal, never the alarm (it would flap on traffic mix)
+        assert "coverage" not in QualityMonitor.ALARMED_SERIES
+        assert set(QualityMonitor.ALARMED_SERIES) == {
+            "score",
+            "popularity",
+            "interactions",
+        }
+
+
+def test_obs_package_imports_without_jax():
+    """`import replay_tpu.obs` must stay jax-free: the quality plane reaches
+    the offline per-slate math through a lazy seam, not a module import."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import replay_tpu.obs\n"
+        "assert 'jax' not in sys.modules, 'obs import pulled jax'\n"
+    )
+    probe = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert probe.returncode == 0, probe.stderr
