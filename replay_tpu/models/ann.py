@@ -40,11 +40,28 @@ class MIPSIndex:
         mesh=None,
         axis_name: str = "data",
         precision: str = "f32",
+        index: str = "brute",
+        nlist: Optional[int] = None,
+        nprobe: int = 32,
+        build_iters: int = 10,
+        build_sample: int = 131072,
+        pq_subspaces: int = 8,
+        seed: int = 0,
     ) -> None:
         import jax
         import jax.numpy as jnp
 
-        if precision not in ("f32", "int8"):
+        if index not in ("brute", "ivf"):
+            msg = f"MIPSIndex index must be 'brute' or 'ivf', got {index!r}"
+            raise ValueError(msg)
+        if index == "ivf":
+            if precision not in ("f32", "int8", "int8+pq"):
+                msg = (
+                    "MIPSIndex(index='ivf') precision must be 'f32', 'int8' or "
+                    f"'int8+pq', got {precision!r}"
+                )
+                raise ValueError(msg)
+        elif precision not in ("f32", "int8"):
             msg = f"MIPSIndex precision must be 'f32' or 'int8', got {precision!r}"
             raise ValueError(msg)
         self.num_items, self.dim = item_vectors.shape
@@ -52,6 +69,33 @@ class MIPSIndex:
         self.mesh = mesh
         self.axis_name = axis_name
         self.precision = precision
+        self.index_mode = index
+        self._ivf = None
+        self._search_cache = {}
+        self._rescore_fn = None
+
+        if index == "ivf":
+            from replay_tpu.models.ivf import IVFConfig, build_ivf, default_nlist
+
+            n_shards = 1 if mesh is None else int(mesh.shape[axis_name])
+            if nlist is None:
+                nlist = default_nlist(self.num_items, n_shards)
+            config = IVFConfig(
+                nlist=int(nlist),
+                nprobe=int(nprobe),
+                build_iters=int(build_iters),
+                build_sample=int(build_sample),
+                pq_subspaces=int(pq_subspaces),
+                seed=int(seed),
+            )
+            self._ivf = build_ivf(
+                self.host_vectors.astype(np.float32), precision, config,
+                mesh=mesh, axis_name=axis_name,
+            )
+            self.item_vectors = None  # cell-major storage lives in self._ivf
+            self.item_scales = None
+            self._payload_nbytes = self._ivf_bytes()["cell_bytes"]
+            return
 
         scales = None
         if precision == "int8":
@@ -98,16 +142,62 @@ class MIPSIndex:
         self._search_cache = {}
         self._rescore_fn = None
 
+    @property
+    def is_approximate(self) -> bool:
+        """True when the sweep only SELECTS candidates (IVF probing and/or a
+        quantized table) — the pipeline's cue to insert ``exact_rescore``
+        before ranking. Only the brute f32 sweep scores exactly."""
+        return self.index_mode == "ivf" or self.precision != "f32"
+
+    def _ivf_bytes(self) -> dict:
+        from replay_tpu.models.ivf import ivf_bytes
+
+        state = self._ivf
+        return ivf_bytes(
+            self.num_items,
+            self.dim,
+            state.config.nlist,
+            self.precision,
+            pq_subspaces=state.config.pq_subspaces,
+            padded_fraction=state.padded_fraction,
+        )
+
+    def index_stats(self) -> dict:
+        """Build/search geometry the bench records and the report renders."""
+        if self.index_mode != "ivf":
+            return {"index": "brute", "num_items": self.num_items, "dim": self.dim}
+        state = self._ivf
+        return {
+            "index": "ivf",
+            "num_items": self.num_items,
+            "dim": self.dim,
+            "nlist": state.config.nlist,
+            "nprobe": state.config.nprobe,
+            "cmax": state.cmax,
+            "padded_fraction": round(state.padded_fraction, 4),
+            "scanned_fraction": round(
+                state.config.nprobe * state.cmax / max(self.num_items, 1), 4
+            ),
+            "n_shards": state.n_shards,
+        }
+
     def table_bytes(self) -> dict:
         """Logical payload bytes of the device catalog (unpadded rows): the
-        honesty number the quant bench rows report next to the f32 baseline."""
+        honesty number the quant bench rows report next to the f32 baseline.
+        IVF adds the machine-derived breakdown (centroid/cell/codebook/id
+        bytes) priced by the same formula as the 100M projection."""
         f32_bytes = int(self.num_items * self.dim * 4)
-        return {
+        out = {
             "precision": self.precision,
             "payload_bytes": int(self._payload_nbytes),
             "f32_bytes": f32_bytes,
             "bytes_ratio": self._payload_nbytes / max(f32_bytes, 1),
         }
+        if self.index_mode == "ivf":
+            out.update(self._ivf_bytes())
+            out["payload_bytes"] = out["total_bytes"]
+            out["bytes_ratio"] = out["total_bytes"] / max(f32_bytes, 1)
+        return out
 
     def _compiled_search(self, k: int):
         import jax
@@ -115,6 +205,12 @@ class MIPSIndex:
 
         if k in self._search_cache:
             return self._search_cache[k]
+        if self.index_mode == "ivf":
+            from replay_tpu.models.ivf import make_search_fn
+
+            search = make_search_fn(self._ivf, k)
+            self._search_cache[k] = search
+            return search
         quantized = self.precision == "int8"
 
         if self.mesh is not None:
@@ -197,7 +293,15 @@ class MIPSIndex:
     def table_shard_bytes(self) -> int:
         """Per-shard payload bytes of the device table (padded rows included)
         — the collective-size threshold the no-gather assertion compares
-        against."""
+        against. For IVF this is the per-shard CELL payload (rows for
+        f32/int8, uint8 codes for int8+pq): the bytes a table-sized gather
+        would have to move."""
+        if self.index_mode == "ivf":
+            state = self._ivf
+            if self.precision == "int8+pq":
+                return state.storage_rows * state.config.pq_subspaces
+            itemsize = 1 if self.precision == "int8" else 4
+            return state.storage_rows * self.dim * itemsize
         rows = int(self.item_vectors.shape[0])
         if self.mesh is not None:
             rows = rows // int(self.mesh.shape[self.axis_name])

@@ -738,6 +738,23 @@ def summarize_events(
                 )
                 if key in quant
             }
+        ann = record.get("ann")
+        if isinstance(ann, Mapping):
+            # the IVF sub-linear retrieval phase: recall@100 / topk agreement
+            # are --compare higher-better gates (0.005 abs floor, the quant
+            # convention); ann_qps is higher-better; the speedup line renders
+            # brute-vs-IVF throughput on the same catalog
+            serve["ann"] = {
+                key: ann.get(key)
+                for key in (
+                    "items", "dim", "nlist", "nprobe", "cmax",
+                    "scanned_fraction", "recall_at_100", "topk_agreement",
+                    "ivf_qps", "brute_qps", "speedup", "build_s",
+                    "recall_at_100_int8", "recall_at_100_pq",
+                    "index_total_bytes", "projection_100m",
+                )
+                if key in ann
+            }
         chaos = record.get("chaos")
         if isinstance(chaos, Mapping):
             serve["chaos"] = {
@@ -1429,6 +1446,31 @@ def render(summary: Mapping[str, Any]) -> str:
             if ratio is not None:
                 parts.append(f"table bytes ×{ratio:.3f}")
             lines.append("  serving quant (int8 retrieval): " + " · ".join(parts))
+        ann = serve.get("ann")
+        if isinstance(ann, Mapping):
+            parts = []
+            if ann.get("items") is not None:
+                parts.append(
+                    f"{ann['items'] / 1e6:.0f}M items · nlist {ann.get('nlist')} "
+                    f"· nprobe {ann.get('nprobe')}"
+                )
+            recall = _finite(ann.get("recall_at_100"))
+            if recall is not None:
+                parts.append(f"recall@100 {recall:.4f}")
+            agreement = _finite(ann.get("topk_agreement"))
+            if agreement is not None:
+                parts.append(f"top-k agreement {agreement:.4f}")
+            speedup = _finite(ann.get("speedup"))
+            if speedup is not None:
+                parts.append(
+                    f"brute {_fmt(_finite(ann.get('brute_qps')), '{:.0f}')} qps "
+                    f"vs IVF {_fmt(_finite(ann.get('ivf_qps')), '{:.0f}')} qps "
+                    f"(×{speedup:.1f})"
+                )
+            frac = _finite(ann.get("scanned_fraction"))
+            if frac is not None:
+                parts.append(f"scans {frac:.2%}/query")
+            lines.append("  serving ann (ivf retrieval): " + " · ".join(parts))
         chaos = serve.get("chaos")
         if isinstance(chaos, Mapping):
             lines.append(
@@ -1660,7 +1702,10 @@ def compare_runs(
     remat-on strictly below remat-off on ``hbm_peak_bytes`` (the
     candidate-alone invariant, like the packing gate). Serving ``quant`` blocks
     gate ``recall_at_candidates`` / ``topk_match_rate`` higher-better with an
-    absolute 0.005 floor. Fleet runs (``bench_fleet.py``) gate ``fleet_qps``
+    absolute 0.005 floor; serving ``ann`` blocks (the IVF rung) gate
+    ``recall_at_100`` / ``topk_agreement`` the same way plus ``ann_qps``
+    higher-better on the relative threshold. Fleet runs (``bench_fleet.py``)
+    gate ``fleet_qps``
     higher-better always, and ``fleet_p99_ms`` / ``fleet_reroute_rate``
     lower-better only when the chaos phase matches on both sides (a kill's
     failover gap and reroutes must not fail against a no-chaos baseline).
@@ -2009,6 +2054,34 @@ def compare_runs(
                         f"serve_quant_{name} regressed "
                         f"{base_value:.4f} -> {cand_value:.4f} (higher is better)"
                     )
+        # IVF retrieval quality gates (sub-linear serving): same absolute
+        # 0.005 floor as the quant rung — approximation quality must not
+        # slide; ann_qps gates higher-better on the relative threshold
+        cand_ann = cand_serve.get("ann") or {}
+        base_ann = base_serve.get("ann") or {}
+        if cand_ann or base_ann:
+            for name in ("recall_at_100", "topk_agreement"):
+                cand_value = _finite(cand_ann.get(name))
+                base_value = _finite(base_ann.get(name))
+                if cand_value is None or base_value is None:
+                    lines.append(
+                        f"  serve_ann_{name}: candidate={_fmt(cand_value, '{:.4f}')} "
+                        f"baseline={_fmt(base_value, '{:.4f}')} (not comparable)"
+                    )
+                    continue
+                lines.append(
+                    f"  serve_ann_{name}: {cand_value:.4f} vs {base_value:.4f}"
+                )
+                if cand_value < base_value - 0.005:
+                    regressions.append(
+                        f"serve_ann_{name} regressed "
+                        f"{base_value:.4f} -> {cand_value:.4f} (higher is better)"
+                    )
+            check(
+                "serve_ann_qps",
+                _finite(cand_ann.get("ivf_qps")),
+                _finite(base_ann.get("ivf_qps")),
+            )
     # fleet gates (serve.fleet / bench_fleet.py): aggregate QPS is higher-
     # better; tail latency and the reroute rate are LOWER-better — but a
     # chaos run's p99 includes the failover gap and its reroutes are the

@@ -120,9 +120,10 @@ class CandidatePipeline:
         extra = span_args or {}
         with span("retrieve", rows=int(np.shape(hidden)[0]), k=self.num_candidates, **extra):
             values, ids = self.index.search_jax(hidden, self.num_candidates)
-        if getattr(self.index, "precision", "f32") != "f32":
-            # full-precision re-rank input: the int8 sweep only chose WHICH C
-            # rows to score; their ranking scores are exact f32
+        if self._is_approximate():
+            # full-precision re-rank input: the approximate sweep (quantized
+            # table and/or IVF probing) only chose WHICH C rows to score;
+            # their ranking scores are exact f32
             with span("rescore", rows=int(np.shape(hidden)[0]), k=self.num_candidates, **extra):
                 values = self.index.exact_rescore(hidden, ids)
         with span("rerank", rows=int(np.shape(hidden)[0]), k=self.top_k, **extra):
@@ -131,9 +132,22 @@ class CandidatePipeline:
             items = np.asarray(items)
         return scores, items
 
+    def _is_approximate(self) -> bool:
+        # IVF probing approximates the candidate SET even at f32 scores;
+        # legacy index objects without the property fall back to the
+        # precision cue (only the brute f32 sweep is exact)
+        return bool(
+            getattr(
+                self.index,
+                "is_approximate",
+                getattr(self.index, "precision", "f32") != "f32",
+            )
+        )
+
     def stats(self) -> Dict[str, int]:
         return {
             "num_candidates": self.num_candidates,
             "top_k": self.top_k,
             "index_precision": getattr(self.index, "precision", "f32"),
+            "index_mode": getattr(self.index, "index_mode", "brute"),
         }

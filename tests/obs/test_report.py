@@ -859,6 +859,61 @@ def test_serve_quant_topk_match_gates(tmp_path, capsys):
     assert "serve_quant_topk_match_rate" in capsys.readouterr().err
 
 
+def _write_ann_serve_run(path, recall=0.995, agreement=1.0, ivf_qps=2500.0):
+    os.makedirs(path, exist_ok=True)
+    record = {
+        "metric": "serve_qps", "value": 250.0, "unit": "req/s", "qps": 250.0,
+        "p50_ms": 2.0, "p95_ms": 3.5, "p99_ms": 4.5, "batch_fill_ratio": 0.8,
+        "cache_hit_rate": 0.9, "requests": 512, "mode": "retrieval",
+        "ann": {
+            "items": 10_000_000, "dim": 64, "nlist": 4096, "nprobe": 16,
+            "cmax": 4688, "scanned_fraction": 0.0075,
+            "recall_at_100": recall, "topk_agreement": agreement,
+            "brute_qps": 180.0, "ivf_qps": ivf_qps,
+            "speedup": ivf_qps / 180.0, "build_s": 310.0,
+            "recall_at_100_int8": 0.994, "recall_at_100_pq": 0.993,
+            "index_total_bytes": 2_900_000_000,
+        },
+    }
+    with open(os.path.join(path, "events.jsonl"), "w") as fh:
+        fh.write(json.dumps(record) + "\n")
+    return path
+
+
+def test_serve_ann_summarizes_and_renders(tmp_path, capsys):
+    run = _write_ann_serve_run(str(tmp_path / "serve"))
+    summary = summarize_run(run)
+    ann = summary["serve"]["ann"]
+    assert ann["recall_at_100"] == pytest.approx(0.995)
+    assert ann["nlist"] == 4096 and ann["nprobe"] == 16
+    assert main([run]) == 0
+    out = capsys.readouterr().out
+    assert "serving ann (ivf retrieval)" in out
+    assert "recall@100 0.9950" in out
+    assert "vs IVF" in out  # the brute-vs-IVF speedup line
+
+
+def test_serve_ann_recall_gates_higher_better(tmp_path, capsys):
+    baseline = _write_ann_serve_run(str(tmp_path / "base"), recall=0.995)
+    candidate = _write_ann_serve_run(str(tmp_path / "cand"), recall=0.95)
+    assert main([candidate, "--compare", baseline]) != 0
+    assert "serve_ann_recall_at_100" in capsys.readouterr().err
+    # within the absolute 0.005 band: measurement noise, not a regression
+    near = _write_ann_serve_run(str(tmp_path / "near"), recall=0.992)
+    assert main([near, "--compare", baseline]) == 0
+
+
+def test_serve_ann_agreement_and_qps_gate(tmp_path, capsys):
+    baseline = _write_ann_serve_run(str(tmp_path / "base"), agreement=1.0)
+    candidate = _write_ann_serve_run(str(tmp_path / "cand"), agreement=0.9)
+    assert main([candidate, "--compare", baseline]) != 0
+    assert "serve_ann_topk_agreement" in capsys.readouterr().err
+    slow = _write_ann_serve_run(str(tmp_path / "slow"), ivf_qps=1000.0)
+    fast = _write_ann_serve_run(str(tmp_path / "fast"), ivf_qps=2500.0)
+    assert main([slow, "--compare", fast]) != 0
+    assert "serve_ann_qps" in capsys.readouterr().err
+
+
 # --------------------------------------------------------------------------- #
 # promotion: canary lifecycle summary, rollback + swap_p99_ms compare gates
 # --------------------------------------------------------------------------- #

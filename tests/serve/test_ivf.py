@@ -1,0 +1,367 @@
+"""IVF sub-linear retrieval — build, recall gates, padding honesty, sharding.
+
+ISSUE 20 acceptance gates on a clustered synthetic catalog: recall@100 ≥ 0.99
+vs the brute-force sweep at the index's own ``nprobe`` for every precision rung
+(f32 and int8 raw; int8+pq through its serving configuration — 3× candidate
+overfetch + ``exact_rescore`` — because PQ codes select candidates, they never
+rank them), bitwise-deterministic builds, the PR-6-style adversarial padding
+test (strictly-negative catalog: any padded zero row winning top-k fails
+loudly), and the PR-15 no-table-gather assert on the sharded search's compiled
+HLO via ``collective_inventory``.
+
+The smoke tests leave ``REPLAY_TPU_RUN_DIR/ann_smoke/ivf_gate.json`` for the
+CI ``ann_smoke`` gate.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from replay_tpu.models.ivf import brute_bytes, default_nlist, ivf_bytes, ladder_width
+
+NUM_ITEMS = 20000
+DIM = 64
+QUERIES = 64
+MODES = 64
+NLIST = 64
+NPROBE = 32
+PQ_M = 16
+PQ_OVERFETCH = 3  # the pq rung's serving config: 3x candidates, then rescore
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    # clustered synthetic: item embeddings concentrate around latent modes
+    # (the structure IVF exploits; an unclustered catalog is brute's turf)
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(MODES, DIM)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    table = (
+        centers[rng.integers(0, MODES, size=NUM_ITEMS)]
+        + 0.1 * rng.normal(size=(NUM_ITEMS, DIM))
+    ).astype(np.float32)
+    queries = (
+        centers[rng.integers(0, MODES, size=QUERIES)]
+        + 0.1 * rng.normal(size=(QUERIES, DIM))
+    ).astype(np.float32)
+    return table, queries
+
+
+def _build(table, precision="f32", mesh=None, **overrides):
+    from replay_tpu.models.ann import MIPSIndex
+
+    kwargs = dict(
+        index="ivf", precision=precision, nlist=NLIST, nprobe=NPROBE,
+        build_sample=8192, pq_subspaces=PQ_M,
+    )
+    kwargs.update(overrides)
+    if mesh is not None:
+        kwargs.update(mesh=mesh, axis_name="model")
+    return MIPSIndex(table, **kwargs)
+
+
+@pytest.fixture(scope="module")
+def ground_truth(catalog):
+    from replay_tpu.models.ann import MIPSIndex
+
+    table, queries = catalog
+    brute = MIPSIndex(table)
+    values, ids = brute.search(queries, 100)
+    return values, ids
+
+
+def _recall(reference_ids: np.ndarray, candidate_ids: np.ndarray) -> float:
+    k = reference_ids.shape[1]
+    return float(
+        np.mean(
+            [
+                len(set(a.tolist()) & set(b.tolist())) / k
+                for a, b in zip(reference_ids, candidate_ids)
+            ]
+        )
+    )
+
+
+def _rescored_top100(index, queries, candidates):
+    exact = np.asarray(index.exact_rescore(queries, candidates))
+    order = np.argsort(-exact, axis=1)[:, :100]
+    return np.take_along_axis(np.asarray(candidates), order, axis=1)
+
+
+# --------------------------------------------------------------------------- #
+# host-side geometry and byte accounting (no device)
+# --------------------------------------------------------------------------- #
+@pytest.mark.core
+def test_ladder_widths_are_aligned_and_monotone():
+    widths = [ladder_width(n) for n in range(1, 4000, 7)]
+    assert all(w % 8 == 0 for w in widths)
+    assert all(w >= n for w, n in zip(widths, range(1, 4000, 7)))
+    assert widths == sorted(widths)
+    # the ladder is a SMALL fixed set of widths, not one per size
+    assert len(set(widths)) < 40
+    assert ladder_width(0) == 0
+
+
+@pytest.mark.core
+def test_default_nlist_is_mesh_divisible_power_of_two():
+    for items in (257, 20000, 1_000_000, 100_000_000):
+        for shards in (1, 8):
+            nlist = default_nlist(items, shards)
+            assert nlist & (nlist - 1) == 0, nlist  # power of two
+            assert nlist % shards == 0
+            assert nlist <= max(items // 4, 8 * shards)
+
+
+@pytest.mark.core
+def test_projected_100m_pq_fits_where_int8_brute_cannot():
+    """The 100M-item memory claim, machine-derived from the same formula that
+    prices the built index: at E=256 an int8 BRUTE table overflows a 16 GiB
+    v5e HBM, while the full IVF int8+pq index (codes + centroids + codebooks
+    + ids) fits with room for the model."""
+    hbm = 16 * 2**30
+    items, dim = 100_000_000, 256
+    brute_int8 = brute_bytes(items, dim, "int8")
+    pq = ivf_bytes(items, dim, nlist=65536, precision="int8+pq", pq_subspaces=32)
+    assert brute_int8["total_bytes"] > hbm, brute_int8
+    assert pq["total_bytes"] < hbm // 3, pq
+    # breakdown components sum to the total (no hand-asserted slack)
+    assert pq["total_bytes"] == (
+        pq["cell_bytes"] + pq["centroid_bytes"] + pq["codebook_bytes"]
+        + pq["scale_bytes"] + pq["id_bytes"]
+    )
+
+
+@pytest.mark.jax
+def test_table_bytes_breakdown_matches_device_arrays(catalog):
+    """The byte formula is anchored against the REAL device buffers — the
+    same formula then prices the 100M projection, keeping it machine-derived."""
+    table, _ = catalog
+    for precision in ("f32", "int8", "int8+pq"):
+        index = _build(table, precision)
+        state = index._ivf
+        reported = index.table_bytes()
+        if precision == "int8+pq":
+            assert reported["cell_bytes"] == state.codes.nbytes
+            assert reported["codebook_bytes"] == state.codebooks.nbytes
+        else:
+            assert reported["cell_bytes"] == state.storage.nbytes
+        assert reported["centroid_bytes"] == state.centroids.nbytes
+        assert reported["id_bytes"] == state.storage_ids.nbytes
+        assert reported["payload_bytes"] == reported["total_bytes"]
+
+
+# --------------------------------------------------------------------------- #
+# recall gates (the acceptance criteria) + determinism
+# --------------------------------------------------------------------------- #
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_ivf_recall_gates_all_rungs(catalog, ground_truth):
+    """recall@100 ≥ 0.99 vs brute at the index's own nprobe: f32 and int8
+    raw; int8+pq via its serving config (3× overfetch + exact rescore —
+    codes pick candidates, the rescore ranks them). Leaves the CI ann_smoke
+    artifact."""
+    table, queries = catalog
+    _, brute_ids = ground_truth
+    gate = {"catalog": NUM_ITEMS, "dim": DIM, "queries": QUERIES}
+
+    for precision in ("f32", "int8"):
+        index = _build(table, precision)
+        _, ids = index.search(queries, 100)
+        recall = _recall(brute_ids, ids)
+        assert recall >= 0.99, (precision, recall)
+        gate[f"recall_at_100_{precision}"] = recall
+
+    pq_index = _build(table, "int8+pq")
+    _, candidates = pq_index.search(queries, 100 * PQ_OVERFETCH)
+    pq_top = _rescored_top100(pq_index, queries, candidates)
+    pq_recall = _recall(brute_ids, pq_top)
+    assert pq_recall >= 0.99, pq_recall
+    gate["recall_at_100_int8+pq"] = pq_recall
+    gate["pq_overfetch"] = PQ_OVERFETCH
+    gate["bytes_ratio_pq"] = pq_index.table_bytes()["bytes_ratio"]
+    gate["index_stats"] = _build(table, "f32").index_stats()
+
+    base = os.environ.get("REPLAY_TPU_RUN_DIR")
+    if base:
+        run_dir = os.path.join(base, "ann_smoke")
+        os.makedirs(run_dir, exist_ok=True)
+        with open(os.path.join(run_dir, "ivf_gate.json"), "w") as fh:
+            json.dump(gate, fh, indent=1)
+
+
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_f32_ivf_scores_are_exact_dots(catalog, ground_truth):
+    """The f32 IVF rung approximates only the candidate SET: every returned
+    score must equal the brute sweep's score for that same item."""
+    table, queries = catalog
+    index = _build(table, "f32")
+    values, ids = index.search(queries, 100)
+    exact = np.asarray(index.exact_rescore(queries, ids))
+    np.testing.assert_allclose(values, exact, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.jax
+def test_ivf_build_is_deterministic(catalog):
+    """Same table, same seed → bitwise-same centroids, layout, and search
+    results (the zero-retrace contract extends to the build)."""
+    table, queries = catalog
+    first = _build(table, "f32", seed=7)
+    second = _build(table, "f32", seed=7)
+    assert np.array_equal(np.asarray(first._ivf.centroids), np.asarray(second._ivf.centroids))
+    assert np.array_equal(np.asarray(first._ivf.storage_ids), np.asarray(second._ivf.storage_ids))
+    v1, i1 = first.search(queries, 50)
+    v2, i2 = second.search(queries, 50)
+    assert np.array_equal(i1, i2) and np.array_equal(v1, v2)
+    stats = first.index_stats()
+    assert stats["index"] == "ivf" and stats["scanned_fraction"] > 0
+    assert stats["nlist"] == NLIST and stats["nprobe"] == NPROBE
+
+
+# --------------------------------------------------------------------------- #
+# adversarial padding honesty (PR-6 style) — unsharded and 8-way sharded
+# --------------------------------------------------------------------------- #
+@pytest.mark.jax
+def test_strictly_negative_catalog_never_surfaces_padding():
+    """Strictly-negative items vs strictly-positive queries: every true score
+    is < 0 while cell-padding rows are zeros (score 0) — any unmasked padded
+    row would WIN top-k. 611 items over non-divisible cells on the 8-device
+    mesh exercise ladder padding, the tail guard, and shard equalization."""
+    from replay_tpu.nn import make_mesh
+
+    rng = np.random.default_rng(3)
+    items = 611  # prime-ish: cells never divide evenly
+    dim = 16
+    table = (-np.abs(rng.normal(size=(items, dim))) - 0.5).astype(np.float32)
+    queries = (np.abs(rng.normal(size=(16, dim))) + 0.5).astype(np.float32)
+    mesh = make_mesh(model_parallel=8)
+
+    for precision in ("f32", "int8", "int8+pq"):
+        for use_mesh in (False, True):
+            index = _build(
+                table, precision, mesh=mesh if use_mesh else None,
+                nlist=16, nprobe=16, build_sample=items, pq_subspaces=4,
+            )
+            values, ids = index.search(queries, 20)
+            label = (precision, "sharded" if use_mesh else "unsharded")
+            assert np.all(ids >= 0), (label, ids.min())
+            assert np.all(ids < items), label
+            assert np.all(np.isfinite(values)), label
+            if precision != "int8+pq":  # pq scores are approximate sums
+                assert np.all(values < 0.0), (label, values.max())
+            for row in ids:
+                assert len(set(row.tolist())) == len(row), (label, row)
+
+
+# --------------------------------------------------------------------------- #
+# sharded layout: no table-sized collectives, recall preserved
+# --------------------------------------------------------------------------- #
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_sharded_ivf_search_never_moves_cell_rows(catalog, ground_truth):
+    """The PR-15 contract extended to IVF: the sharded search's compiled HLO
+    may move per-shard CANDIDATES (≤ the merge budget) but never cell rows —
+    every collective must be orders below the per-shard cell payload."""
+    from replay_tpu.nn import make_mesh
+    from replay_tpu.parallel.introspect import collective_inventory
+
+    table, queries = catalog
+    _, brute_ids = ground_truth
+    mesh = make_mesh(model_parallel=8)
+    index = _build(table, "f32", mesh=mesh)
+    n_shards = 8
+    k = 100
+
+    _, ids = index.search(queries, k)
+    recall = _recall(brute_ids, ids)
+    assert recall >= 0.99, recall
+
+    state = index._ivf
+    local_k = min(k, (NPROBE // n_shards) * state.cmax)
+    merge_budget = 2 * QUERIES * local_k * n_shards * 8
+    shard_bytes = index.table_shard_bytes()
+    assert merge_budget < shard_bytes, (merge_budget, shard_bytes)
+    inventory = collective_inventory(index.search_hlo(QUERIES, k))
+    assert inventory, "sharded search must communicate candidates"
+    for entry in inventory:
+        size = entry.get("bytes") or 0
+        assert size <= merge_budget, (entry, merge_budget, shard_bytes)
+
+
+# --------------------------------------------------------------------------- #
+# serving pipeline integration
+# --------------------------------------------------------------------------- #
+@pytest.mark.jax
+@pytest.mark.smoke
+def test_pipeline_rescores_ivf_and_agrees_with_brute(catalog):
+    """IVF is approximate even at f32 — the pipeline must insert the
+    exact-rescore stage (brute f32 must NOT) and its re-ranked top-k must
+    agree with the brute f32 pipeline wherever the candidates cover the
+    winners (approximation picks candidates, never ranks them)."""
+    from replay_tpu.models.ann import MIPSIndex
+    from replay_tpu.obs import Tracer
+    from replay_tpu.serve import CandidatePipeline
+
+    table, queries = catalog
+    weights = np.asarray([0.05, 0.1], np.float32)
+    brute_pipe = CandidatePipeline(
+        MIPSIndex(table), num_candidates=100, top_k=10, reranker_weights=weights
+    )
+    ivf_pipe = CandidatePipeline(
+        _build(table, "f32"), num_candidates=100, top_k=10, reranker_weights=weights
+    )
+    assert ivf_pipe.stats()["index_mode"] == "ivf"
+    assert brute_pipe.stats()["index_mode"] == "brute"
+
+    tracer = Tracer()
+    _, brute_topk = brute_pipe.rank(queries, tracer=tracer)
+    assert "rescore" not in set(tracer.summary())
+
+    tracer = Tracer()
+    _, ivf_topk = ivf_pipe.rank(queries, tracer=tracer)
+    names = set(tracer.summary())
+    assert {"retrieve", "rescore", "rerank"} <= names, names
+
+    _, ivf_cands = ivf_pipe.index.search(queries, 100)
+    covered = agreed = 0
+    for row in range(queries.shape[0]):
+        if set(brute_topk[row].tolist()) <= set(ivf_cands[row].tolist()):
+            covered += 1
+            if set(brute_topk[row].tolist()) == set(ivf_topk[row].tolist()):
+                agreed += 1
+    assert covered >= int(0.9 * queries.shape[0]), covered
+    assert agreed == covered, (agreed, covered)
+    assert _recall(brute_topk, ivf_topk) >= 0.99
+
+
+# --------------------------------------------------------------------------- #
+# rejection paths
+# --------------------------------------------------------------------------- #
+@pytest.mark.jax
+def test_ivf_rejects_bad_configs(catalog):
+    from replay_tpu.models.ann import MIPSIndex
+    from replay_tpu.nn import make_mesh
+
+    table, queries = catalog
+    with pytest.raises(ValueError, match="index"):
+        MIPSIndex(table, index="hnsw")
+    with pytest.raises(ValueError, match="precision"):
+        MIPSIndex(table, precision="int8+pq")  # pq is an IVF-only rung
+    with pytest.raises(ValueError, match="precision"):
+        MIPSIndex(table, index="ivf", precision="int4")
+    with pytest.raises(ValueError, match="nlist"):
+        MIPSIndex(table, index="ivf", nlist=NUM_ITEMS + 1)
+    with pytest.raises(ValueError, match="nprobe"):
+        MIPSIndex(table, index="ivf", nlist=16, nprobe=17)
+    with pytest.raises(ValueError, match="pq_subspaces"):
+        MIPSIndex(table, index="ivf", precision="int8+pq", pq_subspaces=7)
+    with pytest.raises(ValueError, match="shards"):
+        MIPSIndex(
+            table, index="ivf", nlist=12, mesh=make_mesh(model_parallel=8),
+            axis_name="model",
+        )
+    index = _build(table, "f32", nlist=16, nprobe=2)
+    with pytest.raises(ValueError, match="probed candidate pool"):
+        index.search(queries, 2 * index._ivf.cmax + 1)
